@@ -1,0 +1,23 @@
+"""dalle_pytorch_tpu: a TPU-native (JAX/XLA/Pallas/pjit) framework with the
+capabilities of DALLE-pytorch (discrete VAE + autoregressive text->image
+transformer + CLIP), re-designed TPU-first.
+
+Public API mirrors the reference package surface
+(`/root/reference/dalle_pytorch/__init__.py:1-2`): DALLE, CLIP, DiscreteVAE,
+plus pretrained-VAE import wrappers.
+"""
+
+from dalle_pytorch_tpu.version import __version__
+from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+from dalle_pytorch_tpu.models.dalle import DALLE
+from dalle_pytorch_tpu.models.clip import CLIP
+from dalle_pytorch_tpu.models.vae_io import OpenAIDiscreteVAE, VQGanVAE
+
+__all__ = [
+    "DALLE",
+    "CLIP",
+    "DiscreteVAE",
+    "OpenAIDiscreteVAE",
+    "VQGanVAE",
+    "__version__",
+]

@@ -1,0 +1,4 @@
+from dalle_pytorch_tpu.models.dvae import DiscreteVAE, ResBlock
+from dalle_pytorch_tpu.models.clip import CLIP
+from dalle_pytorch_tpu.models.dalle import DALLE
+from dalle_pytorch_tpu.models.vae_io import OpenAIDiscreteVAE, VQGanVAE

@@ -1,0 +1,116 @@
+"""Attention module: one dense MXU-friendly kernel, many static mask patterns.
+
+The reference has four attention classes
+(`/root/reference/dalle_pytorch/attention.py:39,103,225,339`): full causal,
+conv-like sparse (unfold), axial row/col sparse, and a DeepSpeed CUDA
+block-sparse wrapper. On TPU, every one of these is expressed as *dense
+attention with a static boolean mask* (see ops/masks.py) — a single fused
+einsum chain that XLA tiles onto the MXU; masking is a free epilogue. This
+is both simpler and faster than gather-based sparsity at DALL-E sequence
+lengths (<= a few thousand tokens); a Pallas flash/block-sparse kernel for
+longer sequences is planned under ops/.
+
+Semantics preserved from the reference:
+  * rotary embeddings are applied to q, k AND v (`attention.py:67`);
+  * optional stable softmax (`attention.py:27-30`);
+  * key-padding mask [B, N] (True = valid key);
+  * causal mask composed with the per-layer static pattern mask.
+
+The decode-time KV cache is a fixed-shape pytree {k, v, index} with k/v of
+shape [B, heads, max_len, dim_head]; causality during cached decode is
+enforced by masking positions > index (the reference instead relies on only
+having written the prefix, `attention.py:71-76,86`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax.numpy as jnp
+import jax.lax as lax
+import flax.linen as nn
+
+from dalle_pytorch_tpu.ops.attention_core import dense_attention
+from dalle_pytorch_tpu.ops.rotary import apply_rotary
+
+
+def _cache_write(buf: jnp.ndarray, val: jnp.ndarray, index) -> jnp.ndarray:
+    """Write val [B,H,1,D] into buf [B,H,S,D] at sequence position `index`."""
+    return lax.dynamic_update_slice(buf, val.astype(buf.dtype), (0, 0, index, 0))
+
+
+class Attention(nn.Module):
+    """Multi-head (optionally causal) attention with a static pattern mask."""
+
+    dim: int
+    seq_len: int
+    heads: int = 8
+    dim_head: int = 64
+    causal: bool = True
+    dropout: float = 0.0
+    stable: bool = False
+    static_mask: Optional[np.ndarray] = None  # [S, S] bool, True = attend
+    dtype: Any = jnp.float32
+
+    def _full_mask(self, n_q: int, n_k: int) -> Optional[np.ndarray]:
+        """Host-side composition of causal + static masks, cropped."""
+        mask = None
+        if self.causal:
+            mask = np.tril(np.ones((n_k, n_k), dtype=bool))[n_k - n_q :, :]
+        if self.static_mask is not None:
+            sm = np.asarray(self.static_mask)[n_k - n_q : n_k, :n_k]
+            mask = sm if mask is None else (mask & sm)
+        return mask
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        key_mask: Optional[jnp.ndarray] = None,
+        rotary: Optional[jnp.ndarray] = None,
+        cache: Optional[dict] = None,
+        deterministic: bool = True,
+    ):
+        b, n, _ = x.shape
+        h, dh = self.heads, self.dim_head
+        inner = h * dh
+
+        qkv = nn.Dense(inner * 3, use_bias=False, dtype=self.dtype, name="to_qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q, k, v = (t.reshape(b, n, h, dh).transpose(0, 2, 1, 3) for t in (q, k, v))
+
+        new_cache = None
+        if cache is not None:
+            # single-token decode step (n == 1) against a fixed-shape cache
+            index = cache["index"]
+            if rotary is not None:
+                rot = lax.dynamic_slice_in_dim(rotary, index, 1, axis=0)
+                rot = jnp.expand_dims(rot, (0, 1))  # [1,1,1,dr]
+                q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
+            ck = _cache_write(cache["k"], k, index)
+            cv = _cache_write(cache["v"], v, index)
+            max_len = ck.shape[2]
+            valid = jnp.arange(max_len) <= index
+            mask = valid[None, None, None, :]
+            if self.static_mask is not None:
+                sm = jnp.asarray(self.static_mask[:max_len, :max_len])
+                row = lax.dynamic_slice_in_dim(sm, index, 1, axis=0)[0]
+                mask = mask & row[None, None, None, :]
+            out = dense_attention(q, ck, cv, mask=mask, stable=self.stable)
+            new_cache = {"k": ck, "v": cv, "index": index + 1}
+        else:
+            if rotary is not None:
+                rot = jnp.expand_dims(rotary[:n], (0, 1))
+                q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
+            mask = self._full_mask(n, n)
+            mask = None if mask is None else jnp.asarray(mask)[None, None]
+            if key_mask is not None:
+                km = key_mask[:, None, None, :]
+                mask = km if mask is None else (mask & km)
+            out = dense_attention(q, k, v, mask=mask, stable=self.stable)
+
+        out = out.transpose(0, 2, 1, 3).reshape(b, n, inner)
+        out = nn.Dense(self.dim, dtype=self.dtype, name="to_out")(out)
+        out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
+        return out, new_cache

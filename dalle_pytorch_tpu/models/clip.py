@@ -1,0 +1,121 @@
+"""CLIP: text & image encoders with a symmetric InfoNCE objective.
+
+TPU-native equivalent of the reference `CLIP`
+(`/root/reference/dalle_pytorch/dalle_pytorch.py:274-350`): token/patch
+embeddings + positional embeddings, non-causal transformer encoders, masked
+mean pooling for text, L2-normalized latents, learnable temperature
+(stored as log-space parameter whose exp scales similarities), and the
+symmetric cross-entropy loss over the in-batch similarity matrix. Used by
+the generation pipeline to rerank samples (`dalle_pytorch.py:569-571`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from dalle_pytorch_tpu.models.transformer import Transformer
+from dalle_pytorch_tpu.models.dalle import cross_entropy
+
+
+class CLIP(nn.Module):
+    dim_text: int = 512
+    dim_image: int = 512
+    dim_latent: int = 512
+    num_text_tokens: int = 10000
+    text_enc_depth: int = 6
+    text_seq_len: int = 256
+    text_heads: int = 8
+    num_visual_tokens: int = 512
+    visual_enc_depth: int = 6
+    visual_heads: int = 8
+    visual_image_size: int = 256
+    visual_patch_size: int = 32
+    channels: int = 3
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        assert self.visual_image_size % self.visual_patch_size == 0
+        self.num_patches = (self.visual_image_size // self.visual_patch_size) ** 2
+
+        self.text_emb = nn.Embed(self.num_text_tokens, self.dim_text, dtype=self.dtype)
+        self.text_pos_emb = nn.Embed(self.text_seq_len, self.dim_text, dtype=self.dtype)
+        self.text_transformer = Transformer(
+            dim=self.dim_text,
+            depth=self.text_enc_depth,
+            seq_len=self.text_seq_len,
+            causal=False,
+            heads=self.text_heads,
+            rotary_emb=False,
+            dtype=self.dtype,
+        )
+        self.to_text_latent = nn.Dense(self.dim_latent, use_bias=False, dtype=self.dtype)
+
+        self.to_visual_embedding = nn.Dense(self.dim_image, dtype=self.dtype)
+        self.visual_pos_emb = nn.Embed(self.num_patches, self.dim_image, dtype=self.dtype)
+        self.visual_transformer = Transformer(
+            dim=self.dim_image,
+            depth=self.visual_enc_depth,
+            seq_len=self.num_patches,
+            causal=False,
+            heads=self.visual_heads,
+            rotary_emb=False,
+            dtype=self.dtype,
+        )
+        self.to_visual_latent = nn.Dense(self.dim_latent, use_bias=False, dtype=self.dtype)
+
+        self.temperature = self.param("temperature", nn.initializers.ones, ())
+
+    def _patches(self, image: jnp.ndarray) -> jnp.ndarray:
+        """[B, H, W, C] -> [B, n_patches, p*p*C]."""
+        p = self.visual_patch_size
+        b, hh, ww, c = image.shape
+        h, w = hh // p, ww // p
+        x = image.reshape(b, h, p, w, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(b, h * w, p * p * c)
+
+    def __call__(
+        self,
+        text: jnp.ndarray,
+        image: jnp.ndarray,
+        text_mask: Optional[jnp.ndarray] = None,
+        return_loss: bool = False,
+        deterministic: bool = True,
+    ):
+        b = text.shape[0]
+
+        text_emb = self.text_emb(text) + self.text_pos_emb(jnp.arange(text.shape[1]))
+        image_emb = self.to_visual_embedding(self._patches(image))
+        image_emb = image_emb + self.visual_pos_emb(jnp.arange(image_emb.shape[1]))
+
+        enc_text = self.text_transformer(
+            text_emb, key_mask=text_mask, deterministic=deterministic
+        )
+        enc_image = self.visual_transformer(image_emb, deterministic=deterministic)
+
+        if text_mask is not None:
+            m = text_mask[..., None].astype(enc_text.dtype)
+            text_latents = (enc_text * m).sum(1) / m.sum(1)
+        else:
+            text_latents = enc_text.mean(axis=1)
+        image_latents = enc_image.mean(axis=1)
+
+        text_latents = self.to_text_latent(text_latents)
+        image_latents = self.to_visual_latent(image_latents)
+
+        norm = lambda t: t / jnp.linalg.norm(t, axis=-1, keepdims=True)
+        text_latents, image_latents = norm(text_latents), norm(image_latents)
+
+        temp = jnp.exp(self.temperature)
+
+        if not return_loss:
+            return jnp.einsum("nd,nd->n", text_latents, image_latents) * temp
+
+        sim = jnp.einsum("id,jd->ij", text_latents, image_latents) * temp
+        labels = jnp.arange(b)
+        loss = (cross_entropy(sim, labels) + cross_entropy(sim.T, labels)) / 2
+        return loss

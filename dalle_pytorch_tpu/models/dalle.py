@@ -1,0 +1,399 @@
+"""DALL-E: joint text+image autoregressive token transformer.
+
+TPU-native re-design of the reference `DALLE`
+(`/root/reference/dalle_pytorch/dalle_pytorch.py:354-707`). Functional
+differences from the reference's torch design, on purpose:
+
+  * the frozen VAE is NOT owned by this module. JAX separates parameters
+    from code, so the train/generate pipelines compose
+    `vae.get_codebook_indices` / `vae.decode` (under `stop_gradient`) with
+    this module explicitly — the better TPU pattern is precomputing image
+    tokens offline anyway. The constructor takes the VAE's geometry
+    (`num_image_tokens`, `image_fmap_size`) instead of the model.
+  * generation is a `lax.scan` over positions (see `generate_images`), not
+    a Python loop.
+
+Semantics preserved (with reference lines):
+  * per-position unique padding tokens for text (`:389,606-609`): token id 0
+    at text position p becomes id num_text_tokens_base + p; the embedding
+    table is extended by text_seq_len ids;
+  * <bos> = id 0 prepended (`:612`), sequence truncated to
+    text_seq_len + image_seq_len (`:644-646`);
+  * text/image logits range masks and the fork's inverse-rotated mask
+    (`:450-464,662-675`);
+  * classifier-free-guidance null conditioning: zero out text ids with
+    probability null_cond_prob (`:600-604`), two-forward blend at sampling
+    (`:575-585`);
+  * "stable" tricks: 0.1x + 0.9 stop_grad(x) input anchor (`:648-650`) and
+    DivideMax output norm (`:657-658`);
+  * split text/image cross-entropy with configurable coefficients
+    (`:693-706`), including the fork's inverse (image->text) objective and
+    its 3-token sequence-accuracy metric (`:697-699`). For the inverse mode
+    the reference splits the loss at `text_seq_len`, which equals the
+    image/text boundary only when image_seq_len == text_seq_len (the fork's
+    experimental configs); we split at the actual boundary `image_seq_len`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from dalle_pytorch_tpu.models.transformer import Transformer, DivideMax
+from dalle_pytorch_tpu.ops.sampling import top_k_filter, gumbel_sample
+
+NEG_MASK_VALUE = -float(np.finfo(np.float32).max)
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy with integer labels, fp32 accumulation."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+class AxialPositionalEmbedding(nn.Module):
+    """Row+col additive positional embedding over a 2-D grid, flattened.
+
+    Equivalent of the reference's AxialPositionalEmbedding dependency
+    (`dalle_pytorch.py:392`).
+    """
+
+    dim: int
+    row: int
+    col: int
+
+    @nn.compact
+    def __call__(self, n: int) -> jnp.ndarray:
+        rows = self.param("rows", nn.initializers.normal(1.0), (self.row, 1, self.dim))
+        cols = self.param("cols", nn.initializers.normal(1.0), (1, self.col, self.dim))
+        pos = (rows + cols).reshape(self.row * self.col, self.dim)
+        return pos[:n]
+
+
+class DALLE(nn.Module):
+    dim: int
+    depth: int
+    num_image_tokens: int
+    image_fmap_size: int
+    num_text_tokens: int = 10000  # base count, before unique-pad extension
+    text_seq_len: int = 256
+    heads: int = 8
+    dim_head: int = 64
+    reversible: bool = False
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    attn_types: Optional[Sequence[str]] = None
+    loss_img_weight: float = 7.0  # upstream knob; default img_loss_coeff
+    stable: bool = False
+    sandwich_norm: bool = False
+    shift_tokens: bool = True
+    rotary_emb: bool = True
+    shared_attn_ids: Optional[Sequence[int]] = None
+    shared_ff_ids: Optional[Sequence[int]] = None
+    share_input_output_emb: bool = False
+    # fork's multi-objective coefficients (`config/config.yaml:21-24`).
+    # img_loss_coeff=None defaults to loss_img_weight, making the upstream
+    # knob `(loss_text + w*loss_img)/(w+1)` (`dalle_pytorch.py:702-706`) work.
+    text_loss_coeff: float = 1.0
+    img_loss_coeff: Optional[float] = None
+    text_loss_coeff_inv: float = 7.0
+    img_loss_coeff_inv: float = 1.0
+    dtype: Any = jnp.float32
+
+    @property
+    def total_text_tokens(self) -> int:
+        return self.num_text_tokens + self.text_seq_len
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.image_fmap_size**2
+
+    @property
+    def total_seq_len(self) -> int:
+        return self.text_seq_len + self.image_seq_len
+
+    @property
+    def total_tokens(self) -> int:
+        return self.total_text_tokens + self.num_image_tokens
+
+    def setup(self):
+        self.text_emb = nn.Embed(self.total_text_tokens, self.dim, dtype=self.dtype)
+        self.image_emb = nn.Embed(self.num_image_tokens, self.dim, dtype=self.dtype)
+
+        if not self.rotary_emb:
+            self.text_pos_emb = nn.Embed(self.text_seq_len + 1, self.dim, dtype=self.dtype)
+            self.image_pos_emb = AxialPositionalEmbedding(
+                self.dim, self.image_fmap_size, self.image_fmap_size
+            )
+
+        self.transformer = Transformer(
+            dim=self.dim,
+            depth=self.depth,
+            seq_len=self.total_seq_len,
+            causal=True,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            attn_dropout=self.attn_dropout,
+            ff_dropout=self.ff_dropout,
+            attn_types=self.attn_types,
+            image_fmap_size=self.image_fmap_size,
+            stable=self.stable,
+            sandwich_norm=self.sandwich_norm,
+            shift_tokens=self.shift_tokens,
+            rotary_emb=self.rotary_emb,
+            shared_attn_ids=self.shared_attn_ids,
+            shared_ff_ids=self.shared_ff_ids,
+            reversible=self.reversible,
+            dtype=self.dtype,
+        )
+
+        if self.stable:
+            self.norm_by_max = DivideMax(axis=-1)
+
+        self.logits_norm = nn.LayerNorm(dtype=self.dtype)
+        if not self.share_input_output_emb:
+            self.logits_dense = nn.Dense(self.total_tokens, dtype=self.dtype)
+        else:
+            self.logits_bias = self.param(
+                "logits_bias", nn.initializers.zeros, (self.total_tokens,)
+            )
+
+        # static logits-range masks; True = BLOCKED (reference `:450-464`)
+        seq = np.arange(self.total_seq_len)[:, None]
+        vocab = np.arange(self.total_tokens)[None, :]
+        mask = ((seq >= self.text_seq_len) & (vocab < self.total_text_tokens)) | (
+            (seq < self.text_seq_len) & (vocab >= self.total_text_tokens)
+        )
+        self._logits_mask = mask
+        # inverse mode: image occupies the front of the sequence (`:463`)
+        self._logits_mask_inv = np.concatenate(
+            [mask[self.text_seq_len :], mask[: self.text_seq_len]], axis=0
+        )
+
+    def to_logits(self, out: jnp.ndarray) -> jnp.ndarray:
+        if self.stable:
+            out = self.norm_by_max(out)
+        out = self.logits_norm(out)
+        if self.share_input_output_emb:
+            kernel = jnp.concatenate(
+                [self.text_emb.embedding, self.image_emb.embedding], axis=0
+            ).astype(out.dtype)
+            return out @ kernel.T + self.logits_bias.astype(out.dtype)
+        return self.logits_dense(out)
+
+    def embed_text(self, text: jnp.ndarray, null_cond_prob: float = 0.0):
+        """Unique-pad remap + <bos>; returns (padded_ids [B, T+1], embeddings)."""
+        b = text.shape[0]
+        assert text.shape[-1] == self.text_seq_len, (
+            f"text length {text.shape[-1]} != text_seq_len {self.text_seq_len}"
+        )
+        if null_cond_prob > 0:
+            rng = self.make_rng("null_cond")
+            null = jax.random.uniform(rng, (b, 1)) < null_cond_prob
+            text = jnp.where(null, 0, text)
+
+        text_range = jnp.arange(self.text_seq_len) + (
+            self.total_text_tokens - self.text_seq_len
+        )
+        text = jnp.where(text == 0, text_range, text)
+        text = jnp.pad(text, ((0, 0), (1, 0)))  # <bos> = 0
+
+        tokens = self.text_emb(text)
+        if not self.rotary_emb:
+            tokens = tokens + self.text_pos_emb(jnp.arange(text.shape[1]))
+        return text, tokens
+
+    def __call__(
+        self,
+        text: jnp.ndarray,
+        image: Optional[jnp.ndarray] = None,
+        return_loss: bool = False,
+        inverse_mapping: bool = False,
+        reverse_model: bool = False,
+        null_cond_prob: float = 0.0,
+        deterministic: bool = True,
+    ):
+        """text: [B, text_seq_len] int ids; image: [B, <=image_seq_len] codebook ids.
+
+        Raw-pixel image input is handled by the pipeline (frozen VAE encode)
+        before this call — see module docstring.
+        """
+        text, tokens = self.embed_text(text, null_cond_prob)
+
+        if image is not None and image.shape[1] > 0:
+            image_emb = self.image_emb(image)
+            if not self.rotary_emb:
+                image_emb = image_emb + self.image_pos_emb(image_emb.shape[1])
+            if inverse_mapping:
+                tokens = jnp.concatenate([image_emb, tokens], axis=1)
+            else:
+                tokens = jnp.concatenate([tokens, image_emb], axis=1)
+
+        seq_len = tokens.shape[1]
+        if seq_len > self.total_seq_len:  # drop the final token's input slot
+            tokens = tokens[:, : self.total_seq_len]
+            seq_len = self.total_seq_len
+
+        if self.stable:
+            alpha = 0.1
+            tokens = tokens * alpha + jax.lax.stop_gradient(tokens) * (1 - alpha)
+
+        out = self.transformer(
+            tokens, reverse_model=reverse_model, deterministic=deterministic
+        )
+        logits = self.to_logits(out)
+
+        lmask = self._logits_mask_inv if inverse_mapping else self._logits_mask
+        lmask = jnp.asarray(lmask[:seq_len])[None]
+        logits = jnp.where(lmask, NEG_MASK_VALUE, logits.astype(jnp.float32))
+
+        if not return_loss:
+            return logits
+
+        assert image is not None, "when training, image must be supplied"
+        offsetted_image = image + self.total_text_tokens
+
+        if inverse_mapping:
+            # image first, then text: labels rotate image forward one step and
+            # append the full bos-padded text (`:686-687`)
+            labels = jnp.concatenate([offsetted_image[:, 1:], text], axis=1)
+            split = self.image_seq_len  # see module docstring re: fork's quirk
+            loss_text = cross_entropy(logits[:, split:], labels[:, split:])
+            loss_img = cross_entropy(logits[:, : split - 1], labels[:, : split - 1])
+            pred3 = jnp.argmax(logits[:, split : split + 3], axis=-1)
+            accuracy = jnp.mean(
+                jnp.all(pred3 == labels[:, split : split + 3], axis=-1).astype(jnp.float32)
+            )
+            ct, ci = self.text_loss_coeff_inv, self.img_loss_coeff_inv
+            loss = (ct * loss_text + ci * loss_img) / (ct + ci)
+        else:
+            labels = jnp.concatenate([text[:, 1:], offsetted_image], axis=1)
+            split = self.text_seq_len
+            loss_text = cross_entropy(logits[:, :split], labels[:, :split])
+            loss_img = cross_entropy(logits[:, split:], labels[:, split:])
+            ct = self.text_loss_coeff
+            ci = self.loss_img_weight if self.img_loss_coeff is None else self.img_loss_coeff
+            loss = (ct * loss_text + ci * loss_img) / (ct + ci)
+            accuracy = None
+
+        return loss, accuracy
+
+
+def forward_with_cond_scale(
+    model: DALLE, variables, text, image, cond_scale: float = 1.0, rngs=None
+):
+    """Two-forward classifier-free-guidance blend (`dalle_pytorch.py:575-585`)."""
+    logits = model.apply(variables, text, image, rngs=rngs)
+    if cond_scale == 1:
+        return logits
+    null_rngs = dict(rngs or {})
+    null_rngs["null_cond"] = jax.random.PRNGKey(0)  # prob=1 -> rng irrelevant
+    null_logits = model.apply(
+        variables, text, image, null_cond_prob=1.0, rngs=null_rngs
+    )
+    return null_logits + (logits - null_logits) * cond_scale
+
+
+def generate_images(
+    model: DALLE,
+    variables,
+    rng: jax.Array,
+    text: jnp.ndarray,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    cond_scale: float = 1.0,
+    init_image_tokens: Optional[jnp.ndarray] = None,
+    num_init_img_tokens: Optional[int] = None,
+):
+    """Autoregressively sample image codebook indices for `text`.
+
+    Equivalent of `DALLE.generate_images` (`dalle_pytorch.py:517-567`) up to
+    VAE decode, which the caller applies to the returned [B, image_seq_len]
+    indices. Priming follows the reference's 43.75% default (`:542`).
+
+    Implementation: `lax.scan` over image positions; each step runs a full
+    forward over the fixed-shape token buffer (causality makes the suffix
+    garbage irrelevant). A KV-cached fast path (using Transformer.init_cache)
+    is planned; this path is the correctness oracle it will be tested against.
+    """
+    b = text.shape[0]
+    image_seq_len = model.image_seq_len
+    img_tokens = jnp.zeros((b, image_seq_len), dtype=jnp.int32)
+
+    primed = 0
+    if init_image_tokens is not None:
+        primed = (
+            int(0.4375 * image_seq_len)
+            if num_init_img_tokens is None
+            else num_init_img_tokens
+        )
+        assert primed < image_seq_len
+        img_tokens = img_tokens.at[:, :primed].set(init_image_tokens[:, :primed])
+
+    def step(carry, i):
+        img_tokens, rng = carry
+        rng, sample_rng = jax.random.split(rng)
+        logits = forward_with_cond_scale(
+            model, variables, text, img_tokens, cond_scale=cond_scale
+        )
+        pos_logits = logits[:, model.text_seq_len + i]
+        filtered = top_k_filter(pos_logits, thres=filter_thres)
+        sample = gumbel_sample(sample_rng, filtered, temperature=temperature)
+        sample = (sample - model.total_text_tokens).astype(jnp.int32)
+        keep = i < primed
+        prev = jax.lax.dynamic_index_in_dim(img_tokens, i, axis=1, keepdims=False)
+        new = jnp.where(keep, prev, sample)
+        img_tokens = jax.lax.dynamic_update_slice(img_tokens, new[:, None], (0, i))
+        return (img_tokens, rng), None
+
+    (img_tokens, _), _ = jax.lax.scan(
+        step, (img_tokens, rng), jnp.arange(image_seq_len)
+    )
+    return img_tokens
+
+
+def generate_texts(
+    model: DALLE,
+    variables,
+    rng: jax.Array,
+    text_prefix: jnp.ndarray,
+    prefix_len: int,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+):
+    """Autoregressive text completion (`dalle_pytorch.py:470-515`).
+
+    text_prefix: [B, text_seq_len] with ids after position `prefix_len`
+    ignored/overwritten. Returns [B, text_seq_len] token ids.
+
+    Note: a sampled id 0 is treated as padding on subsequent steps (the
+    unique-pad remap applies to it, and decoding strips it) — consistent
+    with the training distribution, where a raw 0 never appears
+    mid-sequence; the model sampling 0 means "end of caption".
+    """
+
+    def step(carry, i):
+        text, rng = carry
+        rng, sample_rng = jax.random.split(rng)
+        logits = model.apply(variables, text)  # image part absent
+        pos_logits = logits[:, i]  # position i predicts text token i (bos shift)
+        filtered = top_k_filter(pos_logits, thres=filter_thres)
+        sample = gumbel_sample(sample_rng, filtered, temperature=temperature).astype(
+            jnp.int32
+        )
+        keep = i < prefix_len
+        prev = jax.lax.dynamic_index_in_dim(text, i, axis=1, keepdims=False)
+        new = jnp.where(keep, prev, sample)
+        text = jax.lax.dynamic_update_slice(text, new[:, None], (0, i))
+        return (text, rng), None
+
+    (text, _), _ = jax.lax.scan(
+        step, (text_prefix.astype(jnp.int32), rng), jnp.arange(model.text_seq_len)
+    )
+    return text

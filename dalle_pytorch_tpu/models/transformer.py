@@ -1,0 +1,318 @@
+"""Transformer block assembly for DALLE/CLIP.
+
+TPU-native re-design of the reference transformer
+(`/root/reference/dalle_pytorch/transformer.py:206-353`). Feature parity:
+
+  * per-layer attention-type cycling over
+    {full, sparse, axial_row, axial_col, conv_like} (`transformer.py:238-266`)
+    — every variant realized as dense attention + static mask (ops/masks.py);
+  * cross-layer weight sharing via shared_attn_ids / shared_ff_ids
+    (`transformer.py:242-279`) — flax module reuse shares parameters;
+  * LayerScale with depth-dependent init (`transformer.py:76-90`);
+  * PreNorm with optional sandwich output norm (`transformer.py:94-104`);
+  * GEGLU feed-forward (`transformer.py:108-124`);
+  * token-shift before attention and FF (`transformer.py:128-202`), as a
+    pure function on the fixed-shape sequence;
+  * dual rotary embeddings (1-D text + 2-D axial pixel with sentinel
+    positions, `transformer.py:306-330`), precomputed host-side;
+  * `reverse_model=True` runs layers in reversed order — the fork's
+    inverse-mapping trick (`reversible.py:141-144`);
+  * reversible mode maps to `jax.remat` per layer (activation recompute in
+    backward — the memory behavior `reversible.py:57-127` buys), with a true
+    custom-vjp reversible executor as a follow-up.
+
+The executor unrolls layers in Python (static depth) so XLA sees one big
+fusable graph; weight-shared stacks may later scan.
+"""
+
+from __future__ import annotations
+
+import math
+from itertools import cycle, islice
+from typing import Any, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from dalle_pytorch_tpu.models.attention import Attention
+from dalle_pytorch_tpu.ops.masks import (
+    axial_static_mask,
+    conv_like_mask,
+    block_sparse_layout,
+    block_layout_to_token_mask,
+)
+from dalle_pytorch_tpu.ops.rotary import build_dalle_rotary
+from dalle_pytorch_tpu.ops.shift import shift_tokens_dalle
+
+
+def layerscale_init(layer_index: int) -> float:
+    """LayerScale init epsilon by 1-based layer index (`transformer.py:79-84`)."""
+    if layer_index <= 18:
+        return 0.1
+    if layer_index <= 24:
+        return 1e-5
+    return 1e-6
+
+
+class DivideMax(nn.Module):
+    """Divide by the (detached) max along an axis (`transformer.py:31-38`)."""
+
+    axis: int = -1
+
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        maxes = jax.lax.stop_gradient(jnp.max(x, axis=self.axis, keepdims=True))
+        return x / maxes
+
+
+class FeedForward(nn.Module):
+    """GEGLU feed-forward (`transformer.py:108-124`)."""
+
+    dim: int
+    mult: float = 4.0
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        hidden = int(self.dim * self.mult)
+        x = nn.Dense(hidden * 2, dtype=self.dtype)(x)
+        x, gates = jnp.split(x, 2, axis=-1)
+        x = x * nn.gelu(gates)
+        x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        return nn.Dense(self.dim, dtype=self.dtype)(x)
+
+
+def _build_static_mask(
+    attn_type: str,
+    seq_len: int,
+    image_fmap_size: Optional[int],
+    layer_ind: int,
+    sparse_block: int = 16,
+    sparse_text_len: Optional[int] = None,
+) -> Optional[np.ndarray]:
+    if attn_type == "full":
+        return None
+    assert image_fmap_size is not None, f"attn_type {attn_type} needs image_fmap_size"
+    if attn_type == "axial_row":
+        return axial_static_mask(seq_len, image_fmap_size, axis=0)
+    if attn_type == "axial_col":
+        return axial_static_mask(seq_len, image_fmap_size, axis=1)
+    if attn_type == "conv_like":
+        return conv_like_mask(seq_len, image_fmap_size)
+    if attn_type == "sparse":
+        # VariableSparsityConfig semantics (`attention.py:349-365`): block 16,
+        # seq//block//4 random blocks, text blocks global. Padded to a block
+        # multiple; layer index seeds the random blocks so layers differ.
+        padded = sparse_block * math.ceil((seq_len + 1) / sparse_block)
+        text_len = sparse_text_len if sparse_text_len is not None else (
+            seq_len + 1 - image_fmap_size**2
+        )
+        layout = block_sparse_layout(
+            padded,
+            block=sparse_block,
+            num_random_blocks=max(padded // sparse_block // 4, 1),
+            global_block_indices=tuple(range(math.ceil(text_len / sparse_block))),
+            causal=True,
+            seed=layer_ind,
+        )
+        return block_layout_to_token_mask(layout, sparse_block, causal=True)
+    raise ValueError(f'attention type "{attn_type}" is not valid')
+
+
+class Transformer(nn.Module):
+    """Causal (or bidirectional) transformer stack with DALL-E features."""
+
+    dim: int
+    depth: int
+    seq_len: int
+    causal: bool = True
+    heads: int = 8
+    dim_head: int = 64
+    ff_mult: float = 4.0
+    attn_dropout: float = 0.0
+    ff_dropout: float = 0.0
+    attn_types: Optional[Sequence[str]] = None
+    image_fmap_size: Optional[int] = None
+    sparse_attn: bool = False  # accepted for reference-parity; unused there too
+    stable: bool = False
+    sandwich_norm: bool = False
+    shift_tokens: bool = False
+    rotary_emb: bool = True
+    shared_attn_ids: Optional[Sequence[int]] = None
+    shared_ff_ids: Optional[Sequence[int]] = None
+    reversible: bool = False
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        depth = self.depth
+        attn_types = tuple(self.attn_types) if self.attn_types else ("full",)
+        type_per_layer = list(islice(cycle(attn_types), depth))
+        attn_ids = list(islice(cycle(self.shared_attn_ids or range(depth)), depth))
+        ff_ids = list(islice(cycle(self.shared_ff_ids or range(depth)), depth))
+
+        shared_attn, shared_attn_type = {}, {}
+        shared_ff = {}
+        attn_layers, ff_layers = [], []
+        for ind in range(depth):
+            attn_type, attn_id, ff_id = type_per_layer[ind], attn_ids[ind], ff_ids[ind]
+            if attn_id in shared_attn:
+                if shared_attn_type[attn_id] != attn_type:
+                    raise ValueError(
+                        "attn_types do not match shared_attn_ids "
+                        f"(ind = {ind}, attn_type = {attn_type!r}, "
+                        f"reused_attn_type = {shared_attn_type[attn_id]!r})"
+                    )
+                attn = shared_attn[attn_id]
+            else:
+                attn = Attention(
+                    dim=self.dim,
+                    seq_len=self.seq_len,
+                    heads=self.heads,
+                    dim_head=self.dim_head,
+                    causal=self.causal,
+                    dropout=self.attn_dropout,
+                    stable=self.stable,
+                    static_mask=_build_static_mask(
+                        attn_type, self.seq_len, self.image_fmap_size, ind
+                    ),
+                    dtype=self.dtype,
+                    name=f"attn_{attn_id}",
+                )
+                shared_attn[attn_id] = attn
+                shared_attn_type[attn_id] = attn_type
+            attn_layers.append(attn)
+
+            if ff_id in shared_ff:
+                ff = shared_ff[ff_id]
+            else:
+                ff = FeedForward(
+                    dim=self.dim,
+                    mult=self.ff_mult,
+                    dropout=self.ff_dropout,
+                    dtype=self.dtype,
+                    name=f"ff_{ff_id}",
+                )
+                shared_ff[ff_id] = ff
+            ff_layers.append(ff)
+
+        self.attn_layers = attn_layers
+        self.ff_layers = ff_layers
+        self.attn_norms = [nn.LayerNorm(dtype=self.dtype) for _ in range(depth)]
+        self.ff_norms = [nn.LayerNorm(dtype=self.dtype) for _ in range(depth)]
+        if self.sandwich_norm:
+            self.attn_norms_out = [nn.LayerNorm(dtype=self.dtype) for _ in range(depth)]
+            self.ff_norms_out = [nn.LayerNorm(dtype=self.dtype) for _ in range(depth)]
+        self.attn_scales = [
+            self.param(
+                f"attn_scale_{i}",
+                lambda key, shape, i=i: jnp.full(shape, layerscale_init(i + 1)),
+                (1, 1, self.dim),
+            )
+            for i in range(depth)
+        ]
+        self.ff_scales = [
+            self.param(
+                f"ff_scale_{i}",
+                lambda key, shape, i=i: jnp.full(shape, layerscale_init(i + 1)),
+                (1, 1, self.dim),
+            )
+            for i in range(depth)
+        ]
+
+        if self.rotary_emb:
+            assert self.image_fmap_size is not None
+            text_len = self.seq_len - self.image_fmap_size**2 + 1
+            self.rotary_table = build_dalle_rotary(
+                text_len, self.image_fmap_size, self.dim_head
+            )
+        else:
+            self.rotary_table = None
+
+        self.text_len = (
+            self.seq_len - self.image_fmap_size**2 + 1
+            if self.image_fmap_size is not None
+            else self.seq_len
+        )
+
+    def _layer(
+        self,
+        i: int,
+        x: jnp.ndarray,
+        key_mask,
+        cache,
+        deterministic: bool,
+    ):
+        """One (attn, ff) residual pair; returns (x, updated layer cache)."""
+        new_cache = {}
+        h = self.attn_norms[i](x)
+        if self.shift_tokens:
+            assert self.image_fmap_size is not None
+            if cache is not None:
+                raise NotImplementedError(
+                    "cached decode with token-shift needs the ring-buffer "
+                    "shift state (not yet wired); use the uncached "
+                    "generate_images path"
+                )
+            h = shift_tokens_dalle(h, self.text_len, self.image_fmap_size)
+        h, attn_cache = self.attn_layers[i](
+            h,
+            key_mask=key_mask,
+            rotary=self.rotary_table,
+            cache=None if cache is None else cache[f"attn_{i}"],
+            deterministic=deterministic,
+        )
+        if self.sandwich_norm:
+            h = self.attn_norms_out[i](h)
+        x = x + h * self.attn_scales[i].astype(h.dtype)
+        if attn_cache is not None:
+            new_cache[f"attn_{i}"] = attn_cache
+
+        h = self.ff_norms[i](x)
+        if self.shift_tokens:
+            h = shift_tokens_dalle(h, self.text_len, self.image_fmap_size)
+        h = self.ff_layers[i](h, deterministic=deterministic)
+        if self.sandwich_norm:
+            h = self.ff_norms_out[i](h)
+        x = x + h * self.ff_scales[i].astype(h.dtype)
+        return x, (new_cache or None)
+
+    def __call__(
+        self,
+        x: jnp.ndarray,
+        key_mask: Optional[jnp.ndarray] = None,
+        reverse_model: bool = False,
+        cache: Optional[dict] = None,
+        deterministic: bool = True,
+    ):
+        order = range(self.depth - 1, -1, -1) if reverse_model else range(self.depth)
+        new_cache = {} if cache is not None else None
+        for i in order:
+            if self.reversible and cache is None:
+                # activation rematerialization: recompute the layer in the
+                # backward pass instead of saving activations — the memory
+                # behavior the reference's ReversibleSequence buys
+                # (`reversible.py:57-127`), via flax's lifted remat.
+                def layer_fn(mdl, y, i=i):
+                    return mdl._layer(i, y, key_mask, None, deterministic)[0]
+
+                x = nn.remat(layer_fn)(self, x)
+            else:
+                x, layer_cache = self._layer(i, x, key_mask, cache, deterministic)
+                if layer_cache:
+                    new_cache.update(layer_cache)
+        if cache is not None:
+            return x, new_cache
+        return x
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32) -> dict:
+        """Fixed-shape KV cache pytree for autoregressive decoding."""
+        cache = {}
+        for i in range(self.depth):
+            cache[f"attn_{i}"] = {
+                "k": jnp.zeros((batch, self.heads, max_len, self.dim_head), dtype),
+                "v": jnp.zeros((batch, self.heads, max_len, self.dim_head), dtype),
+                "index": jnp.zeros((), jnp.int32),
+            }
+        return cache

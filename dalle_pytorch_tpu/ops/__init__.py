@@ -1,0 +1,20 @@
+from dalle_pytorch_tpu.ops.rotary import (
+    build_dalle_rotary,
+    apply_rotary,
+    rotary_freqs_lang,
+    rotary_freqs_pixel,
+)
+from dalle_pytorch_tpu.ops.gumbel import gumbel_softmax
+from dalle_pytorch_tpu.ops.sampling import top_k_filter, gumbel_sample
+from dalle_pytorch_tpu.ops.masks import (
+    causal_mask,
+    axial_static_mask,
+    conv_like_mask,
+    block_sparse_layout,
+    block_layout_to_token_mask,
+)
+from dalle_pytorch_tpu.ops.shift import shift_tokens_dalle
+from dalle_pytorch_tpu.ops.attention_core import (
+    stable_softmax,
+    dense_attention,
+)

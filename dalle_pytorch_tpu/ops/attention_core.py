@@ -1,0 +1,49 @@
+"""Dense attention core: one fused MXU-friendly path for every mask pattern.
+
+The reference ships four attention kernels (full / conv-like / axial /
+DeepSpeed block-sparse, `/root/reference/dalle_pytorch/attention.py`). On
+TPU the idiomatic design is a *single* dense attention einsum with a static
+boolean mask (XLA fuses mask + softmax into the matmul epilogue), with a
+Pallas flash kernel as the long-sequence fast path. Scores accumulate in
+fp32 regardless of the bf16 compute dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = float(jnp.finfo(jnp.float32).max) * -1.0
+
+
+def stable_softmax(t: jnp.ndarray, axis: int = -1, alpha: float = 32.0**2) -> jnp.ndarray:
+    """fp16/bf16-stable softmax: pre-divide by alpha before the max-subtract.
+
+    Matches `stable_softmax` (`attention.py:27-30`).
+    """
+    t = t / alpha
+    t = t - lax.stop_gradient(jnp.max(t, axis=axis, keepdims=True))
+    return jax.nn.softmax(t * alpha, axis=axis)
+
+
+def dense_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    stable: bool = False,
+) -> jnp.ndarray:
+    """Scaled dot-product attention. q,k,v: [..., n, d]; mask True=attend."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "...id,...jd->...ij", q * scale, k, preferred_element_type=jnp.float32
+    )
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    if stable:
+        attn = stable_softmax(scores, axis=-1)
+    else:
+        attn = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("...ij,...jd->...id", attn.astype(v.dtype), v)
+    return out

@@ -1,0 +1,52 @@
+"""Gumbel-softmax relaxation with straight-through and ReinMax estimators.
+
+Functional equivalents of the sampling used by the reference DiscreteVAE
+(`/root/reference/dalle_pytorch/dalle_pytorch.py:236-246`): soft/hard
+gumbel-softmax over the codebook axis, plus the ReinMax second-order
+straight-through correction (https://arxiv.org/abs/2304.08612, alg. 2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _log(t: jnp.ndarray, eps: float = 1e-20) -> jnp.ndarray:
+    return jnp.log(jnp.maximum(t, eps))
+
+
+def gumbel_softmax(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    tau: float = 1.0,
+    hard: bool = False,
+    reinmax: bool = False,
+    axis: int = -1,
+) -> jnp.ndarray:
+    """Sample from the Gumbel-softmax distribution over `axis`.
+
+    hard=False  -> soft relaxed one-hot.
+    hard=True   -> exact one-hot forward, straight-through gradient.
+    reinmax=True (with hard) -> ReinMax second-order gradient correction.
+    """
+    g = jax.random.gumbel(rng, logits.shape, dtype=logits.dtype)
+    y_soft = jax.nn.softmax((logits + g) / tau, axis=axis)
+
+    if not hard:
+        return y_soft
+
+    index = jnp.argmax(y_soft, axis=axis)
+    one_hot = jax.nn.one_hot(index, logits.shape[axis], dtype=logits.dtype, axis=axis)
+
+    if not reinmax:
+        # classic straight-through
+        return one_hot + y_soft - lax.stop_gradient(y_soft)
+
+    # ReinMax algorithm 2
+    pi0 = jax.nn.softmax(logits, axis=axis)
+    pi1 = (one_hot + jax.nn.softmax(logits / tau, axis=axis)) / 2.0
+    pi1 = jax.nn.softmax(lax.stop_gradient(_log(pi1) - logits) + logits, axis=axis)
+    pi2 = 2.0 * pi1 - 0.5 * pi0
+    return pi2 - lax.stop_gradient(pi2) + one_hot

@@ -1,0 +1,34 @@
+"""Token sampling helpers for autoregressive decoding.
+
+Functional equivalents of the reference's sampling utilities
+(`/root/reference/dalle_pytorch/dalle_pytorch.py:55-71`): top-k filtering
+keyed by a *fraction* threshold and gumbel-max sampling. Implemented with
+`lax.top_k` + threshold comparison so shapes stay static under jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def top_k_filter(logits: jnp.ndarray, thres: float = 0.5) -> jnp.ndarray:
+    """Keep the top max(int((1-thres)*V), 1) logits; set the rest to -inf.
+
+    Matches the reference's `top_k(logits, thres)` semantics where `thres`
+    is the fraction of the vocabulary to drop (default 0.5; generation CLI
+    uses 0.9).
+    """
+    num_logits = logits.shape[-1]
+    k = max(int((1.0 - thres) * num_logits), 1)
+    kth = lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def gumbel_sample(
+    rng: jax.Array, logits: jnp.ndarray, temperature: float = 1.0
+) -> jnp.ndarray:
+    """Sample token ids via the gumbel-max trick: argmax(logits/T + G)."""
+    g = jax.random.gumbel(rng, logits.shape, dtype=jnp.float32)
+    return jnp.argmax(logits.astype(jnp.float32) / temperature + g, axis=-1)

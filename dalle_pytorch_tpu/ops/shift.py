@@ -1,0 +1,52 @@
+"""Token-shift for the joint text+image sequence.
+
+Functional re-derivation of the reference's `PreShiftToken`
+(`/root/reference/dalle_pytorch/transformer.py:128-202`): before attention
+and feed-forward, part of each token's channels are replaced by channels of
+a *previous* token — a cheap locality prior.
+
+  * text positions: the first half of the channels is shifted one position
+    to the right (channel content comes from the token to the left);
+  * image positions (viewed as an H x W grid): the first quarter comes from
+    the token one row up, the second quarter from the token one column left,
+    and the remaining half passes through.
+
+Pure function of a fixed-shape [B, N, D] array — jit/scan friendly; the
+reference's deque-based streaming variant is replaced by a ring-buffer cache
+in the decode loop (see models/transformer.py cached path).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def shift_tokens_dalle(x: jnp.ndarray, text_len: int, image_fmap_size: int) -> jnp.ndarray:
+    """Apply DALL-E token-shift. x: [B, N, D]; text_len counts <bos>."""
+    b, n, d = x.shape
+    assert d % 4 == 0, "model dim must be divisible by 4 for token shift"
+    img_seq_len = image_fmap_size * image_fmap_size
+
+    if n < text_len:  # static shape check: no image tokens present
+        half = d // 2
+        x_shift = jnp.pad(x[:, :-1, :half], ((0, 0), (1, 0), (0, 0)))
+        return jnp.concatenate([x_shift, x[..., half:]], axis=-1)
+
+    x_text, x_img = x[:, :text_len], x[:, text_len:]
+
+    half = d // 2
+    t_shift = jnp.pad(x_text[:, :-1, :half], ((0, 0), (1, 0), (0, 0)))
+    x_text = jnp.concatenate([t_shift, x_text[..., half:]], axis=-1)
+
+    img_len = x_img.shape[1]
+    pad_rows = img_seq_len - img_len
+    x_img = jnp.pad(x_img, ((0, 0), (0, pad_rows), (0, 0)))
+    x_img = x_img.reshape(b, image_fmap_size, image_fmap_size, d)
+
+    q = d // 4
+    top = jnp.pad(x_img[:, :-1, :, :q], ((0, 0), (1, 0), (0, 0), (0, 0)))
+    left = jnp.pad(x_img[:, :, :-1, q : 2 * q], ((0, 0), (0, 0), (1, 0), (0, 0)))
+    x_img = jnp.concatenate([top, left, x_img[..., 2 * q :]], axis=-1)
+
+    x_img = x_img.reshape(b, img_seq_len, d)[:, :img_len]
+    return jnp.concatenate([x_text, x_img], axis=1)

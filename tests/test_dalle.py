@@ -1,0 +1,201 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dalle_pytorch_tpu.models.dalle import (
+    DALLE,
+    generate_images,
+    generate_texts,
+    forward_with_cond_scale,
+)
+
+TEXT_SEQ = 6
+FMAP = 3
+IMG_SEQ = FMAP * FMAP
+NUM_TEXT = 20
+NUM_IMG = 16
+
+
+def make_dalle(**kw):
+    defaults = dict(
+        dim=32,
+        depth=2,
+        num_image_tokens=NUM_IMG,
+        image_fmap_size=FMAP,
+        num_text_tokens=NUM_TEXT,
+        text_seq_len=TEXT_SEQ,
+        heads=2,
+        dim_head=8,
+        shift_tokens=False,
+        rotary_emb=True,
+    )
+    defaults.update(kw)
+    return DALLE(**defaults)
+
+
+@pytest.fixture
+def batch():
+    rng = jax.random.PRNGKey(0)
+    text = jax.random.randint(rng, (2, TEXT_SEQ), 1, NUM_TEXT)
+    text = text.at[:, -2:].set(0)  # trailing padding
+    image = jax.random.randint(jax.random.PRNGKey(1), (2, IMG_SEQ), 0, NUM_IMG)
+    return text, image
+
+
+def init_vars(model, text, image):
+    return model.init(jax.random.PRNGKey(42), text, image)
+
+
+class TestDALLEForward:
+    def test_logits_shape_and_mask(self, batch):
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+        logits = model.apply(variables, text, image)
+        total_seq = TEXT_SEQ + IMG_SEQ
+        total_tokens = NUM_TEXT + TEXT_SEQ + NUM_IMG
+        assert logits.shape == (2, total_seq, total_tokens)
+
+        arr = np.asarray(logits)
+        text_vocab = NUM_TEXT + TEXT_SEQ
+        # text positions may only produce text tokens
+        assert (arr[:, : TEXT_SEQ, text_vocab:] < -1e30).all()
+        assert np.isfinite(arr[:, : TEXT_SEQ, :text_vocab]).all()
+        # image positions may only produce image tokens
+        assert (arr[:, TEXT_SEQ:, :text_vocab] < -1e30).all()
+        assert np.isfinite(arr[:, TEXT_SEQ:, text_vocab:]).all()
+
+    def test_inverse_mask_rotated(self, batch):
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+        logits = model.apply(variables, text, image, inverse_mapping=True)
+        arr = np.asarray(logits)
+        text_vocab = NUM_TEXT + TEXT_SEQ
+        # image occupies the FRONT of the sequence in inverse mode
+        assert (arr[:, :IMG_SEQ, :text_vocab] < -1e30).all()
+        assert (arr[:, IMG_SEQ:, text_vocab:] < -1e30).all()
+
+    def test_loss_modes(self, batch):
+        """forward / forward_forward / forward_reverse_partial objectives."""
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+
+        loss, acc = model.apply(variables, text, image, return_loss=True)
+        assert np.isfinite(float(loss)) and acc is None
+
+        inv_loss, inv_acc = model.apply(
+            variables, text, image, return_loss=True, inverse_mapping=True
+        )
+        assert np.isfinite(float(inv_loss))
+        assert 0.0 <= float(inv_acc) <= 1.0
+
+        rev_loss, _ = model.apply(
+            variables, text, image, return_loss=True,
+            inverse_mapping=True, reverse_model=True,
+        )
+        assert np.isfinite(float(rev_loss))
+        assert float(rev_loss) != float(inv_loss)
+
+    def test_grads_flow(self, batch):
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+
+        def loss_fn(params):
+            loss, _ = model.apply({"params": params}, text, image, return_loss=True)
+            return loss
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        total = sum(float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads))
+        assert np.isfinite(total) and total > 0
+
+    def test_unique_pad_tokens_distinguish_positions(self, batch):
+        """Zero-padding at different positions embeds differently (`:606-609`)."""
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+        t1 = jnp.zeros((1, TEXT_SEQ), jnp.int32).at[0, 0].set(5)
+        t2 = jnp.zeros((1, TEXT_SEQ), jnp.int32).at[0, 1].set(5)
+        l1 = model.apply(variables, t1, image[:1])
+        l2 = model.apply(variables, t2, image[:1])
+        assert not np.allclose(np.asarray(l1), np.asarray(l2))
+
+    def test_feature_flag_matrix(self, batch):
+        text, image = batch
+        for kw in (
+            {"stable": True},
+            {"sandwich_norm": True},
+            {"shift_tokens": True},
+            {"rotary_emb": False},
+            {"share_input_output_emb": True},
+            {"attn_types": ("full", "axial_row")},
+            {"reversible": True},
+        ):
+            model = make_dalle(**kw)
+            variables = init_vars(model, text, image)
+            loss, _ = model.apply(variables, text, image, return_loss=True)
+            assert np.isfinite(float(loss)), kw
+
+    def test_null_cond_prob_drops_text(self, batch):
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+        l_cond = model.apply(variables, text, image)
+        l_null = model.apply(
+            variables, text, image, null_cond_prob=1.0,
+            rngs={"null_cond": jax.random.PRNGKey(0)},
+        )
+        assert not np.allclose(np.asarray(l_cond), np.asarray(l_null))
+        # null-conditioning equals passing all-padding text
+        l_zeros = model.apply(variables, jnp.zeros_like(text), image)
+        np.testing.assert_allclose(np.asarray(l_null), np.asarray(l_zeros), atol=1e-5)
+
+
+class TestGeneration:
+    def test_generate_images_tokens_in_range(self, batch):
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+        toks = generate_images(
+            model, variables, jax.random.PRNGKey(0), text, filter_thres=0.9
+        )
+        assert toks.shape == (2, IMG_SEQ)
+        arr = np.asarray(toks)
+        assert (arr >= 0).all() and (arr < NUM_IMG).all()
+
+    def test_generate_with_priming(self, batch):
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+        toks = generate_images(
+            model,
+            variables,
+            jax.random.PRNGKey(0),
+            text,
+            init_image_tokens=image,
+            num_init_img_tokens=4,
+        )
+        np.testing.assert_array_equal(np.asarray(toks[:, :4]), np.asarray(image[:, :4]))
+
+    def test_cond_scale_two_forward_blend(self, batch):
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+        l1 = forward_with_cond_scale(model, variables, text, image, cond_scale=1.0)
+        l3 = forward_with_cond_scale(model, variables, text, image, cond_scale=3.0)
+        assert not np.allclose(np.asarray(l1), np.asarray(l3))
+
+    def test_generate_texts(self, batch):
+        model = make_dalle()
+        text, image = batch
+        variables = init_vars(model, text, image)
+        out = generate_texts(
+            model, variables, jax.random.PRNGKey(0), text, prefix_len=2
+        )
+        assert out.shape == (2, TEXT_SEQ)
+        np.testing.assert_array_equal(np.asarray(out[:, :2]), np.asarray(text[:, :2]))
+        arr = np.asarray(out)
+        assert (arr >= 0).all() and (arr < NUM_TEXT + TEXT_SEQ).all()

@@ -1,0 +1,194 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dalle_pytorch_tpu.ops.rotary import build_dalle_rotary, apply_rotary
+from dalle_pytorch_tpu.ops.gumbel import gumbel_softmax
+from dalle_pytorch_tpu.ops.sampling import top_k_filter, gumbel_sample
+from dalle_pytorch_tpu.ops.masks import (
+    causal_mask,
+    axial_static_mask,
+    conv_like_mask,
+    block_sparse_layout,
+    block_layout_to_token_mask,
+)
+from dalle_pytorch_tpu.ops.shift import shift_tokens_dalle
+from dalle_pytorch_tpu.ops.attention_core import dense_attention, stable_softmax
+
+
+class TestRotary:
+    def test_shape_and_rotation_norm(self):
+        dim_head = 64
+        fmap = 4
+        text_len = 9  # 8 text + bos
+        table = build_dalle_rotary(text_len, fmap, dim_head)
+        rot_dim = dim_head // 3
+        per_block = 2 * (rot_dim // 2)
+        assert table.shape == (text_len + fmap * fmap, 3 * per_block)
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, table.shape[0], dim_head))
+        y = apply_rotary(table[None, None], x)
+        assert y.shape == x.shape
+        # rotation preserves the norm of the rotated channel block
+        d = table.shape[-1]
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(x[..., :d]), axis=-1),
+            np.linalg.norm(np.asarray(y[..., :d]), axis=-1),
+            rtol=1e-5,
+        )
+        # pass-through channels untouched
+        np.testing.assert_array_equal(np.asarray(x[..., d:]), np.asarray(y[..., d:]))
+
+    def test_text_image_sentinels_differ(self):
+        dim_head = 48
+        per_block = 2 * ((dim_head // 3) // 2)
+        table = np.asarray(build_dalle_rotary(5, 4, dim_head))
+        # all image rows share the same text-block angles (sentinel 8192)
+        text_block = table[5:, :per_block]
+        assert np.allclose(text_block, text_block[0])
+        # text rows share the same axial-block angles (sentinel -10)
+        axial_block = table[:5, per_block:]
+        assert np.allclose(axial_block, axial_block[0])
+
+
+class TestGumbel:
+    def test_soft_sums_to_one(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (3, 5, 7))
+        y = gumbel_softmax(jax.random.PRNGKey(1), logits, tau=0.5, hard=False)
+        np.testing.assert_allclose(np.asarray(y.sum(-1)), 1.0, rtol=1e-5)
+
+    @pytest.mark.parametrize("reinmax", [False, True])
+    def test_hard_is_one_hot_with_grads(self, reinmax):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (4, 8))
+
+        def f(l):
+            y = gumbel_softmax(
+                jax.random.PRNGKey(1), l, tau=0.9, hard=True, reinmax=reinmax
+            )
+            return (y * jnp.arange(8)).sum(), y
+
+        (val, y), grad = jax.value_and_grad(f, has_aux=True)(logits)
+        assert np.allclose(np.sort(np.asarray(y), axis=-1)[:, :-1], 0)
+        assert np.allclose(np.asarray(y).sum(-1), 1.0)
+        assert np.abs(np.asarray(grad)).sum() > 0  # straight-through grads flow
+
+
+class TestSampling:
+    def test_top_k_filter(self):
+        logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0, 0.0, -1.0, 2.5, 0.5, 1.5]])
+        out = np.asarray(top_k_filter(logits, thres=0.75))  # keep top 2
+        kept = np.isfinite(out[0])
+        assert kept.sum() == 2
+        assert kept[1] and kept[4]
+
+    def test_gumbel_sample_zero_temp_is_argmax_like(self):
+        logits = jnp.asarray([[0.0, 100.0, 0.0]])
+        s = gumbel_sample(jax.random.PRNGKey(0), logits, temperature=1.0)
+        assert int(s[0]) == 1
+
+
+class TestMasks:
+    def test_axial_row_matches_bruteforce(self):
+        fmap, seq_len = 4, 19  # text_len = 4
+        m = axial_static_mask(seq_len, fmap, axis=0)
+        text_len = seq_len + 1 - fmap * fmap
+        assert m[:, :text_len].all()
+        for qi in range(fmap * fmap):
+            for ki in range(fmap * fmap):
+                same_row = qi // fmap == ki // fmap
+                assert m[text_len + qi, text_len + ki] == same_row
+
+    def test_axial_col(self):
+        fmap, seq_len = 4, 19
+        m = axial_static_mask(seq_len, fmap, axis=1)
+        text_len = seq_len + 1 - fmap * fmap
+        for qi in range(fmap * fmap):
+            for ki in range(fmap * fmap):
+                same_col = qi % fmap == ki % fmap
+                assert m[text_len + qi, text_len + ki] == same_col
+
+    def test_conv_like_neighborhood(self):
+        fmap, seq_len, k = 4, 19, 3
+        m = conv_like_mask(seq_len, fmap, kernel_size=k)
+        text_len = seq_len + 1 - fmap * fmap
+        # query at (2, 2): rows 0..2, cols 0..2 reachable (sp=1, window r-2..r)
+        q = text_len + 2 * fmap + 2
+        allowed = {
+            (r, c)
+            for r in range(0, 3)
+            for c in range(0, 3)
+        }
+        for r in range(fmap):
+            for c in range(fmap):
+                assert m[q, text_len + r * fmap + c] == ((r, c) in allowed)
+
+    def test_block_sparse_causal_and_global(self):
+        layout = block_sparse_layout(
+            64, block=8, num_local_blocks=2, num_random_blocks=1,
+            global_block_indices=(0,), causal=True, seed=0,
+        )
+        assert layout.shape == (8, 8)
+        assert not np.triu(layout, 1).any()  # causal at block level
+        assert layout[:, 0].all()  # global text block
+        assert np.diagonal(layout).all()  # local includes self
+        token = block_layout_to_token_mask(layout, 8)
+        assert not np.triu(token, 1).any()
+
+    def test_masks_are_causal(self):
+        fmap, seq_len = 4, 19
+        c = causal_mask(seq_len + 1)
+        for m in (
+            axial_static_mask(seq_len, fmap, 0) & c,
+            conv_like_mask(seq_len, fmap),
+        ):
+            assert not np.triu(m, 1).any()
+
+
+class TestShift:
+    def test_shift_semantics(self):
+        b, d, fmap = 2, 8, 3
+        text_len, img_len = 4, 9
+        n = text_len + img_len
+        x = jax.random.normal(jax.random.PRNGKey(0), (b, n, d))
+        y = shift_tokens_dalle(x, text_len, fmap)
+        x, y = np.asarray(x), np.asarray(y)
+        half, q = d // 2, d // 4
+        # text: first position's shifted half is zeros
+        assert np.allclose(y[:, 0, :half], 0)
+        np.testing.assert_allclose(y[:, 1:text_len, :half], x[:, : text_len - 1, :half])
+        np.testing.assert_allclose(y[:, :text_len, half:], x[:, :text_len, half:])
+        # image grid: first quarter from one row up, second from one col left
+        for r in range(fmap):
+            for c in range(fmap):
+                i = text_len + r * fmap + c
+                if r == 0:
+                    assert np.allclose(y[:, i, :q], 0)
+                else:
+                    np.testing.assert_allclose(y[:, i, :q], x[:, i - fmap, :q])
+                if c == 0:
+                    assert np.allclose(y[:, i, q : 2 * q], 0)
+                else:
+                    np.testing.assert_allclose(y[:, i, q : 2 * q], x[:, i - 1, q : 2 * q])
+                np.testing.assert_allclose(y[:, i, 2 * q :], x[:, i, 2 * q :])
+
+
+class TestAttentionCore:
+    def test_matches_naive_softmax_attention(self):
+        rng = jax.random.PRNGKey(0)
+        q, k, v = jax.random.normal(rng, (3, 2, 4, 6, 8))
+        mask = jnp.asarray(np.tril(np.ones((6, 6), bool)))[None, None]
+        out = dense_attention(q, k, v, mask=mask)
+
+        scores = np.einsum("bhid,bhjd->bhij", np.asarray(q), np.asarray(k)) / np.sqrt(8)
+        scores = np.where(np.asarray(mask), scores, -1e30)
+        w = np.exp(scores - scores.max(-1, keepdims=True))
+        w /= w.sum(-1, keepdims=True)
+        expected = np.einsum("bhij,bhjd->bhid", w, np.asarray(v))
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4, atol=1e-5)
+
+    def test_stable_softmax_equals_softmax(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 9)) * 10
+        np.testing.assert_allclose(
+            np.asarray(stable_softmax(x)), np.asarray(jax.nn.softmax(x)), rtol=1e-5
+        )

@@ -1,0 +1,132 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dalle_pytorch_tpu.models.transformer import Transformer
+
+FMAP = 3
+SEQ = 4 + FMAP * FMAP - 1  # text_len (incl bos) = 4+1... seq = text+img tokens
+
+
+def make_transformer(**kw):
+    defaults = dict(
+        dim=32,
+        depth=2,
+        seq_len=SEQ,
+        heads=2,
+        dim_head=8,
+        image_fmap_size=FMAP,
+        rotary_emb=True,
+    )
+    defaults.update(kw)
+    return Transformer(**defaults)
+
+
+def init_and_run(tfm, n=SEQ, **call_kw):
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, n, 32))
+    variables = tfm.init(jax.random.PRNGKey(1), x)
+    return variables, tfm.apply(variables, x, **call_kw), x
+
+
+class TestTransformer:
+    @pytest.mark.parametrize(
+        "attn_types",
+        [("full",), ("axial_row", "axial_col"), ("conv_like",), ("sparse",)],
+    )
+    def test_forward_shapes(self, attn_types):
+        tfm = make_transformer(attn_types=attn_types)
+        _, out, x = init_and_run(tfm)
+        assert out.shape == x.shape
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {"shift_tokens": True},
+            {"sandwich_norm": True},
+            {"stable": True},
+            {"rotary_emb": False},
+            {"reversible": True},
+        ],
+    )
+    def test_feature_flags(self, kw):
+        tfm = make_transformer(**kw)
+        _, out, x = init_and_run(tfm)
+        assert out.shape == x.shape
+
+    @pytest.mark.parametrize(
+        "attn_types", [("full",), ("axial_row", "axial_col"), ("conv_like",), ("sparse",)]
+    )
+    def test_causality(self, attn_types):
+        """Perturbing position j must not change outputs at positions < j."""
+        tfm = make_transformer(attn_types=attn_types, shift_tokens=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, SEQ, 32))
+        variables = tfm.init(jax.random.PRNGKey(1), x)
+        out1 = tfm.apply(variables, x)
+        j = SEQ - 3
+        x2 = x.at[:, j].add(10.0)
+        out2 = tfm.apply(variables, x2)
+        np.testing.assert_allclose(
+            np.asarray(out1[:, :j]), np.asarray(out2[:, :j]), atol=1e-5
+        )
+        assert not np.allclose(np.asarray(out1[:, j:]), np.asarray(out2[:, j:]))
+
+    def test_shared_ids_reduce_params(self):
+        full = make_transformer(depth=4)
+        shared = make_transformer(depth=4, shared_attn_ids=(0, 1, 0, 1), shared_ff_ids=(0, 0, 0, 0))
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, SEQ, 32))
+        n_full = sum(g.size for g in jax.tree.leaves(full.init(jax.random.PRNGKey(1), x)))
+        n_shared = sum(
+            g.size for g in jax.tree.leaves(shared.init(jax.random.PRNGKey(1), x))
+        )
+        assert n_shared < n_full
+
+    def test_shared_ids_type_mismatch_raises(self):
+        tfm = make_transformer(
+            depth=2, attn_types=("full", "axial_row"), shared_attn_ids=(0, 0)
+        )
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, SEQ, 32))
+        with pytest.raises(ValueError, match="shared_attn_ids"):
+            tfm.init(jax.random.PRNGKey(1), x)
+
+    def test_reverse_model_changes_output(self):
+        tfm = make_transformer(depth=3)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, SEQ, 32))
+        variables = tfm.init(jax.random.PRNGKey(1), x)
+        out_fwd = tfm.apply(variables, x)
+        out_rev = tfm.apply(variables, x, reverse_model=True)
+        assert not np.allclose(np.asarray(out_fwd), np.asarray(out_rev))
+
+    def test_reversible_matches_grads_structure(self):
+        """remat-reversible must compute identical outputs to plain mode."""
+        tfm_plain = make_transformer(depth=2)
+        tfm_rev = make_transformer(depth=2, reversible=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, SEQ, 32))
+        variables = tfm_plain.init(jax.random.PRNGKey(1), x)
+
+        out_plain = tfm_plain.apply(variables, x)
+        out_rev = tfm_rev.apply(variables, x)
+        np.testing.assert_allclose(np.asarray(out_plain), np.asarray(out_rev), atol=1e-6)
+
+        g1 = jax.grad(lambda p: (tfm_plain.apply({"params": p}, x) ** 2).sum())(
+            variables["params"]
+        )
+        g2 = jax.grad(lambda p: (tfm_rev.apply({"params": p}, x) ** 2).sum())(
+            variables["params"]
+        )
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    def test_noncausal_key_mask(self):
+        tfm = make_transformer(causal=False, rotary_emb=False, image_fmap_size=None)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, SEQ, 32))
+        variables = tfm.init(jax.random.PRNGKey(1), x)
+        mask = jnp.ones((2, SEQ), dtype=bool).at[:, -3:].set(False)
+        out = tfm.apply(variables, x, key_mask=mask)
+        # changing masked-out keys must not affect any output
+        x2 = x.at[:, -1].add(100.0)
+        out2 = tfm.apply(variables, x2, key_mask=mask)
+        np.testing.assert_allclose(
+            np.asarray(out[:, :-3]), np.asarray(out2[:, :-3]), atol=1e-5
+        )
