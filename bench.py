@@ -1,0 +1,117 @@
+"""Benchmark: DALLE training throughput (image-tokens/sec/chip) + MFU.
+
+Runs the flagship train step (dim 1024 / depth 12 / 256 text + 256 image
+tokens, bf16 compute) on the available accelerator and prints ONE JSON
+line. The reference publishes no numbers (BASELINE.md) — its only runtime
+metric is `sample_per_sec` (`/root/reference/train_dalle.py:578-581`) — so
+`vs_baseline` is reported against the ≥45%-MFU design target from
+BASELINE.json (value 1.0 == exactly hitting the target scaled to this
+chip count).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+# published bf16 peak FLOP/s per chip
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5": 459e12,  # v5p
+    "v6": 918e12,
+    "cpu": 5e11,  # nominal, so CPU runs still report something
+}
+
+
+def peak_flops_per_chip() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def transformer_train_flops(dim, depth, heads, dim_head, seq, ff_mult=4) -> float:
+    """Analytic fwd+bwd matmul FLOPs per sample for one step."""
+    inner = heads * dim_head
+    per_layer = (
+        2 * seq * dim * 3 * inner          # qkv proj
+        + 2 * seq * seq * inner * 2        # qk^T and attn@v
+        + 2 * seq * inner * dim            # out proj
+        + 2 * seq * dim * dim * ff_mult * 2  # ff up (GEGLU: 2x width)
+        + 2 * seq * dim * ff_mult * dim    # ff down
+    )
+    fwd = depth * per_layer
+    return 3 * fwd  # fwd + 2x bwd
+
+
+def main():
+    from dalle_pytorch_tpu.models.dalle import DALLE
+    from dalle_pytorch_tpu.training import TrainState, make_optimizer, make_dalle_train_step
+
+    dim, depth, heads, dim_head = 1024, 12, 16, 64
+    text_seq, fmap = 256, 16
+    image_seq = fmap * fmap
+    seq = text_seq + image_seq
+    batch = 32
+
+    model = DALLE(
+        dim=dim, depth=depth, heads=heads, dim_head=dim_head,
+        num_image_tokens=8192, image_fmap_size=fmap,
+        num_text_tokens=10000, text_seq_len=text_seq,
+        shift_tokens=True, rotary_emb=True, dtype=jnp.bfloat16,
+    )
+    text = jnp.ones((batch, text_seq), jnp.int32)
+    tokens = jnp.zeros((batch, image_seq), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), text, tokens)["params"]
+    state = TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=make_optimizer(3e-4, clip_grad_norm=0.5),
+    )
+    step = jax.jit(make_dalle_train_step(model), donate_argnums=0)
+    batch_dict = {"text": text, "image_tokens": tokens}
+    rng = jax.random.PRNGKey(1)
+
+    # warmup / compile
+    state, metrics = step(state, batch_dict, rng)
+    jax.block_until_ready(metrics["loss"])
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        rng, r = jax.random.split(rng)
+        state, metrics = step(state, batch_dict, r)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    steps_per_sec = n_steps / dt
+    img_tok_per_sec_chip = steps_per_sec * batch * image_seq / n_chips
+    flops_per_step = transformer_train_flops(dim, depth, heads, dim_head, seq) * batch
+    mfu = flops_per_step * steps_per_sec / (peak_flops_per_chip() * n_chips)
+
+    print(
+        json.dumps(
+            {
+                "metric": "dalle_train_image_tokens_per_sec_per_chip",
+                "value": round(img_tok_per_sec_chip, 1),
+                "unit": "img-tok/s/chip",
+                "vs_baseline": round(mfu / 0.45, 4),
+                "mfu": round(mfu, 4),
+                "samples_per_sec": round(steps_per_sec * batch, 2),
+                "device": jax.devices()[0].device_kind,
+                "n_chips": n_chips,
+                "config": f"dim{dim}-depth{depth}-seq{seq}-bs{batch}-bf16",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

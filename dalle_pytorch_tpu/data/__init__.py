@@ -1,0 +1,11 @@
+from dalle_pytorch_tpu.data.tokenizer import (
+    SimpleTokenizer,
+    ByteTokenizer,
+    HugTokenizer,
+    ChineseTokenizer,
+    YttmTokenizer,
+    get_tokenizer,
+)
+from dalle_pytorch_tpu.data.rainbow import RainbowDataset
+from dalle_pytorch_tpu.data.loader import TextImageDataset, Cub2011, MnistDataset
+from dalle_pytorch_tpu.data.webdataset import TarImageTextDataset

@@ -1,0 +1,307 @@
+"""Host-side image+caption datasets and the batching pipeline.
+
+Equivalent of the reference's data layer
+(`/root/reference/dalle_pytorch/loader.py`, `cub2011.py`): a
+`TextImageDataset` keyed on the folder argument — "cub200" -> CUB-200-2011,
+"mnist" -> MNIST IDX files, anything else -> an image-folder tree where
+captions derive from the parent directory name (optionally mapped through
+a user-supplied JSON, generalizing the reference's vendored imagenet.json)
+or from a sibling `<stem>.txt` caption file (upstream's paired-caption
+mode, `loader.py:56-62`).
+
+TPU-shaped differences:
+  * no torch DataLoader worker processes — batches are assembled on the
+    host in numpy and fed to jit'ted steps; per-host sharding replaces
+    DistributedSampler (`train_dalle.py:298-305`) via `shard=(i, n)`;
+  * RandomResizedCrop (`loader.py:70-77`) reimplemented with PIL + numpy
+    (same scale/ratio semantics);
+  * corrupt images are skipped with a deterministic fallback sample
+    (`loader.py:95-98,131-136`).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from pathlib import Path
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
+
+
+def host_shard_order(order: np.ndarray, shard: Tuple[int, int]) -> np.ndarray:
+    """Equal-length interleaved host split.
+
+    Trims `order` to a multiple of the host count BEFORE interleaving so
+    every host yields the SAME number of samples (and therefore batches) —
+    unequal per-host batch counts would deadlock the collective train step
+    on a pod. This re-establishes the invariant DistributedSampler's
+    padding provides in the reference (`train_dalle.py:298-305`).
+    """
+    i, n = shard
+    if n <= 1:
+        return order
+    usable = (len(order) // n) * n
+    return order[:usable][i::n]
+
+DIGIT_WORDS = (
+    "zero", "one", "two", "three", "four",
+    "five", "six", "seven", "eight", "nine",
+)
+
+
+def _load_image(path: Path) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"), dtype=np.uint8)
+
+
+def random_resized_crop(
+    img: np.ndarray,
+    out_size: int,
+    rng: np.random.RandomState,
+    scale: Tuple[float, float] = (0.75, 1.0),
+    ratio: Tuple[float, float] = (3 / 4, 4 / 3),
+) -> np.ndarray:
+    """Area-scaled random crop + resize to out_size; [0,1] float32 output."""
+    from PIL import Image
+
+    h, w = img.shape[:2]
+    area = h * w
+    for _ in range(10):
+        target_area = area * rng.uniform(*scale)
+        aspect = np.exp(rng.uniform(np.log(ratio[0]), np.log(ratio[1])))
+        cw = int(round(np.sqrt(target_area * aspect)))
+        ch = int(round(np.sqrt(target_area / aspect)))
+        if cw <= w and ch <= h:
+            x = rng.randint(0, w - cw + 1)
+            y = rng.randint(0, h - ch + 1)
+            crop = img[y : y + ch, x : x + cw]
+            break
+    else:  # central fallback
+        side = min(h, w)
+        y, x = (h - side) // 2, (w - side) // 2
+        crop = img[y : y + side, x : x + side]
+    out = Image.fromarray(crop).resize((out_size, out_size), Image.BILINEAR)
+    return np.asarray(out, dtype=np.float32) / 255.0
+
+
+# ------------------------------------------------------------------ datasets
+
+
+class _Dataset:
+    """Minimal protocol: __len__ + get(i) -> (caption, uint8 image array)."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def get(self, i: int) -> Tuple[str, np.ndarray]:
+        raise NotImplementedError
+
+
+class ImageFolderDataset(_Dataset):
+    """Generic folder tree; caption = parent-dir name (mapped/cleaned) or
+    sibling .txt file."""
+
+    def __init__(
+        self,
+        folder: str,
+        class_name_json: Optional[str] = None,
+        prefer_txt_captions: bool = True,
+    ):
+        self.root = Path(folder)
+        self.paths: List[Path] = sorted(
+            p for p in self.root.rglob("*") if p.suffix.lower() in IMAGE_EXTS
+        )
+        assert len(self.paths) > 0, f"no images found under {folder}"
+        self.class_map: Dict[str, str] = {}
+        if class_name_json:
+            with open(class_name_json) as f:
+                self.class_map = json.load(f)
+        self.prefer_txt = prefer_txt_captions
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def _caption(self, path: Path) -> str:
+        if self.prefer_txt:
+            txt = path.with_suffix(".txt")
+            if txt.exists():
+                return txt.read_text().strip()
+        key = path.parent.name
+        if key in self.class_map:
+            return str(self.class_map[key])
+        return key.replace("_", " ").replace("-", " ").strip()
+
+    def get(self, i: int) -> Tuple[str, np.ndarray]:
+        path = self.paths[i]
+        return self._caption(path), _load_image(path)
+
+
+class Cub2011(_Dataset):
+    """CUB-200-2011 from the standard extracted layout (`cub2011.py:10-83`).
+
+    Reads images.txt / train_test_split.txt / image_class_labels.txt /
+    classes.txt with pandas; captions come from class names
+    ("001.Black_footed_Albatross" -> "black footed albatross",
+    reference `loader.py:101-110`). No download (zero-egress build).
+    """
+
+    def __init__(self, root: str, train: bool = True):
+        import pandas as pd
+
+        self.root = Path(root)
+        base = self.root / "CUB_200_2011"
+        if not base.exists():
+            base = self.root
+        images = pd.read_csv(
+            base / "images.txt", sep=" ", names=["img_id", "filepath"]
+        )
+        labels = pd.read_csv(
+            base / "image_class_labels.txt", sep=" ", names=["img_id", "target"]
+        )
+        split = pd.read_csv(
+            base / "train_test_split.txt", sep=" ", names=["img_id", "is_training_img"]
+        )
+        classes = pd.read_csv(
+            base / "classes.txt", sep=" ", names=["class_id", "class_name"]
+        )
+        data = images.merge(labels, on="img_id").merge(split, on="img_id")
+        data = data[data.is_training_img == (1 if train else 0)]
+        self.data = data.reset_index(drop=True)
+        self.class_names = {
+            int(r.class_id): str(r.class_name) for r in classes.itertuples()
+        }
+        self.images_dir = base / "images"
+        missing = [
+            r.filepath
+            for r in self.data.head(16).itertuples()
+            if not (self.images_dir / r.filepath).exists()
+        ]
+        assert not missing, f"CUB-200 integrity check failed; missing {missing[:3]}"
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def get(self, i: int) -> Tuple[str, np.ndarray]:
+        row = self.data.iloc[i]
+        name = self.class_names[int(row.target)]
+        caption = name.split(".", 1)[-1].replace("_", " ").lower()
+        return caption, _load_image(self.images_dir / row.filepath)
+
+
+class MnistDataset(_Dataset):
+    """MNIST from raw IDX files; captions are digit words
+    (reference `loader.py:111-119` via torchvision)."""
+
+    def __init__(self, root: str, train: bool = True):
+        base = Path(root)
+        stem = "train" if train else "t10k"
+        img_path = self._find(base, f"{stem}-images-idx3-ubyte")
+        lbl_path = self._find(base, f"{stem}-labels-idx1-ubyte")
+        with open(img_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad MNIST image magic {magic}"
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        with open(lbl_path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad MNIST label magic {magic}"
+            self.labels = np.frombuffer(f.read(), np.uint8)
+
+    @staticmethod
+    def _find(base: Path, name: str) -> Path:
+        for cand in (base / name, base / "MNIST" / "raw" / name):
+            if cand.exists():
+                return cand
+        raise FileNotFoundError(f"{name} not found under {base}")
+
+    def __len__(self) -> int:
+        return len(self.images)
+
+    def get(self, i: int) -> Tuple[str, np.ndarray]:
+        img = np.repeat(self.images[i][..., None], 3, axis=-1)
+        return DIGIT_WORDS[int(self.labels[i])], img
+
+
+# ------------------------------------------------------------------ pipeline
+
+
+class TextImageDataset:
+    """Folder-keyed dataset + tokenize/crop/batch pipeline
+    (`loader.py:16-139` equivalent).
+    """
+
+    def __init__(
+        self,
+        folder: str,
+        text_len: int = 256,
+        image_size: int = 128,
+        truncate_captions: bool = False,
+        resize_ratio: float = 0.75,
+        tokenizer=None,
+        train: bool = True,
+        class_name_json: Optional[str] = None,
+        seed: int = 0,
+    ):
+        name = Path(folder).name.lower()
+        if name == "cub200":
+            self.dataset: _Dataset = Cub2011(folder, train=train)
+        elif name == "mnist":
+            self.dataset = MnistDataset(folder, train=train)
+        else:
+            self.dataset = ImageFolderDataset(folder, class_name_json)
+        if tokenizer is None:
+            from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+
+            tokenizer = ByteTokenizer()
+        self.tokenizer = tokenizer
+        self.text_len = text_len
+        self.image_size = image_size
+        self.truncate_captions = truncate_captions
+        self.resize_ratio = resize_ratio
+        self.rng = np.random.RandomState(seed)
+
+    def __len__(self) -> int:
+        return len(self.dataset)
+
+    def _sample(self, i: int) -> Tuple[str, np.ndarray]:
+        """Fetch with corrupt-image fallback (`loader.py:95-98,131-136`)."""
+        for attempt in range(8):
+            try:
+                caption, img = self.dataset.get(i)
+                return caption, img
+            except Exception:
+                i = int(self.rng.randint(0, len(self.dataset)))
+        raise RuntimeError("too many corrupt samples in a row")
+
+    def item(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+        caption, img = self._sample(i)
+        text = self.tokenizer.tokenize(
+            caption, self.text_len, truncate_text=self.truncate_captions
+        )[0]
+        img = random_resized_crop(
+            img, self.image_size, self.rng, scale=(self.resize_ratio, 1.0)
+        )
+        return text, img
+
+    def batches(
+        self,
+        batch_size: int,
+        shuffle_seed: Optional[int] = None,
+        shard: Tuple[int, int] = (0, 1),
+        drop_last: bool = True,
+    ) -> Iterator[dict]:
+        """Host-sharded minibatch stream: {"text": [B,T], "images": [B,H,W,3]}."""
+        order = np.arange(len(self.dataset))
+        if shuffle_seed is not None:
+            np.random.RandomState(shuffle_seed).shuffle(order)
+        order = host_shard_order(order, shard)
+        for start in range(0, len(order), batch_size):
+            sel = order[start : start + batch_size]
+            if drop_last and len(sel) < batch_size:
+                return
+            texts, images = zip(*(self.item(int(i)) for i in sel))
+            yield {"text": np.stack(texts), "images": np.stack(images)}

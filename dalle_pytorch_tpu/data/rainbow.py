@@ -1,0 +1,134 @@
+"""Synthetic "rainbow shapes" dataset: compositional captions -> images.
+
+The reference's only end-to-end correctness bar is a notebook that renders
+~9k cairo-drawn 32x32 geometric shapes with captions like "small orange
+circle", trains dVAE then DALLE, and checks exact image-token-sequence
+accuracy (1.0 train / ~0.3 held out)
+(`/root/reference/examples/rainbow_dalle.ipynb`, SURVEY.md §4). This module
+re-creates that dataset as a deterministic numpy renderer (no cairo
+dependency) usable both as a pytest fixture and as a real training set for
+the integration run.
+
+Captions: "<size> <color> <shape>" over sizes {small, large},
+9 colors, shapes {circle, square, triangle}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+SIZES = ("small", "large")
+COLORS = {
+    "red": (0.9, 0.1, 0.1),
+    "orange": (1.0, 0.55, 0.0),
+    "yellow": (0.95, 0.9, 0.1),
+    "green": (0.1, 0.75, 0.2),
+    "cyan": (0.1, 0.8, 0.85),
+    "blue": (0.15, 0.25, 0.9),
+    "purple": (0.55, 0.15, 0.8),
+    "pink": (0.95, 0.5, 0.7),
+    "white": (0.95, 0.95, 0.95),
+}
+SHAPES = ("circle", "square", "triangle")
+
+
+def render_shape(
+    shape: str,
+    color: Tuple[float, float, float],
+    size: str,
+    image_size: int = 32,
+    jitter: Tuple[float, float] = (0.0, 0.0),
+) -> np.ndarray:
+    """Render one anti-aliased shape on a black background. [H, W, 3] in [0,1]."""
+    n = image_size
+    yy, xx = np.mgrid[0:n, 0:n].astype(np.float64) + 0.5
+    cx = n / 2 + jitter[0] * n * 0.1
+    cy = n / 2 + jitter[1] * n * 0.1
+    r = n * (0.18 if size == "small" else 0.34)
+
+    if shape == "circle":
+        dist = np.sqrt((xx - cx) ** 2 + (yy - cy) ** 2) - r
+    elif shape == "square":
+        dist = np.maximum(np.abs(xx - cx), np.abs(yy - cy)) - r
+    elif shape == "triangle":
+        # equilateral triangle pointing up: intersection of 3 half-planes
+        h = r * 1.2
+        d1 = (yy - cy) - h * 0.6  # below the base
+        d2 = 0.866 * (xx - cx) + 0.5 * (yy - cy) - h * 0.6
+        d3 = -0.866 * (xx - cx) + 0.5 * (yy - cy) - h * 0.6
+        dist = np.maximum.reduce([d1, d2, d3])
+    else:
+        raise ValueError(f"unknown shape {shape}")
+
+    alpha = np.clip(0.5 - dist, 0.0, 1.0)  # 1px anti-alias band
+    img = np.zeros((n, n, 3))
+    for c in range(3):
+        img[..., c] = alpha * color[c]
+    return img.astype(np.float32)
+
+
+@dataclass
+class RainbowDataset:
+    """Deterministic caption->image dataset.
+
+    num_samples combinations are cycled over (size, color, shape) with a
+    small deterministic center jitter so repeated combos differ slightly.
+    """
+
+    num_samples: int = 1024
+    image_size: int = 32
+    seed: int = 0
+
+    def __post_init__(self):
+        rng = np.random.RandomState(self.seed)
+        combos = [
+            (s, c, sh) for s in SIZES for c in COLORS for sh in SHAPES
+        ]
+        idx = np.arange(self.num_samples) % len(combos)
+        rng.shuffle(idx)
+        self._combos = [combos[i] for i in idx]
+        self._jitter = rng.uniform(-1, 1, size=(self.num_samples, 2))
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def caption(self, i: int) -> str:
+        size, color, shape = self._combos[i]
+        return f"{size} {color} {shape}"
+
+    def image(self, i: int) -> np.ndarray:
+        size, color, shape = self._combos[i]
+        return render_shape(
+            shape, COLORS[color], size, self.image_size, tuple(self._jitter[i])
+        )
+
+    def __getitem__(self, i: int):
+        return self.caption(i), self.image(i)
+
+    def batches(self, batch_size: int, tokenizer, text_seq_len: int, *,
+                shuffle_seed: int | None = None, shard: Tuple[int, int] = (0, 1),
+                drop_last: bool = True):
+        """Yield {"text": [B,T] int32, "images": [B,H,W,3] float32} batches.
+
+        `shard=(i, n)` gives host i of n its interleaved subset — the
+        host-sharded replacement for DistributedSampler
+        (`/root/reference/train_dalle.py:298-305`).
+        """
+        from dalle_pytorch_tpu.data.loader import host_shard_order
+
+        order = np.arange(self.num_samples)
+        if shuffle_seed is not None:
+            np.random.RandomState(shuffle_seed).shuffle(order)
+        order = host_shard_order(order, shard)
+        for start in range(0, len(order), batch_size):
+            sel = order[start : start + batch_size]
+            if drop_last and len(sel) < batch_size:
+                return
+            texts = [self.caption(i) for i in sel]
+            yield {
+                "text": tokenizer.tokenize(texts, text_seq_len, truncate_text=True),
+                "images": np.stack([self.image(i) for i in sel]),
+            }

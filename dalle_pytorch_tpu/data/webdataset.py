@@ -1,0 +1,165 @@
+"""Streaming tar-shard dataset (WebDataset-style).
+
+Equivalent of the reference's WebDataset pipeline
+(`/root/reference/train_dalle.py:97-117,257-278,309-313`): samples are
+stored as `key.jpg` + `key.txt` pairs inside (possibly many) tar shards;
+sources can be local tar files, brace-expanded shard patterns
+(`shard-{0000..0042}.tar`), directories of tars, or `pipe:` commands
+(e.g. `pipe:gsutil cat gs://...` — the reference's GCS path). Implemented
+directly on `tarfile` — no webdataset dependency.
+
+Decode errors follow the reference's `warn_and_continue` handler; the
+image/caption column names are configurable like `--wds img,cap`.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import subprocess
+import tarfile
+from pathlib import Path
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from dalle_pytorch_tpu.data.loader import random_resized_crop
+
+IMAGE_KEYS = ("jpg", "jpeg", "png", "img", "image")
+TEXT_KEYS = ("txt", "text", "cap", "caption")
+
+
+def expand_shards(url: str) -> List[str]:
+    """Expand `{0000..0099}` brace patterns / directories into shard lists."""
+    m = re.search(r"\{(\d+)\.\.(\d+)\}", url)
+    if m:
+        lo, hi = m.group(1), m.group(2)
+        width = len(lo)
+        return [
+            url[: m.start()] + str(i).zfill(width) + url[m.end() :]
+            for i in range(int(lo), int(hi) + 1)
+        ]
+    p = Path(url)
+    if p.is_dir():
+        return [str(t) for t in sorted(p.glob("*.tar"))]
+    return [url]
+
+
+def _open_stream(url: str):
+    """Returns (fileobj, proc_or_None)."""
+    if url.startswith("pipe:"):
+        proc = subprocess.Popen(
+            url[len("pipe:") :], shell=True, stdout=subprocess.PIPE
+        )
+        return proc.stdout, proc
+    return open(url, "rb"), None
+
+
+def _iter_tar_samples(url: str) -> Iterator[dict]:
+    """Group tar members by sample key ('dir/stem') preserving order."""
+    stream, proc = _open_stream(url)
+    try:
+        with tarfile.open(fileobj=stream, mode="r|*") as tar:
+            current_key, fields = None, {}
+            for member in tar:
+                if not member.isfile():
+                    continue
+                name = member.name
+                stem, _, ext = name.rpartition(".")
+                if current_key is not None and stem != current_key and fields:
+                    yield fields
+                    fields = {}
+                current_key = stem
+                data = tar.extractfile(member)
+                if data is not None:
+                    fields[ext.lower()] = data.read()
+            if fields:
+                yield fields
+    finally:
+        stream.close()
+        if proc is not None:
+            ret = proc.wait()
+            if ret != 0:
+                raise RuntimeError(
+                    f"pipe command for shard {url!r} exited with status {ret} "
+                    "— stream may be truncated"
+                )
+
+
+class TarImageTextDataset:
+    """Iterable tar-shard dataset -> host-sharded numpy batches."""
+
+    def __init__(
+        self,
+        urls: str,
+        image_key: str = "jpg",
+        text_key: str = "txt",
+        text_len: int = 256,
+        image_size: int = 128,
+        truncate_captions: bool = True,
+        resize_ratio: float = 0.75,
+        tokenizer=None,
+        seed: int = 0,
+    ):
+        self.shards = expand_shards(urls)
+        assert self.shards, f"no shards matched {urls}"
+        self.image_keys = (image_key,) + IMAGE_KEYS
+        self.text_keys = (text_key,) + TEXT_KEYS
+        if tokenizer is None:
+            from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+
+            tokenizer = ByteTokenizer()
+        self.tokenizer = tokenizer
+        self.text_len = text_len
+        self.image_size = image_size
+        self.truncate = truncate_captions
+        self.resize_ratio = resize_ratio
+        self.rng = np.random.RandomState(seed)
+
+    def _decode(self, sample: dict) -> Optional[Tuple[str, np.ndarray]]:
+        from PIL import Image
+
+        img_bytes = next(
+            (sample[k] for k in self.image_keys if k in sample), None
+        )
+        txt_bytes = next(
+            (sample[k] for k in self.text_keys if k in sample), None
+        )
+        if img_bytes is None or txt_bytes is None:
+            return None  # filter: both columns required (`train_dalle.py:269-274`)
+        try:
+            with Image.open(io.BytesIO(img_bytes)) as im:
+                img = np.asarray(im.convert("RGB"), dtype=np.uint8)
+            return txt_bytes.decode("utf-8", errors="replace").strip(), img
+        except Exception as e:  # warn_and_continue (`train_dalle.py:276`)
+            print(f"[wds] skipping undecodable sample: {e}")
+            return None
+
+    def samples(self, shard: Tuple[int, int] = (0, 1)) -> Iterator[Tuple[str, np.ndarray]]:
+        """Shard-level host split: host i reads every n-th tar shard."""
+        if shard[1] > 1 and len(self.shards) < shard[1]:
+            raise ValueError(
+                f"{len(self.shards)} tar shards cannot be split across "
+                f"{shard[1]} hosts — provide at least one shard per host"
+            )
+        my_shards = self.shards[shard[0] :: shard[1]]
+        for url in my_shards:
+            for raw in _iter_tar_samples(url):
+                decoded = self._decode(raw)
+                if decoded is not None:
+                    yield decoded
+
+    def batches(self, batch_size: int, shard: Tuple[int, int] = (0, 1)) -> Iterator[dict]:
+        texts, images = [], []
+        for caption, img in self.samples(shard):
+            texts.append(
+                self.tokenizer.tokenize(caption, self.text_len, self.truncate)[0]
+            )
+            images.append(
+                random_resized_crop(
+                    img, self.image_size, self.rng, scale=(self.resize_ratio, 1.0)
+                )
+            )
+            if len(texts) == batch_size:
+                yield {"text": np.stack(texts), "images": np.stack(images)}
+                texts, images = [], []

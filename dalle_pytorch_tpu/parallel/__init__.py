@@ -1,0 +1,16 @@
+from dalle_pytorch_tpu.parallel.mesh import (
+    MESH_AXES,
+    make_mesh,
+    initialize_distributed,
+    is_root,
+    is_local_root,
+    host_barrier,
+    batch_spec,
+    batch_sharding,
+)
+from dalle_pytorch_tpu.parallel.partition import (
+    param_partition_spec,
+    partition_params,
+    state_shardings,
+)
+from dalle_pytorch_tpu.parallel.ring import ring_attention

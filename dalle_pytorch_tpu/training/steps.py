@@ -1,0 +1,215 @@
+"""Jitted train-step factories for the three model families.
+
+Equivalent of the reference hot loops (`/root/reference/train_dalle.py:
+494-592`, `train_vae.py:230-303`) — but each whole step (frozen-VAE encode,
+forward(s), backward, clip, Adam update) is ONE compiled XLA program, pjit-
+shardable over the mesh. Gradient averaging across data-parallel shards is
+implicit (XLA inserts the psum); the reference's explicit
+`average_all(loss)` (`deepspeed_backend.py:165-171`) becomes a jnp.mean the
+compiler lowers to the same collective.
+
+Feature mapping:
+  * `--fp16` + apex AMP (`train_dalle.py:326-327,382-388`) -> bf16 compute
+    dtype on the model, fp32 params/optimizer (no loss scaling needed);
+  * DeepSpeed `ga_steps` (`train_dalle.py:380`) -> lax.scan microbatching
+    inside the step (`grad_accum`);
+  * `clip_grad_norm_` (`train_dalle.py:526`) -> optax.clip_by_global_norm;
+  * the fork's objective modes (`train_dalle.py:513-518`,
+    `config/config.yaml:13`): forward_only / forward_forward /
+    forward_reverse_partial; reverse_only (named in `config/exp/ro.yaml`
+    but unhandled by the reference trainer) is implemented here as the
+    inverse objective alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+MODES = ("forward_only", "forward_forward", "forward_reverse_partial", "reverse_only")
+
+
+class TrainState(train_state.TrainState):
+    pass
+
+
+def make_optimizer(
+    learning_rate: float, clip_grad_norm: Optional[float] = None
+) -> optax.GradientTransformation:
+    """Adam with optional global-norm clipping; lr is a mutable hyperparam
+    (host-side schedulers rewrite it, see lr.py)."""
+
+    def build(learning_rate):
+        steps = []
+        if clip_grad_norm is not None:
+            steps.append(optax.clip_by_global_norm(clip_grad_norm))
+        steps.append(optax.adam(learning_rate))
+        return optax.chain(*steps)
+
+    return optax.inject_hyperparams(build)(learning_rate=learning_rate)
+
+
+def get_learning_rate(state: TrainState) -> float:
+    return float(state.opt_state.hyperparams["learning_rate"])
+
+
+def set_learning_rate(state: TrainState, lr: float) -> TrainState:
+    opt_state = state.opt_state
+    hyper = dict(opt_state.hyperparams)
+    hyper["learning_rate"] = jnp.asarray(lr, jnp.float32)
+    return state.replace(opt_state=opt_state._replace(hyperparams=hyper))
+
+
+def _accumulate(loss_and_metrics_fn, params, batches, rng, accum: int):
+    """Scan `accum` microbatches, averaging grads and metrics."""
+
+    def micro(carry, inp):
+        g_acc, m_acc = carry
+        mb, r = inp
+        (_, metrics), grads = jax.value_and_grad(
+            loss_and_metrics_fn, has_aux=True
+        )(params, mb, r)
+        g_acc = jax.tree.map(jnp.add, g_acc, grads)
+        m_acc = jax.tree.map(jnp.add, m_acc, metrics)
+        return (g_acc, m_acc), None
+
+    rngs = jax.random.split(rng, accum)
+    mb0 = jax.tree.map(lambda x: x[0], batches)
+    (_, m0), g0 = jax.value_and_grad(loss_and_metrics_fn, has_aux=True)(
+        params, mb0, rngs[0]
+    )
+    if accum == 1:
+        return g0, m0
+    rest = jax.tree.map(lambda x: x[1:], batches)
+    (g, m), _ = jax.lax.scan(micro, (g0, m0), (rest, rngs[1:]))
+    scale = 1.0 / accum
+    return jax.tree.map(lambda x: x * scale, g), jax.tree.map(lambda x: x * scale, m)
+
+
+def _microbatch(batch, accum: int):
+    """[B, ...] -> [accum, B/accum, ...] for every leaf."""
+    if accum == 1:
+        return jax.tree.map(lambda x: x[None], batch)
+    return jax.tree.map(
+        lambda x: x.reshape(accum, x.shape[0] // accum, *x.shape[1:]), batch
+    )
+
+
+def make_vae_train_step(vae: DiscreteVAE, grad_accum: int = 1) -> Callable:
+    """step(state, images, rng, temp) -> (state, metrics).
+
+    Mirrors the dVAE hot loop (`train_vae.py:234-248`); `temp` is the
+    annealed gumbel temperature (`train_vae.py:278`), traced so annealing
+    doesn't recompile.
+    """
+
+    def loss_fn(params, images, rng, temp):
+        loss = vae.apply(
+            {"params": params}, images, return_loss=True, temp=temp,
+            rngs={"gumbel": rng},
+        )
+        return loss, {"loss": loss}
+
+    def step(state: TrainState, images, rng, temp):
+        fn = lambda p, mb, r: loss_fn(p, mb, r, temp)
+        grads, metrics = _accumulate(
+            fn, state.params, _microbatch(images, grad_accum), rng, grad_accum
+        )
+        return state.apply_gradients(grads=grads), metrics
+
+    return step
+
+
+def make_dalle_train_step(
+    model,
+    vae: Optional[DiscreteVAE] = None,
+    mode: str = "forward_only",
+    grad_accum: int = 1,
+    null_cond_prob: float = 0.0,
+) -> Callable:
+    """step(state, batch, rng[, vae_params]) -> (state, metrics).
+
+    batch: {"text": [B, T] ids, "images": [B, H, W, C]} when a trainable-
+    frozen `vae` is supplied (in-step encode, reference
+    `dalle_pytorch.py:619-627`), else {"text", "image_tokens": [B, N]}
+    (the better TPU pattern: tokens precomputed offline).
+
+    Loss composition per the fork trainer (`train_dalle.py:509-518`):
+    forward loss always except reverse_only; inverse loss added for
+    forward_forward (same layer order) / forward_reverse_partial
+    (reversed layer order).
+    """
+    assert mode in MODES, f"mode must be one of {MODES}"
+
+    def encode(vae_params, batch):
+        if vae is not None and "image_tokens" not in batch:
+            return jax.lax.stop_gradient(
+                vae.apply(
+                    {"params": vae_params},
+                    batch["images"],
+                    method=DiscreteVAE.get_codebook_indices,
+                )
+            )
+        return batch["image_tokens"]
+
+    def loss_fn(params, batch, rng, vae_params):
+        text = batch["text"]
+        tokens = encode(vae_params, batch)
+        drop_rng, null_rng = jax.random.split(rng)
+        rngs = {"dropout": drop_rng, "null_cond": null_rng}
+        apply = lambda **kw: model.apply(
+            {"params": params}, text, tokens, return_loss=True,
+            deterministic=False, null_cond_prob=null_cond_prob, rngs=rngs, **kw
+        )
+
+        metrics = {}
+        if mode == "reverse_only":
+            loss, acc = apply(inverse_mapping=True)
+            metrics.update(inverse_loss=loss, accuracy=acc, forward_loss=0.0)
+        else:
+            loss, _ = apply()
+            metrics["forward_loss"] = loss
+            if mode in ("forward_forward", "forward_reverse_partial"):
+                inv_loss, acc = apply(
+                    inverse_mapping=True,
+                    reverse_model=(mode == "forward_reverse_partial"),
+                )
+                loss = loss + inv_loss
+                metrics.update(inverse_loss=inv_loss, accuracy=acc)
+        metrics["loss"] = loss
+        return loss, metrics
+
+    def step(state: TrainState, batch, rng, vae_params=None):
+        fn = lambda p, mb, r: loss_fn(p, mb, r, vae_params)
+        grads, metrics = _accumulate(
+            fn, state.params, _microbatch(batch, grad_accum), rng, grad_accum
+        )
+        return state.apply_gradients(grads=grads), metrics
+
+    return step
+
+
+def make_clip_train_step(clip_model, grad_accum: int = 1) -> Callable:
+    """step(state, batch{text,images}, rng) -> (state, metrics)."""
+
+    def loss_fn(params, batch, rng):
+        loss = clip_model.apply(
+            {"params": params}, batch["text"], batch["images"],
+            text_mask=batch.get("text_mask"), return_loss=True,
+            deterministic=False, rngs={"dropout": rng},
+        )
+        return loss, {"loss": loss}
+
+    def step(state: TrainState, batch, rng):
+        grads, metrics = _accumulate(
+            loss_fn, state.params, _microbatch(batch, grad_accum), rng, grad_accum
+        )
+        return state.apply_gradients(grads=grads), metrics
+
+    return step

@@ -1,0 +1,2 @@
+from dalle_pytorch_tpu.utils.images import save_image_grid, to_uint8
+from dalle_pytorch_tpu.utils.trees import param_count, tree_bytes

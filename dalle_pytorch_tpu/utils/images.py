@@ -1,0 +1,31 @@
+"""Image output helpers (PNG grids replace the reference's wandb.Image /
+torchvision.utils.save_image usage, `train_vae.py:252-271`,
+`generate.py:138-141`)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+
+def to_uint8(img: np.ndarray) -> np.ndarray:
+    """[H,W,C] float (any range ~[0,1]) -> uint8, clipped."""
+    img = np.asarray(img, dtype=np.float32)
+    return (np.clip(img, 0.0, 1.0) * 255).astype(np.uint8)
+
+
+def save_image_grid(images: np.ndarray, path, nrow: int = 8) -> None:
+    """[N,H,W,C] -> single PNG grid at `path`."""
+    from PIL import Image
+
+    images = np.asarray(images)
+    n, h, w, c = images.shape
+    nrow = min(nrow, n)
+    ncol = (n + nrow - 1) // nrow
+    grid = np.zeros((ncol * h, nrow * w, c), dtype=np.uint8)
+    for i in range(n):
+        r, col = divmod(i, nrow)
+        grid[r * h : (r + 1) * h, col * w : (col + 1) * w] = to_uint8(images[i])
+    Path(path).parent.mkdir(parents=True, exist_ok=True)
+    Image.fromarray(grid.squeeze()).save(path)

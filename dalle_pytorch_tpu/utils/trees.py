@@ -1,0 +1,13 @@
+"""Pytree utilities."""
+
+from __future__ import annotations
+
+import jax
+
+
+def param_count(tree) -> int:
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def tree_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))

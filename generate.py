@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Generate images from a trained DALL-E checkpoint.
+
+Equivalent of `/root/reference/generate.py`: loads the single-file
+checkpoint (hparams + weights + frozen-VAE weights), verifies the VAE
+class matches (`generate.py:101`), splits prompts on '|', optionally
+completes the text first (--gentxt, `:116-118`), samples image tokens with
+top-k 0.9 + temperature, decodes through the VAE and writes PNGs per
+prompt directory (`:134-143`).
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dalle_path", type=str, required=True)
+    p.add_argument("--text", type=str, required=True, help="'|'-separated prompts")
+    p.add_argument("--num_images", type=int, default=16)
+    p.add_argument("--batch_size", type=int, default=8)
+    p.add_argument("--top_k", type=float, default=0.9)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--cond_scale", type=float, default=1.0)
+    p.add_argument("--outputs_dir", type=str, default="outputs")
+    p.add_argument("--gentxt", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    import jax
+    import os as _os
+
+    if _os.environ.get("DALLE_TPU_FORCE_PLATFORM"):
+        jax.config.update("jax_platforms", _os.environ["DALLE_TPU_FORCE_PLATFORM"])
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models.dalle import (
+        generate_images, generate_texts,
+    )
+    from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+    from dalle_pytorch_tpu.training.pipeline import (
+        build_tokenizer, dalle_from_config, load_dalle_checkpoint,
+        dvae_from_hparams,
+    )
+    from dalle_pytorch_tpu.utils.images import save_image_grid, to_uint8
+
+    ckpt_path = Path(args.dalle_path)
+    assert ckpt_path.exists(), f"trained DALL-E {ckpt_path} must exist"
+    cfg, dalle_params, vae_params, meta = load_dalle_checkpoint(str(ckpt_path))
+
+    assert meta.get("vae_class_name") == "DiscreteVAE" or vae_params is None, (
+        "checkpoint was trained with a pretrained VAE wrapper; provide it"
+    )
+    if vae_params is None:
+        from dalle_pytorch_tpu.training.pipeline import build_vae
+
+        vae, vae_params = build_vae(cfg)
+    else:
+        assert meta.get("vae_hparams"), "checkpoint missing vae_hparams"
+        vae = dvae_from_hparams(meta["vae_hparams"])
+    fmap = vae.image_size // (2 ** vae.num_layers)
+
+    tokenizer = build_tokenizer(cfg)
+    model = dalle_from_config(
+        cfg, num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
+        vocab_size=max(tokenizer.vocab_size, 1),
+    )
+    variables = {"params": dalle_params}
+    rng = jax.random.PRNGKey(args.seed)
+
+    from PIL import Image
+
+    for raw_prompt in args.text.split("|"):
+        prompt = raw_prompt.strip()
+        if args.gentxt:
+            ids = tokenizer.tokenize(prompt, cfg.model.text_seq_len, truncate_text=True)
+            prefix_len = int((ids[0] != 0).sum())
+            rng, r = jax.random.split(rng)
+            completed = generate_texts(
+                model, variables, r, jnp.asarray(ids), prefix_len=prefix_len
+            )
+            prompt = tokenizer.decode(
+                completed[0],
+                pad_tokens=set(
+                    range(model.total_text_tokens - model.text_seq_len,
+                          model.total_text_tokens)
+                ),
+            )
+            print(f"completed text: {prompt!r}")
+
+        ids = tokenizer.tokenize(prompt, cfg.model.text_seq_len, truncate_text=True)
+        text = jnp.asarray(np.repeat(ids, args.num_images, axis=0))
+
+        images = []
+        for start in range(0, args.num_images, args.batch_size):
+            chunk = text[start : start + args.batch_size]
+            rng, r = jax.random.split(rng)
+            toks = generate_images(
+                model, variables, r, chunk,
+                filter_thres=args.top_k, temperature=args.temperature,
+                cond_scale=args.cond_scale,
+            )
+            if isinstance(vae, DiscreteVAE):
+                imgs = vae.apply(
+                    {"params": vae_params}, toks, method=DiscreteVAE.decode
+                )
+                images.append(np.asarray(imgs) * 0.5 + 0.5)  # un-normalize
+            else:  # pretrained wrappers decode to [0,1] already
+                images.append(np.asarray(vae.decode(toks)))
+        images = np.concatenate(images, axis=0)
+
+        safe = "".join(c if c.isalnum() or c in " -." else "" for c in prompt)
+        out_dir = Path(args.outputs_dir) / (safe.strip().replace(" ", "_")[:100] or "prompt")
+        out_dir.mkdir(parents=True, exist_ok=True)
+        for i, img in enumerate(images):
+            Image.fromarray(to_uint8(img)).save(out_dir / f"{i}.png")
+        save_image_grid(images, out_dir / "grid.png")
+        print(f"created {len(images)} images at {out_dir}")
+
+
+if __name__ == "__main__":
+    main()

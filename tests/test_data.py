@@ -1,0 +1,239 @@
+import io
+import json
+import os
+import tarfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.data.tokenizer import (
+    ByteTokenizer,
+    SimpleTokenizer,
+    get_tokenizer,
+)
+from dalle_pytorch_tpu.data.rainbow import RainbowDataset, COLORS, SHAPES
+from dalle_pytorch_tpu.data.loader import (
+    TextImageDataset,
+    ImageFolderDataset,
+    MnistDataset,
+    random_resized_crop,
+)
+from dalle_pytorch_tpu.data.webdataset import TarImageTextDataset, expand_shards
+
+
+class TestByteTokenizer:
+    def test_roundtrip(self):
+        tok = ByteTokenizer()
+        ids = tok.tokenize(["small orange circle", "big blue square"], 32)
+        assert ids.shape == (2, 32)
+        assert ids.dtype == np.int32
+        assert (ids >= 0).all()
+        assert tok.decode(ids[0]) == "small orange circle"
+
+    def test_overflow_raises_unless_truncate(self):
+        tok = ByteTokenizer()
+        with pytest.raises(RuntimeError, match="too long"):
+            tok.tokenize("a" * 100, 8)
+        out = tok.tokenize("a" * 100, 8, truncate_text=True)
+        assert out.shape == (1, 8)
+
+    def test_zero_reserved_for_padding(self):
+        tok = ByteTokenizer()
+        ids = tok.tokenize("hi", 8)[0]
+        assert ids[0] != 0 and ids[1] != 0 and (ids[2:] == 0).all()
+
+
+class TestSimpleTokenizer:
+    @pytest.fixture
+    def bpe_file(self, tmp_path):
+        # tiny CLIP-format merges file: header line + merges
+        merges = ["#version: test", "h e", "l l", "he ll", "hell o</w>", "o k</w>"]
+        p = tmp_path / "merges.txt"
+        p.write_text("\n".join(merges))
+        return p
+
+    def test_encode_decode_roundtrip(self, bpe_file):
+        tok = SimpleTokenizer(bpe_file)
+        ids = tok.encode("hello ok")
+        assert len(ids) > 0
+        assert tok.decode(ids) == "hello ok"
+
+    def test_merges_reduce_token_count(self, bpe_file):
+        tok = SimpleTokenizer(bpe_file)
+        # 'hello' fully merges via the chain -> single token
+        assert len(tok.encode("hello")) == 1
+
+    def test_vocab_layout(self, bpe_file):
+        tok = SimpleTokenizer(bpe_file)
+        assert tok.vocab_size == 512 + 5 + 2
+
+    def test_get_tokenizer_dispatch(self, bpe_file):
+        assert isinstance(get_tokenizer(), ByteTokenizer)
+        assert isinstance(get_tokenizer(bpe_path=str(bpe_file)), SimpleTokenizer)
+
+
+class TestRainbow:
+    def test_deterministic(self):
+        d1 = RainbowDataset(num_samples=16, seed=3)
+        d2 = RainbowDataset(num_samples=16, seed=3)
+        np.testing.assert_array_equal(d1.image(5), d2.image(5))
+        assert d1.caption(5) == d2.caption(5)
+
+    def test_images_valid(self):
+        ds = RainbowDataset(num_samples=8, image_size=32)
+        for i in range(8):
+            img = ds.image(i)
+            assert img.shape == (32, 32, 3)
+            assert img.min() >= 0 and img.max() <= 1
+            assert img.max() > 0.4  # shape actually drawn
+            size, color, shape = ds.caption(i).split()
+            assert color in COLORS and shape in SHAPES
+
+    def test_batches_sharded(self):
+        ds = RainbowDataset(num_samples=32)
+        tok = ByteTokenizer()
+        b0 = list(ds.batches(4, tok, 24, shard=(0, 2)))
+        b1 = list(ds.batches(4, tok, 24, shard=(1, 2)))
+        assert len(b0) == len(b1) == 4
+        assert b0[0]["images"].shape == (4, 32, 32, 3)
+        assert b0[0]["text"].shape == (4, 24)
+        assert not np.array_equal(b0[0]["images"], b1[0]["images"])
+
+
+@pytest.fixture
+def image_folder(tmp_path):
+    from PIL import Image
+
+    for cls, color in [("red_things", (255, 0, 0)), ("blue_things", (0, 0, 255))]:
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            Image.new("RGB", (40, 50), color).save(d / f"im{i}.png")
+    # one paired-caption image
+    cap = tmp_path / "train" / "red_things" / "special.png"
+    Image.new("RGB", (40, 40), (255, 255, 0)).save(cap)
+    cap.with_suffix(".txt").write_text("a special yellow image")
+    return tmp_path / "train"
+
+
+class TestFolderDataset:
+    def test_captions_from_dirs_and_txt(self, image_folder):
+        ds = ImageFolderDataset(str(image_folder))
+        caps = {ds.get(i)[0] for i in range(len(ds))}
+        assert "red things" in caps and "blue things" in caps
+        assert "a special yellow image" in caps
+
+    def test_class_name_json(self, image_folder, tmp_path):
+        mapping = tmp_path / "map.json"
+        mapping.write_text(json.dumps({"red_things": "crimson objects"}))
+        ds = ImageFolderDataset(str(image_folder), class_name_json=str(mapping))
+        caps = {ds.get(i)[0] for i in range(len(ds))}
+        assert "crimson objects" in caps
+
+    def test_pipeline_batches(self, image_folder):
+        ds = TextImageDataset(
+            str(image_folder), text_len=16, image_size=32,
+            truncate_captions=True,
+        )
+        batches = list(ds.batches(2, shuffle_seed=0))
+        assert len(batches) == 3
+        assert batches[0]["images"].shape == (2, 32, 32, 3)
+        assert batches[0]["images"].dtype == np.float32
+        assert batches[0]["text"].shape == (2, 16)
+
+    def test_corrupt_image_fallback(self, image_folder):
+        bad = image_folder / "red_things" / "corrupt.png"
+        bad.write_bytes(b"not an image")
+        ds = TextImageDataset(str(image_folder), text_len=8, image_size=16,
+                              truncate_captions=True)
+        # consuming every sample must not raise
+        n = sum(b["text"].shape[0] for b in ds.batches(1, drop_last=False))
+        assert n == len(ds)
+
+
+class TestMnist:
+    @pytest.fixture
+    def mnist_dir(self, tmp_path):
+        import struct
+
+        imgs = np.random.RandomState(0).randint(0, 255, (4, 28, 28), np.uint8)
+        lbls = np.asarray([0, 5, 9, 3], np.uint8)
+        with open(tmp_path / "train-images-idx3-ubyte", "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 4, 28, 28))
+            f.write(imgs.tobytes())
+        with open(tmp_path / "train-labels-idx1-ubyte", "wb") as f:
+            f.write(struct.pack(">II", 2049, 4))
+            f.write(lbls.tobytes())
+        return tmp_path
+
+    def test_idx_loading(self, mnist_dir):
+        ds = MnistDataset(str(mnist_dir), train=True)
+        assert len(ds) == 4
+        cap, img = ds.get(1)
+        assert cap == "five"
+        assert img.shape == (28, 28, 3)
+
+
+class TestWebdataset:
+    @pytest.fixture
+    def tar_shards(self, tmp_path):
+        from PIL import Image
+
+        for s in range(2):
+            with tarfile.open(tmp_path / f"shard-{s:04d}.tar", "w") as tar:
+                for i in range(3):
+                    key = f"sample{s}{i}"
+                    buf = io.BytesIO()
+                    Image.new("RGB", (32, 32), (s * 100, i * 50, 0)).save(
+                        buf, format="JPEG"
+                    )
+                    data = buf.getvalue()
+                    info = tarfile.TarInfo(f"{key}.jpg")
+                    info.size = len(data)
+                    tar.addfile(info, io.BytesIO(data))
+                    txt = f"caption {s} {i}".encode()
+                    info = tarfile.TarInfo(f"{key}.txt")
+                    info.size = len(txt)
+                    tar.addfile(info, io.BytesIO(txt))
+        return tmp_path
+
+    def test_brace_expansion(self):
+        shards = expand_shards("shard-{0000..0003}.tar")
+        assert shards == [f"shard-{i:04d}.tar" for i in range(4)]
+
+    def test_iterates_pairs(self, tar_shards):
+        ds = TarImageTextDataset(str(tar_shards), text_len=16, image_size=16)
+        batches = list(ds.batches(3))
+        assert len(batches) == 2
+        assert batches[0]["images"].shape == (3, 16, 16, 3)
+        assert batches[0]["text"].shape == (3, 16)
+
+    def test_shard_split(self, tar_shards):
+        ds = TarImageTextDataset(str(tar_shards), text_len=8, image_size=16)
+        s0 = list(ds.samples(shard=(0, 2)))
+        s1 = list(ds.samples(shard=(1, 2)))
+        assert len(s0) == 3 and len(s1) == 3
+        assert {c for c, _ in s0}.isdisjoint({c for c, _ in s1})
+
+    def test_missing_caption_filtered(self, tmp_path):
+        from PIL import Image
+
+        with tarfile.open(tmp_path / "solo.tar", "w") as tar:
+            buf = io.BytesIO()
+            Image.new("RGB", (8, 8)).save(buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo("orphan.jpg")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+        ds = TarImageTextDataset(str(tmp_path / "solo.tar"))
+        assert list(ds.samples()) == []
+
+
+class TestCrop:
+    def test_random_resized_crop_shape_and_range(self):
+        rng = np.random.RandomState(0)
+        img = np.random.randint(0, 255, (50, 70, 3), np.uint8)
+        out = random_resized_crop(img, 32, rng)
+        assert out.shape == (32, 32, 3)
+        assert 0.0 <= out.min() and out.max() <= 1.0

@@ -1,0 +1,161 @@
+"""End-to-end: config system, checkpoint formats, and the full CLI flow
+(train_vae -> train_dalle -> generate) on the synthetic rainbow dataset —
+the moral equivalent of the reference's rainbow notebook integration test
+(`/root/reference/examples/rainbow_dalle.ipynb`, SURVEY.md §4)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.training.config import load_config, TrainConfig
+from dalle_pytorch_tpu.training.checkpoint import (
+    save_params_npz,
+    load_params_npz,
+    CheckpointManager,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = load_config()
+        assert cfg.mode == "forward_only"
+        assert cfg.model.dim == 512
+
+    def test_overrides_and_types(self):
+        cfg = load_config(
+            overrides=["model.depth=4", "learning_rate=1e-3", "lr_decay=true"]
+        )
+        assert cfg.model.depth == 4 and isinstance(cfg.model.depth, int)
+        assert cfg.learning_rate == pytest.approx(1e-3)
+        assert cfg.lr_decay is True
+
+    def test_exp_presets(self):
+        assert load_config(overrides=["exp=ff"]).mode == "forward_forward"
+        assert load_config(overrides=["exp=r"]).mode == "forward_reverse_partial"
+        assert load_config(overrides=["exp=ro"]).mode == "reverse_only"
+
+    def test_unknown_key_raises(self):
+        with pytest.raises(KeyError):
+            load_config(overrides=["bogus_key=1"])
+
+    def test_yaml_roundtrip(self, tmp_path):
+        import yaml
+
+        p = tmp_path / "cfg.yaml"
+        p.write_text(yaml.safe_dump({"batch_size": 16, "model": {"depth": 3}}))
+        cfg = load_config(str(p), overrides=["model.heads=4"])
+        assert cfg.batch_size == 16 and cfg.model.depth == 3 and cfg.model.heads == 4
+
+
+class TestCheckpointFormats:
+    def test_npz_roundtrip(self, tmp_path):
+        tree = {"a": {"kernel": np.ones((3, 4)), "bias": np.zeros(4)}, "b": np.arange(5)}
+        path = tmp_path / "ck.npz"
+        save_params_npz(str(path), tree, metadata={"epoch": 3})
+        loaded, meta = load_params_npz(str(path))
+        assert meta["epoch"] == 3
+        np.testing.assert_array_equal(loaded["a"]["kernel"], tree["a"]["kernel"])
+        np.testing.assert_array_equal(loaded["b"], tree["b"])
+
+    def test_orbax_manager_rotation_and_resume(self, tmp_path):
+        from dalle_pytorch_tpu.training import TrainState, make_optimizer
+
+        params = {"w": jnp.ones((4, 4))}
+        state = TrainState.create(
+            apply_fn=lambda *a: None, params=params, tx=make_optimizer(1e-3)
+        )
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep_n=2)
+        for step in (1, 2, 3):
+            mgr.save(
+                step,
+                state.replace(step=step),
+                metadata={"epoch": step},
+            )
+        mgr.wait()
+        assert mgr.latest_step() == 3
+        restored, meta, step = mgr.restore(state)
+        assert step == 3 and meta["epoch"] == 3
+        assert int(restored.step) == 3
+        # rotation: keep_n=2 -> step 1 gone
+        steps = sorted(int(p.name) for p in (tmp_path / "ck").iterdir() if p.name.isdigit())
+        assert steps == [2, 3]
+        mgr.close()
+
+
+@pytest.mark.slow
+class TestCliEndToEnd:
+    def run_cli(self, script, *cli_args, cwd):
+        env = dict(os.environ)
+        env["DALLE_TPU_FORCE_PLATFORM"] = "cpu"
+        env.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        result = subprocess.run(
+            [sys.executable, str(REPO / script), *cli_args],
+            cwd=cwd, env=env, capture_output=True, text=True, timeout=900,
+        )
+        assert result.returncode == 0, (
+            f"{script} failed:\nSTDOUT:{result.stdout[-3000:]}\n"
+            f"STDERR:{result.stderr[-3000:]}"
+        )
+        return result.stdout
+
+    def test_full_flow(self, tmp_path):
+        common = [
+            "--set", "vae.image_size=16", "--set", "vae.num_layers=2",
+            "--set", "vae.num_tokens=32", "--set", "vae.codebook_dim=16",
+            "--set", "vae.hidden_dim=16", "--set", "debug=true",
+        ]
+        # 1. train dVAE on rainbow
+        out = self.run_cli(
+            "train_vae.py", "--image_folder", "rainbow:64", "--epochs", "1",
+            "--batch_size", "8", "--output", str(tmp_path / "vae.npz"),
+            *common, cwd=tmp_path,
+        )
+        assert (tmp_path / "vae.npz").exists()
+        assert "64 images for training" in out
+
+        # 2. train DALLE (forward_forward exercises the inverse objective).
+        # NOTE: deliberately does NOT repeat the vae.* overrides — the
+        # checkpoint must carry the actual VAE hparams from vae.npz
+        # (regression: generate once rebuilt the VAE from stale cfg.vae).
+        out = self.run_cli(
+            "train_dalle.py", "--image_text_folder", "rainbow:64",
+            "--vae_path", str(tmp_path / "vae.npz"),
+            "--epochs", "1", "--batch_size", "8", "--exp", "ff",
+            "--set", "model.dim=64", "--set", "model.depth=2",
+            "--set", "model.heads=2", "--set", "model.dim_head=16",
+            "--set", "model.text_seq_len=32", "--set", "model.rotary_emb=true",
+            "--set", "model.shift_tokens=true", "--set", "save_every_n_steps=5",
+            "--set", "log_images_freq=0", "--set", "bf16=false",
+            "--set", "debug=true", cwd=tmp_path,
+        )
+        ckpt = tmp_path / "checkpoints" / "dalle.npz"
+        assert ckpt.exists()
+
+        # 3. resume for one more epoch from the checkpoint
+        self.run_cli(
+            "train_dalle.py", "--image_text_folder", "rainbow:64",
+            "--dalle_path", str(ckpt), "--epochs", "2", "--batch_size", "8",
+            cwd=tmp_path,
+        )
+
+        # 4. generate images from two prompts
+        self.run_cli(
+            "generate.py", "--dalle_path", str(ckpt),
+            "--text", "small red circle|large blue square",
+            "--num_images", "2", "--batch_size", "2",
+            "--outputs_dir", str(tmp_path / "outputs"), cwd=tmp_path,
+        )
+        grids = list((tmp_path / "outputs").rglob("grid.png"))
+        assert len(grids) == 2
+        pngs = list((tmp_path / "outputs").rglob("[0-9].png"))
+        assert len(pngs) == 4

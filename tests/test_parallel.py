@@ -1,0 +1,133 @@
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dalle_pytorch_tpu.parallel import (
+    make_mesh,
+    batch_sharding,
+    partition_params,
+    state_shardings,
+    ring_attention,
+)
+from dalle_pytorch_tpu.parallel.ring import ring_attention_sharded
+from dalle_pytorch_tpu.ops.attention_core import dense_attention
+
+
+class TestMesh:
+    def test_make_mesh_fills_dp(self):
+        mesh = make_mesh(fsdp=2, tp=2)
+        assert dict(mesh.shape) == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1}
+
+    def test_make_mesh_all_axes(self):
+        mesh = make_mesh(dp=1, fsdp=2, tp=2, sp=2)
+        assert dict(mesh.shape) == {"dp": 1, "fsdp": 2, "tp": 2, "sp": 2}
+
+    def test_bad_mesh_raises(self):
+        with pytest.raises(AssertionError):
+            make_mesh(dp=3, fsdp=3)
+
+
+class TestPartition:
+    def test_rules(self):
+        from dalle_pytorch_tpu.models.dalle import DALLE
+
+        model = DALLE(
+            dim=32, depth=1, num_image_tokens=16, image_fmap_size=4,
+            num_text_tokens=26, text_seq_len=6, heads=2, dim_head=8,
+        )
+        text = jnp.zeros((1, 6), jnp.int32)
+        img = jnp.zeros((1, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), text, img)["params"]
+        mesh = make_mesh(dp=2, fsdp=2, tp=2)
+        shardings = partition_params(params, mesh)
+
+        flat = {
+            "/".join(str(k.key) for k in path): s
+            for path, s in jax.tree_util.tree_flatten_with_path(shardings)[0]
+        }
+        qkv = next(v for k, v in flat.items() if "to_qkv/kernel" in k)
+        assert qkv.spec == P("fsdp", "tp")
+        out = next(v for k, v in flat.items() if "to_out/kernel" in k)
+        assert out.spec == P("tp", "fsdp")
+        scale = next(v for k, v in flat.items() if "scale" in k)
+        assert scale.spec == P()
+
+    def test_nondivisible_dims_fall_back_to_replicated(self):
+        mesh = make_mesh(dp=1, fsdp=4, tp=2)
+        params = {"to_qkv": {"kernel": jnp.zeros((6, 10))}}  # 6 % 4 != 0
+        sh = partition_params(params, mesh)
+        assert sh["to_qkv"]["kernel"].spec == P(None, "tp")
+
+
+class TestRingAttention:
+    def test_matches_dense_causal(self):
+        mesh = make_mesh(dp=1, sp=8)
+        b, h, n, d = 2, 2, 32, 8
+        rng = jax.random.PRNGKey(0)
+        q, k, v = jax.random.normal(rng, (3, b, h, n, d))
+
+        out_ring = ring_attention_sharded(mesh, q, k, v, causal=True)
+
+        causal = jnp.tril(jnp.ones((n, n), bool))[None, None]
+        out_dense = dense_attention(q, k, v, mask=causal)
+        np.testing.assert_allclose(
+            np.asarray(out_ring), np.asarray(out_dense), rtol=2e-4, atol=2e-5
+        )
+
+    def test_noncausal_matches_dense(self):
+        mesh = make_mesh(dp=2, sp=4)
+        q, k, v = jax.random.normal(jax.random.PRNGKey(1), (3, 2, 2, 16, 8))
+        out_ring = ring_attention_sharded(mesh, q, k, v, causal=False)
+        out_dense = dense_attention(q, k, v, mask=None)
+        np.testing.assert_allclose(
+            np.asarray(out_ring), np.asarray(out_dense), rtol=2e-4, atol=2e-5
+        )
+
+
+class TestShardedTrainStep:
+    def test_sharded_step_matches_unsharded(self):
+        """dp2 x fsdp2 x tp2 sharded step == single-device step, bitwise-ish.
+
+        This is the real replacement for the reference's DummyBackend test
+        seam: the same step function, sharded vs not, must agree.
+        """
+        from dalle_pytorch_tpu.models.dalle import DALLE
+        from dalle_pytorch_tpu.training import TrainState, make_optimizer, make_dalle_train_step
+
+        model = DALLE(
+            dim=32, depth=2, num_image_tokens=16, image_fmap_size=4,
+            num_text_tokens=26, text_seq_len=6, heads=2, dim_head=8,
+        )
+        text = jax.random.randint(jax.random.PRNGKey(0), (8, 6), 1, 26)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 16)
+        batch = {"text": text, "image_tokens": tokens}
+        params = model.init(jax.random.PRNGKey(2), text, tokens)["params"]
+        tx = make_optimizer(1e-3, clip_grad_norm=0.5)
+        state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+        step = make_dalle_train_step(model)
+        rng = jax.random.PRNGKey(3)
+
+        ref_state, ref_metrics = jax.jit(step)(state, batch, rng)
+
+        mesh = make_mesh(dp=2, fsdp=2, tp=2)
+        state_sh = state_shardings(state, mesh)
+        bs = batch_sharding(mesh)
+        batch_sh = {k: jax.device_put(v, bs) for k, v in batch.items()}
+        sharded_state = jax.device_put(state, state_sh)
+        sharded_step = jax.jit(
+            step, in_shardings=(state_sh, {k: bs for k in batch}, None),
+            out_shardings=(state_sh, None),
+        )
+        new_state, metrics = sharded_step(sharded_state, batch_sh, rng)
+
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(ref_metrics["loss"]), rtol=1e-5
+        )
+        for a, b in zip(
+            jax.tree.leaves(ref_state.params), jax.tree.leaves(new_state.params)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )

@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Train DALL-E (TPU-native train_dalle).
+
+Equivalent of `/root/reference/train_dalle.py`: resumes/builds the frozen
+VAE and the DALLE transformer, streams host-sharded batches, runs the
+jitted+sharded train step (forward and optional inverse objectives,
+`:509-518`), logs loss/throughput/samples, checkpoints with rotation, and
+steps a plateau LR scheduler per epoch (`:344-353,589-590`).
+
+Usage:
+  python train_dalle.py --image_text_folder <dir|rainbow[:N]|shards.tar>
+      [--config cfg.yaml] [--exp ff] [--vae_path vae.npz]
+      [--set model.depth=4] [--set mesh.fsdp=2] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", type=str, default=None)
+    p.add_argument("--image_text_folder", type=str, default=None)
+    p.add_argument("--vae_path", type=str, default=None)
+    p.add_argument("--dalle_path", type=str, default=None, help="resume checkpoint")
+    p.add_argument("--taming", action="store_true")
+    p.add_argument("--exp", type=str, default=None, choices=["f", "ff", "r", "ro"])
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--learning_rate", type=float, default=None)
+    p.add_argument("--debug", action="store_true")
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="config override, e.g. --set model.depth=4",
+    )
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    import jax
+    import os as _os
+
+    if _os.environ.get("DALLE_TPU_FORCE_PLATFORM"):
+        jax.config.update("jax_platforms", _os.environ["DALLE_TPU_FORCE_PLATFORM"])
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models.dalle import generate_images
+    from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+    from dalle_pytorch_tpu.parallel import (
+        make_mesh, batch_sharding, state_shardings, partition_params, is_root,
+    )
+    from dalle_pytorch_tpu.training import (
+        TrainState, make_optimizer, make_dalle_train_step, ReduceLROnPlateau,
+        set_learning_rate, get_learning_rate,
+    )
+    from dalle_pytorch_tpu.training.config import load_config
+    from dalle_pytorch_tpu.training.checkpoint import CheckpointManager
+    from dalle_pytorch_tpu.training.metrics import (
+        MetricsLogger, ThroughputMeter, ProfilerHook,
+    )
+    from dalle_pytorch_tpu.training.pipeline import (
+        build_tokenizer, build_dataset, build_vae, dalle_from_config,
+        save_dalle_checkpoint, load_dalle_checkpoint,
+    )
+    from dalle_pytorch_tpu.utils import param_count
+
+    cfg = load_config(args.config, args.set)
+    resume_meta = None
+    if args.dalle_path:  # RESUME (`train_dalle.py:139-161`)
+        cfg, dalle_params_resume, vae_params_resume, resume_meta = (
+            load_dalle_checkpoint(args.dalle_path)
+        )
+        for ov in args.set:
+            k, v = ov.split("=", 1)
+            from dalle_pytorch_tpu.training.config import _set_dotted
+
+            _set_dotted(cfg, k.strip(), v.strip())
+    for k in ("epochs", "batch_size", "learning_rate", "image_text_folder",
+              "vae_path", "exp"):
+        v = getattr(args, k)
+        if v is not None:
+            setattr(cfg, k, v)
+    if args.taming:
+        cfg.taming = True
+    if args.debug:
+        cfg.debug = True
+    cfg.resolve()
+
+    tokenizer = build_tokenizer(cfg)
+    vae, vae_params = build_vae(cfg)
+    if args.dalle_path and vae_params_resume is not None:
+        vae_params = vae_params_resume
+    image_fmap_size = vae.image_size // (2 ** vae.num_layers)
+    dataset = build_dataset(cfg, tokenizer, image_size=vae.image_size)
+    print(f"{len(dataset)} image-text pairs for training")
+
+    model = dalle_from_config(
+        cfg,
+        num_image_tokens=vae.num_tokens,
+        image_fmap_size=image_fmap_size,
+        vocab_size=max(tokenizer.vocab_size, 1),
+    )
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    rng, init_rng = jax.random.split(rng)
+    t0 = jnp.zeros((1, cfg.model.text_seq_len), jnp.int32)
+    i0 = jnp.zeros((1, image_fmap_size**2), jnp.int32)
+    params = model.init(init_rng, t0, i0)["params"]
+    if args.dalle_path:
+        params = dalle_params_resume
+    print(f"{param_count(params):,} parameters")
+
+    state = TrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=make_optimizer(cfg.learning_rate, clip_grad_norm=cfg.clip_grad_norm),
+    )
+
+    mesh = make_mesh(
+        dp=cfg.mesh.dp, fsdp=cfg.mesh.fsdp, tp=cfg.mesh.tp, sp=cfg.mesh.sp
+    )
+    state_sh = state_shardings(state, mesh)
+    txt_sh = batch_sharding(mesh, extra_dims=1)
+    state = jax.device_put(state, state_sh)
+
+    in_step_encode = isinstance(vae, DiscreteVAE)
+    if in_step_encode:
+        img_sh = batch_sharding(mesh, extra_dims=3)
+        vae_sh = partition_params(vae_params, mesh)
+        vae_params = jax.device_put(vae_params, vae_sh)
+        batch_shardings = {"text": txt_sh, "images": img_sh}
+        step_fn = jax.jit(
+            make_dalle_train_step(
+                model, vae=vae, mode=cfg.mode, grad_accum=cfg.ga_steps,
+                null_cond_prob=cfg.null_cond_prob,
+            ),
+            in_shardings=(state_sh, batch_shardings, None, vae_sh),
+            out_shardings=(state_sh, None),
+            donate_argnums=0,
+        )
+    else:
+        # pretrained torch-backed VAE: encode on host, feed tokens
+        batch_shardings = {"text": txt_sh, "image_tokens": txt_sh}
+        step_fn = jax.jit(
+            make_dalle_train_step(
+                model, mode=cfg.mode, grad_accum=cfg.ga_steps,
+                null_cond_prob=cfg.null_cond_prob,
+            ),
+            in_shardings=(state_sh, batch_shardings, None),
+            out_shardings=(state_sh, None),
+            donate_argnums=0,
+        )
+
+    run_dir = Path(cfg.output_dir)
+    ckpt = CheckpointManager(run_dir / "dalle_ckpt", keep_n=cfg.keep_n_checkpoints)
+    logger = MetricsLogger(
+        project=cfg.wandb_name, config={"cli": "train_dalle"},
+        enabled=is_root(), debug=cfg.debug, out_dir=str(run_dir / "logs"),
+    )
+    meter = ThroughputMeter()
+    profiler = ProfilerHook(cfg.flops_profiler)
+    plateau = ReduceLROnPlateau() if cfg.lr_decay else None
+
+    from dalle_pytorch_tpu.training.pipeline import dvae_hparams
+
+    def export(path: Path, epoch: int):
+        if is_root():
+            save_dalle_checkpoint(
+                str(path), cfg, jax.device_get(state.params),
+                None if not in_step_encode else jax.device_get(vae_params),
+                epoch, type(vae).__name__,
+                vae_hparams=dvae_hparams(vae) if in_step_encode else None,
+            )
+
+    # fail-early smoke save (`train_dalle.py:488-491`)
+    out_file = run_dir / f"{cfg.dalle_output_file_name}.npz"
+    resume_epoch = (resume_meta or {}).get("epoch", 0)
+    export(out_file, resume_epoch)
+
+    global_step = 0
+    shard = (jax.process_index(), jax.process_count())
+    stop = False
+    for epoch in range(resume_epoch, cfg.epochs):
+        if stop:
+            break
+        epoch_losses = []
+        last_loss = None
+        for batch in dataset.batches(
+            cfg.batch_size, shuffle_seed=cfg.seed + epoch, shard=shard
+        ):
+            profiler.before_step(global_step)
+            if in_step_encode:
+                dev_batch = {
+                    "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
+                    "images": jax.device_put(
+                        jnp.asarray(batch["images"]), batch_shardings["images"]
+                    ),
+                }
+                rng, r = jax.random.split(rng)
+                state, metrics = step_fn(state, dev_batch, r, vae_params)
+            else:
+                tokens = vae.get_codebook_indices(jnp.asarray(batch["images"]))
+                dev_batch = {
+                    "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
+                    "image_tokens": jax.device_put(tokens, txt_sh),
+                }
+                rng, r = jax.random.split(rng)
+                state, metrics = step_fn(state, dev_batch, r)
+
+            global_step += 1
+            last_loss = metrics["loss"]  # lazy device scalar; no sync here
+            log = {}
+            if global_step % 10 == 0:
+                step_loss = float(last_loss)
+                epoch_losses.append(step_loss)
+                log.update(
+                    epoch=epoch, iter=global_step, loss=step_loss,
+                    forward_loss=float(metrics.get("forward_loss", 0.0)),
+                    inverse_loss=float(metrics.get("inverse_loss", 0.0)),
+                )
+                if "accuracy" in metrics:
+                    log["accuracy"] = float(metrics["accuracy"])
+                print(epoch, global_step, f"loss - {step_loss:.5f}")
+
+            if global_step % cfg.save_every_n_steps == 0:
+                ckpt.save(
+                    global_step, jax.device_get(state),
+                    metadata={"epoch": epoch, "step": global_step},
+                )
+
+            if cfg.log_images_freq and global_step % cfg.log_images_freq == 0 \
+                    and is_root() and in_step_encode:
+                rng, gr = jax.random.split(rng)
+                toks = generate_images(
+                    model, {"params": state.params},
+                    gr, jnp.asarray(batch["text"][:1]), filter_thres=0.9,
+                )
+                image = vae.apply(
+                    {"params": vae_params}, toks, method=DiscreteVAE.decode
+                )
+                caption = tokenizer.decode(batch["text"][0])
+                logger.log_images(
+                    np.asarray(image) * 0.5 + 0.5, caption, "image", global_step
+                )
+
+            rate = meter.update(global_step, cfg.batch_size)
+            if rate is not None:
+                log["sample_per_sec"] = rate
+                print(epoch, global_step, f"sample_per_sec - {rate:.2f}")
+            if log:
+                logger.log(log, step=global_step)
+            if profiler.after_step(global_step):
+                print("Profiler has finished running. Stopping training early.")
+                stop = True
+                break
+
+        if plateau is not None and last_loss is not None:
+            # epoch-average of the sampled losses (+ the final step), the
+            # reference's scheduler signal (`train_dalle.py:589-590`)
+            epoch_losses.append(float(last_loss))
+            new_lr = plateau.step(
+                float(np.mean(epoch_losses)), get_learning_rate(state)
+            )
+            state = set_learning_rate(state, new_lr)
+        export(out_file, epoch)
+
+    export(out_file, cfg.epochs)
+    ckpt.wait()
+    logger.finish()
+    print(f"final checkpoint -> {out_file}")
+
+
+if __name__ == "__main__":
+    main()

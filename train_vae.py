@@ -1,0 +1,171 @@
+#!/usr/bin/env python
+"""Train the DiscreteVAE (TPU-native train_vae).
+
+Equivalent of `/root/reference/train_vae.py`: dVAE training with gumbel
+temperature annealing (`:278`), exponential LR decay (`:158`),
+reconstruction grids + codebook-usage histogram every 100 steps
+(`:252-271`), per-epoch checkpoints. The whole optimizer step is one jitted
+XLA program, sharded over the data axes of the device mesh.
+
+Usage:
+  python train_vae.py --image_folder <dir|rainbow[:N]> [--config cfg.yaml]
+      [--set vae.num_tokens=1024] [--set learning_rate=1e-3] ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", type=str, default=None, help="YAML config file")
+    p.add_argument("--image_folder", type=str, default=None)
+    p.add_argument(
+        "--set", action="append", default=[], metavar="KEY=VALUE",
+        help="config override, e.g. --set vae.num_tokens=1024",
+    )
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--batch_size", type=int, default=None)
+    p.add_argument("--learning_rate", type=float, default=None)
+    p.add_argument("--output", type=str, default="vae.npz")
+    p.add_argument("--lr_decay_rate", type=float, default=0.98)
+    p.add_argument("--debug", action="store_true")
+    return p.parse_args()
+
+
+def main():
+    args = parse_args()
+    import jax
+    import os as _os
+
+    if _os.environ.get("DALLE_TPU_FORCE_PLATFORM"):
+        jax.config.update("jax_platforms", _os.environ["DALLE_TPU_FORCE_PLATFORM"])
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.parallel import make_mesh, batch_sharding, state_shardings, is_root
+    from dalle_pytorch_tpu.training import (
+        TrainState, make_optimizer, make_vae_train_step, ExponentialDecay,
+        set_learning_rate, get_learning_rate,
+    )
+    from dalle_pytorch_tpu.training.config import load_config
+    from dalle_pytorch_tpu.training.metrics import MetricsLogger, ThroughputMeter
+    from dalle_pytorch_tpu.training.pipeline import (
+        build_tokenizer, build_dataset, vae_from_config, save_vae_checkpoint,
+    )
+
+    cfg = load_config(args.config, args.set)
+    for k in ("epochs", "batch_size", "learning_rate"):
+        v = getattr(args, k)
+        if v is not None:
+            setattr(cfg, k, v)
+    if args.image_folder:
+        cfg.image_text_folder = args.image_folder
+    if args.debug:
+        cfg.debug = True
+
+    vae = vae_from_config(cfg.vae)
+    tokenizer = build_tokenizer(cfg)
+    dataset = build_dataset(cfg, tokenizer, image_size=cfg.vae.image_size)
+    print(f"{len(dataset)} images for training")
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    rng, init_rng, gumbel_rng = jax.random.split(rng, 3)
+    sample = jnp.zeros((1, cfg.vae.image_size, cfg.vae.image_size, cfg.vae.channels))
+    params = vae.init({"params": init_rng, "gumbel": gumbel_rng}, sample)["params"]
+    state = TrainState.create(
+        apply_fn=vae.apply, params=params, tx=make_optimizer(cfg.learning_rate)
+    )
+
+    mesh = make_mesh(
+        dp=cfg.mesh.dp, fsdp=cfg.mesh.fsdp, tp=cfg.mesh.tp, sp=cfg.mesh.sp
+    )
+    state_sh = state_shardings(state, mesh)
+    img_sh = batch_sharding(mesh, extra_dims=3)
+    state = jax.device_put(state, state_sh)
+    step_fn = jax.jit(
+        make_vae_train_step(vae, grad_accum=cfg.ga_steps),
+        in_shardings=(state_sh, img_sh, None, None),
+        out_shardings=(state_sh, None),
+        donate_argnums=0,
+    )
+
+    logger = MetricsLogger(
+        project=cfg.project, config={"cli": "train_vae"},
+        enabled=is_root(), debug=cfg.debug, out_dir=str(Path(cfg.output_dir) / "vae_logs"),
+    )
+    meter = ThroughputMeter()
+    sched = ExponentialDecay(gamma=args.lr_decay_rate) if cfg.lr_decay else None
+
+    temp = cfg.vae.temperature
+    global_step = 0
+    shard = (jax.process_index(), jax.process_count())
+    for epoch in range(cfg.epochs):
+        for batch in dataset.batches(cfg.batch_size, shuffle_seed=epoch, shard=shard):
+            images = jax.device_put(jnp.asarray(batch["images"]), img_sh)
+            rng, r = jax.random.split(rng)
+            state, metrics = step_fn(state, images, r, jnp.float32(temp))
+            global_step += 1
+
+            log = {}
+            if global_step % 100 == 0:
+                # recon grids: soft (gumbel) + hard (argmax->decode)
+                k = min(4, images.shape[0])
+                soft = vae.apply(
+                    {"params": state.params}, images[:k], temp=temp,
+                    rngs={"gumbel": r},
+                )
+                codes = vae.apply(
+                    {"params": state.params}, images[:k],
+                    method=type(vae).get_codebook_indices,
+                )
+                hard = vae.apply({"params": state.params}, codes, method=type(vae).decode)
+                # codebook usage histogram (`train_vae.py:256-260`)
+                usage = np.bincount(
+                    np.asarray(codes).ravel(), minlength=cfg.vae.num_tokens
+                )
+                grid = np.concatenate(
+                    [np.asarray(images[:k]), np.asarray(soft) * 0.5 + 0.5,
+                     np.asarray(hard) * 0.5 + 0.5], axis=0
+                )
+                logger.log_images(grid, "orig | soft | hard", "recons", global_step)
+                # temperature anneal (`train_vae.py:278`)
+                temp = max(
+                    temp * math.exp(-cfg.vae.anneal_rate * global_step),
+                    cfg.vae.temp_min,
+                )
+                if sched is not None:
+                    state = set_learning_rate(
+                        state, sched.step(0.0, get_learning_rate(state))
+                    )
+                log.update(
+                    temperature=temp,
+                    lr=get_learning_rate(state),
+                    codebook_usage_frac=float((usage > 0).mean()),
+                )
+
+            rate = meter.update(global_step, cfg.batch_size)
+            if rate is not None:
+                log["sample_per_sec"] = rate
+            if global_step % 10 == 0:
+                log["loss"] = float(metrics["loss"])
+                print(epoch, global_step, f"loss - {log['loss']:.5f}")
+            if log:
+                logger.log(log, step=global_step)
+
+        if is_root():
+            save_vae_checkpoint(args.output, vae, jax.device_get(state.params), epoch)
+            print(f"epoch {epoch} done; checkpoint -> {args.output}")
+
+    if is_root():
+        save_vae_checkpoint(args.output, vae, jax.device_get(state.params), cfg.epochs)
+    logger.finish()
+
+
+if __name__ == "__main__":
+    main()
