@@ -82,23 +82,32 @@ class Attention(nn.Module):
 
         new_cache = None
         if cache is not None:
-            # single-token decode step (n == 1) against a fixed-shape cache
+            # n-token chunk (prefill or single-token decode) written into a
+            # fixed-shape cache at sequence position `index`
             index = cache["index"]
             if rotary is not None:
-                rot = lax.dynamic_slice_in_dim(rotary, index, 1, axis=0)
-                rot = jnp.expand_dims(rot, (0, 1))  # [1,1,1,dr]
+                rot = lax.dynamic_slice_in_dim(rotary, index, n, axis=0)
+                rot = jnp.expand_dims(rot, (0, 1))  # [1,1,n,dr]
                 q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
             ck = _cache_write(cache["k"], k, index)
             cv = _cache_write(cache["v"], v, index)
             max_len = ck.shape[2]
-            valid = jnp.arange(max_len) <= index
-            mask = valid[None, None, None, :]
+            # query row i sits at global position index + i: causal over the
+            # written prefix (the reference instead relies on only having
+            # written the prefix, `attention.py:71-76,86`)
+            valid = jnp.arange(max_len)[None, :] <= index + jnp.arange(n)[:, None]
+            mask = valid[None, None]
             if self.static_mask is not None:
-                sm = jnp.asarray(self.static_mask[:max_len, :max_len])
-                row = lax.dynamic_slice_in_dim(sm, index, 1, axis=0)[0]
-                mask = mask & row[None, None, None, :]
+                sm = np.asarray(self.static_mask)
+                if sm.shape[0] < max_len:  # decode caches may be 1 longer
+                    pad = max_len - sm.shape[0]
+                    sm = np.pad(sm, ((0, pad), (0, pad)), constant_values=True)
+                rows = lax.dynamic_slice_in_dim(
+                    jnp.asarray(sm[:, :max_len]), index, n, axis=0
+                )
+                mask = mask & rows[None, None]
             out = dense_attention(q, ck, cv, mask=mask, stable=self.stable)
-            new_cache = {"k": ck, "v": cv, "index": index + 1}
+            new_cache = {"k": ck, "v": cv, "index": index + n}
         else:
             if rotary is not None:
                 rot = jnp.expand_dims(rotary[:n], (0, 1))

@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import flax.linen as nn
 
-from dalle_pytorch_tpu.models.transformer import Transformer, DivideMax
+from dalle_pytorch_tpu.models.transformer import Transformer, DivideMax, make_decode_cache
 from dalle_pytorch_tpu.ops.sampling import top_k_filter, gumbel_sample
 
 NEG_MASK_VALUE = -float(np.finfo(np.float32).max)
@@ -284,6 +284,127 @@ class DALLE(nn.Module):
 
         return loss, accuracy
 
+    # ------------------------------------------------ cached decode methods
+
+    def decode_prefill(self, text: jnp.ndarray, cache: dict):
+        """Run the text prefix (bos + text) through the transformer, filling
+        the decode cache. Returns (last-position logits [B, V], cache) — the
+        logits for image slot 0."""
+        _, tokens = self.embed_text(text, null_cond_prob=0.0)
+        out, cache = self.transformer(tokens, cache=cache)
+        logits = self.to_logits(out[:, -1:])  # only the last row is needed
+        return logits[:, 0].astype(jnp.float32), cache
+
+    def decode_image_step(self, img_token: jnp.ndarray, image_pos, cache: dict):
+        """Feed one sampled image token (grid index `image_pos`, traced);
+        returns (next-position logits [B, V], cache)."""
+        emb = self.image_emb(img_token[:, None].astype(jnp.int32))
+        if not self.rotary_emb:
+            table = self.image_pos_emb(self.image_seq_len)
+            row = jax.lax.dynamic_slice_in_dim(
+                table, jnp.clip(image_pos, 0, self.image_seq_len - 1), 1, axis=0
+            )
+            emb = emb + row[None]
+        out, cache = self.transformer(emb, cache=cache)
+        return self.to_logits(out)[:, 0].astype(jnp.float32), cache
+
+
+def init_decode_cache(model: DALLE, batch: int, dtype=None) -> dict:
+    """Fixed-shape decode cache for `generate_images_cached`.
+
+    Sized total_seq_len + 1 so the scan can uniformly feed every sampled
+    token (the final write lands in the spare slot and its logits are
+    discarded)."""
+    return make_decode_cache(
+        depth=model.depth,
+        batch=batch,
+        max_len=model.total_seq_len + 1,
+        heads=model.heads,
+        dim_head=model.dim_head,
+        dim=model.dim,
+        image_fmap_size=model.image_fmap_size,
+        shift_tokens=model.shift_tokens,
+        dtype=model.dtype if dtype is None else dtype,
+    )
+
+
+def generate_images_cached(
+    model: DALLE,
+    variables,
+    rng: jax.Array,
+    text: jnp.ndarray,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    cond_scale: float = 1.0,
+    init_image_tokens: Optional[jnp.ndarray] = None,
+    num_init_img_tokens: Optional[int] = None,
+):
+    """KV-cached autoregressive sampling: O(seq) attention per generated
+    token instead of `generate_images`' full re-forward (the reference's
+    `use_cache=True` path, `dalle_pytorch.py:652-653`, `attention.py:71-76`).
+
+    Prefills the text prefix once, then `lax.scan`s single-token decode
+    steps against the fixed-shape cache (KV + token-shift rings).
+    Classifier-free guidance (cond_scale != 1) stacks a null-text stream
+    along the batch axis — one model call serves both — and blends logits
+    per step (`dalle_pytorch.py:575-585`)."""
+    b = text.shape[0]
+    image_seq_len = model.image_seq_len
+    use_null = cond_scale != 1.0
+
+    primed = 0
+    img_tokens = jnp.zeros((b, image_seq_len), dtype=jnp.int32)
+    if init_image_tokens is not None:
+        primed = (
+            int(0.4375 * image_seq_len)
+            if num_init_img_tokens is None
+            else num_init_img_tokens
+        )
+        assert primed < image_seq_len
+        img_tokens = img_tokens.at[:, :primed].set(init_image_tokens[:, :primed])
+
+    def blend(row):
+        if not use_null:
+            return row
+        cond, null = row[:b], row[b:]
+        return null + (cond - null) * cond_scale
+
+    if use_null:
+        # null conditioning == all-pad text (`:602-604`), stacked on batch
+        text = jnp.concatenate([text, jnp.zeros_like(text)], axis=0)
+    row, cache = model.apply(
+        variables,
+        text,
+        init_decode_cache(model, text.shape[0]),
+        method=DALLE.decode_prefill,
+    )
+
+    # image-range logits mask (rows text_seq_len.. of `_logits_mask` are all
+    # identical: only image-vocab ids are allowed)
+    blocked = jnp.asarray(
+        np.arange(model.total_tokens) < model.total_text_tokens
+    )[None]
+
+    def step(carry, i):
+        img_tokens, cache, row, rng = carry
+        rng, sample_rng = jax.random.split(rng)
+        masked = jnp.where(blocked, NEG_MASK_VALUE, blend(row))
+        filtered = top_k_filter(masked, thres=filter_thres)
+        sample = gumbel_sample(sample_rng, filtered, temperature=temperature)
+        sample = (sample - model.total_text_tokens).astype(jnp.int32)
+        prev = jax.lax.dynamic_index_in_dim(img_tokens, i, axis=1, keepdims=False)
+        new = jnp.where(i < primed, prev, sample)
+        img_tokens = jax.lax.dynamic_update_slice(img_tokens, new[:, None], (0, i))
+        feed = jnp.concatenate([new, new], axis=0) if use_null else new
+        row, cache = model.apply(
+            variables, feed, i, cache, method=DALLE.decode_image_step
+        )
+        return (img_tokens, cache, row, rng), None
+
+    carry = (img_tokens, cache, row, rng)
+    (img_tokens, _, _, _), _ = jax.lax.scan(step, carry, jnp.arange(image_seq_len))
+    return img_tokens
+
 
 def forward_with_cond_scale(
     model: DALLE, variables, text, image, cond_scale: float = 1.0, rngs=None
@@ -319,8 +440,9 @@ def generate_images(
 
     Implementation: `lax.scan` over image positions; each step runs a full
     forward over the fixed-shape token buffer (causality makes the suffix
-    garbage irrelevant). A KV-cached fast path (using Transformer.init_cache)
-    is planned; this path is the correctness oracle it will be tested against.
+    garbage irrelevant). This path is the correctness oracle for the
+    KV-cached fast path, `generate_images_cached`, which is what production
+    callers should use.
     """
     b = text.shape[0]
     image_seq_len = model.image_seq_len

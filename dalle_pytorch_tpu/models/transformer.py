@@ -44,7 +44,11 @@ from dalle_pytorch_tpu.ops.masks import (
     block_layout_to_token_mask,
 )
 from dalle_pytorch_tpu.ops.rotary import build_dalle_rotary
-from dalle_pytorch_tpu.ops.shift import shift_tokens_dalle
+from dalle_pytorch_tpu.ops.shift import (
+    shift_tokens_dalle,
+    shift_ring_from_prefill,
+    shift_token_step,
+)
 
 
 def layerscale_init(layer_index: int) -> float:
@@ -236,47 +240,65 @@ class Transformer(nn.Module):
             else self.seq_len
         )
 
+    def _shift(self, h: jnp.ndarray, ring, pos):
+        """Token-shift h; in cached mode also maintain the ring buffer.
+
+        Uncached (ring is None): pure batch shift. Cached prefill (n > 1,
+        necessarily from position 0): batch shift + build the ring from the
+        trailing tokens. Cached decode (n == 1): streaming shift at traced
+        position `pos`.
+        """
+        fmap = self.image_fmap_size
+        assert fmap is not None
+        if ring is None:
+            return shift_tokens_dalle(h, self.text_len, fmap), None
+        if h.shape[1] > 1:
+            return shift_tokens_dalle(h, self.text_len, fmap), shift_ring_from_prefill(
+                h, fmap
+            )
+        return shift_token_step(h, ring, pos, self.text_len, fmap)
+
     def _layer(
         self,
         i: int,
         x: jnp.ndarray,
         key_mask,
-        cache,
+        layer_cache,
         deterministic: bool,
     ):
         """One (attn, ff) residual pair; returns (x, updated layer cache)."""
-        new_cache = {}
+        cached = layer_cache is not None
+        new_cache = {} if cached else None
+        pos = layer_cache["attn"]["index"] if cached else None
+
         h = self.attn_norms[i](x)
         if self.shift_tokens:
-            assert self.image_fmap_size is not None
-            if cache is not None:
-                raise NotImplementedError(
-                    "cached decode with token-shift needs the ring-buffer "
-                    "shift state (not yet wired); use the uncached "
-                    "generate_images path"
-                )
-            h = shift_tokens_dalle(h, self.text_len, self.image_fmap_size)
+            h, ring = self._shift(h, layer_cache.get("shift_attn") if cached else None, pos)
+            if cached:
+                new_cache["shift_attn"] = ring
         h, attn_cache = self.attn_layers[i](
             h,
             key_mask=key_mask,
             rotary=self.rotary_table,
-            cache=None if cache is None else cache[f"attn_{i}"],
+            cache=layer_cache["attn"] if cached else None,
             deterministic=deterministic,
         )
         if self.sandwich_norm:
             h = self.attn_norms_out[i](h)
         x = x + h * self.attn_scales[i].astype(h.dtype)
-        if attn_cache is not None:
-            new_cache[f"attn_{i}"] = attn_cache
+        if cached:
+            new_cache["attn"] = attn_cache
 
         h = self.ff_norms[i](x)
         if self.shift_tokens:
-            h = shift_tokens_dalle(h, self.text_len, self.image_fmap_size)
+            h, ring = self._shift(h, layer_cache.get("shift_ff") if cached else None, pos)
+            if cached:
+                new_cache["shift_ff"] = ring
         h = self.ff_layers[i](h, deterministic=deterministic)
         if self.sandwich_norm:
             h = self.ff_norms_out[i](h)
         x = x + h * self.ff_scales[i].astype(h.dtype)
-        return x, (new_cache or None)
+        return x, new_cache
 
     def __call__(
         self,
@@ -299,20 +321,58 @@ class Transformer(nn.Module):
 
                 x = nn.remat(layer_fn)(self, x)
             else:
-                x, layer_cache = self._layer(i, x, key_mask, cache, deterministic)
+                x, layer_cache = self._layer(
+                    i, x, key_mask, cache[f"layer_{i}"] if cache else None, deterministic
+                )
                 if layer_cache:
-                    new_cache.update(layer_cache)
+                    new_cache[f"layer_{i}"] = layer_cache
         if cache is not None:
             return x, new_cache
         return x
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32) -> dict:
-        """Fixed-shape KV cache pytree for autoregressive decoding."""
-        cache = {}
-        for i in range(self.depth):
-            cache[f"attn_{i}"] = {
-                "k": jnp.zeros((batch, self.heads, max_len, self.dim_head), dtype),
-                "v": jnp.zeros((batch, self.heads, max_len, self.dim_head), dtype),
+        """Fixed-shape decode cache pytree (KV + token-shift rings)."""
+        return make_decode_cache(
+            depth=self.depth,
+            batch=batch,
+            max_len=max_len,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            dim=self.dim,
+            image_fmap_size=self.image_fmap_size,
+            shift_tokens=self.shift_tokens,
+            dtype=dtype,
+        )
+
+
+def make_decode_cache(
+    depth: int,
+    batch: int,
+    max_len: int,
+    heads: int,
+    dim_head: int,
+    dim: int,
+    image_fmap_size: Optional[int] = None,
+    shift_tokens: bool = False,
+    dtype=jnp.float32,
+) -> dict:
+    """Decode cache pytree for a Transformer of this geometry.
+
+    Standalone (not a module method) so model owners like DALLE can build
+    it from config without binding parameters.
+    """
+    cache = {}
+    for i in range(depth):
+        layer = {
+            "attn": {
+                "k": jnp.zeros((batch, heads, max_len, dim_head), dtype),
+                "v": jnp.zeros((batch, heads, max_len, dim_head), dtype),
                 "index": jnp.zeros((), jnp.int32),
             }
-        return cache
+        }
+        if shift_tokens:
+            assert image_fmap_size is not None
+            layer["shift_attn"] = jnp.zeros((batch, image_fmap_size, dim), dtype)
+            layer["shift_ff"] = jnp.zeros((batch, image_fmap_size, dim), dtype)
+        cache[f"layer_{i}"] = layer
+    return cache

@@ -19,6 +19,7 @@ in the decode loop (see models/transformer.py cached path).
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 
 def shift_tokens_dalle(x: jnp.ndarray, text_len: int, image_fmap_size: int) -> jnp.ndarray:
@@ -50,3 +51,59 @@ def shift_tokens_dalle(x: jnp.ndarray, text_len: int, image_fmap_size: int) -> j
 
     x_img = x_img.reshape(b, img_seq_len, d)[:, :img_len]
     return jnp.concatenate([x_text, x_img], axis=1)
+
+
+# ------------------------------------------------- streaming (cached decode)
+#
+# The reference streams token-shift during cached inference with a python
+# deque of recent tokens (`transformer.py:140-155`). The jit/scan-friendly
+# equivalent is a ring buffer of the last `image_fmap_size` pre-shift token
+# vectors, indexed by global position mod fmap: the slot about to be
+# overwritten at position p holds exactly h[p - fmap] (the token one grid
+# row up), and slot (p-1) mod fmap holds h[p-1] (one position left).
+
+
+def shift_ring_from_prefill(h: jnp.ndarray, fmap: int) -> jnp.ndarray:
+    """Ring buffer after prefilling positions 0..n-1 with pre-shift values h."""
+    import numpy as np
+
+    b, n, d = h.shape
+    ring = jnp.zeros((b, fmap, d), h.dtype)
+    start = max(0, n - fmap)
+    slots = np.arange(start, n) % fmap  # static, distinct -> one scatter
+    return ring.at[:, slots].set(h[:, start:])
+
+
+def shift_token_step(
+    h: jnp.ndarray, ring: jnp.ndarray, pos: jnp.ndarray, text_len: int, fmap: int
+):
+    """One-token token-shift against the ring buffer.
+
+    h: [B, 1, D] pre-shift value of the token at global position `pos`
+    (traced scalar). Returns (shifted [B, 1, D], updated ring).
+    """
+    b, _, d = h.shape
+    half, q = d // 2, d // 4
+    cur = h[:, 0]
+
+    prev = lax.dynamic_slice_in_dim(ring, jnp.mod(pos - 1, fmap), 1, axis=1)[:, 0]
+    up = lax.dynamic_slice_in_dim(ring, jnp.mod(pos, fmap), 1, axis=1)[:, 0]
+
+    # text position: first half of channels from the previous token
+    t_first = jnp.where(pos > 0, prev[:, :half], jnp.zeros_like(prev[:, :half]))
+    text_shift = jnp.concatenate([t_first, cur[:, half:]], axis=-1)
+
+    # image position i (row r, col c): first quarter from one row up
+    # (i - fmap, valid when r > 0), second quarter from one col left
+    # (i - 1, valid when c > 0); both sources are image positions whenever
+    # valid, so text never leaks into the grid.
+    i = pos - text_len
+    top = jnp.where(i >= fmap, up[:, :q], jnp.zeros_like(up[:, :q]))
+    left = jnp.where(
+        jnp.mod(i, fmap) != 0, prev[:, q : 2 * q], jnp.zeros_like(prev[:, q : 2 * q])
+    )
+    img_shift = jnp.concatenate([top, left, cur[:, 2 * q :]], axis=-1)
+
+    out = jnp.where(pos < text_len, text_shift, img_shift)
+    ring = lax.dynamic_update_slice(ring, cur[:, None], (0, jnp.mod(pos, fmap), 0))
+    return out[:, None], ring

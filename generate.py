@@ -29,6 +29,11 @@ def parse_args():
     p.add_argument("--outputs_dir", type=str, default="outputs")
     p.add_argument("--gentxt", action="store_true")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--no_cache",
+        action="store_true",
+        help="use the full-reforward sampling oracle instead of KV-cached decode",
+    )
     return p.parse_args()
 
 
@@ -42,7 +47,7 @@ def main():
     import jax.numpy as jnp
 
     from dalle_pytorch_tpu.models.dalle import (
-        generate_images, generate_texts,
+        generate_images, generate_images_cached, generate_texts,
     )
     from dalle_pytorch_tpu.models.dvae import DiscreteVAE
     from dalle_pytorch_tpu.training.pipeline import (
@@ -102,7 +107,8 @@ def main():
         for start in range(0, args.num_images, args.batch_size):
             chunk = text[start : start + args.batch_size]
             rng, r = jax.random.split(rng)
-            toks = generate_images(
+            sample_fn = generate_images if args.no_cache else generate_images_cached
+            toks = sample_fn(
                 model, variables, r, chunk,
                 filter_thres=args.top_k, temperature=args.temperature,
                 cond_scale=args.cond_scale,
