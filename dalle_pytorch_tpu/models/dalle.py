@@ -104,6 +104,7 @@ class DALLE(nn.Module):
     img_loss_coeff: Optional[float] = None
     text_loss_coeff_inv: float = 7.0
     img_loss_coeff_inv: float = 1.0
+    attn_impl: str = "auto"  # "dense" | "flash" | "auto" (see models/attention.py)
     dtype: Any = jnp.float32
 
     @property
@@ -150,6 +151,7 @@ class DALLE(nn.Module):
             shared_attn_ids=self.shared_attn_ids,
             shared_ff_ids=self.shared_ff_ids,
             reversible=self.reversible,
+            attn_impl=self.attn_impl,
             dtype=self.dtype,
         )
 
@@ -328,6 +330,32 @@ def init_decode_cache(model: DALLE, batch: int, dtype=None) -> dict:
     )
 
 
+def _primed_image_tokens(
+    model: DALLE,
+    batch: int,
+    init_image_tokens: Optional[jnp.ndarray],
+    num_init_img_tokens: Optional[int],
+):
+    """Image-token buffer with the optional priming prefix written in.
+
+    The reference primes generation with the first 43.75% of a source
+    image's tokens by default (`dalle_pytorch.py:537-546`). Returns
+    (tokens [B, image_seq_len], primed_len).
+    """
+    image_seq_len = model.image_seq_len
+    img_tokens = jnp.zeros((batch, image_seq_len), dtype=jnp.int32)
+    primed = 0
+    if init_image_tokens is not None:
+        primed = (
+            int(0.4375 * image_seq_len)
+            if num_init_img_tokens is None
+            else num_init_img_tokens
+        )
+        assert primed < image_seq_len
+        img_tokens = img_tokens.at[:, :primed].set(init_image_tokens[:, :primed])
+    return img_tokens, primed
+
+
 def generate_images_cached(
     model: DALLE,
     variables,
@@ -351,17 +379,9 @@ def generate_images_cached(
     b = text.shape[0]
     image_seq_len = model.image_seq_len
     use_null = cond_scale != 1.0
-
-    primed = 0
-    img_tokens = jnp.zeros((b, image_seq_len), dtype=jnp.int32)
-    if init_image_tokens is not None:
-        primed = (
-            int(0.4375 * image_seq_len)
-            if num_init_img_tokens is None
-            else num_init_img_tokens
-        )
-        assert primed < image_seq_len
-        img_tokens = img_tokens.at[:, :primed].set(init_image_tokens[:, :primed])
+    img_tokens, primed = _primed_image_tokens(
+        model, b, init_image_tokens, num_init_img_tokens
+    )
 
     def blend(row):
         if not use_null:
@@ -446,17 +466,9 @@ def generate_images(
     """
     b = text.shape[0]
     image_seq_len = model.image_seq_len
-    img_tokens = jnp.zeros((b, image_seq_len), dtype=jnp.int32)
-
-    primed = 0
-    if init_image_tokens is not None:
-        primed = (
-            int(0.4375 * image_seq_len)
-            if num_init_img_tokens is None
-            else num_init_img_tokens
-        )
-        assert primed < image_seq_len
-        img_tokens = img_tokens.at[:, :primed].set(init_image_tokens[:, :primed])
+    img_tokens, primed = _primed_image_tokens(
+        model, b, init_image_tokens, num_init_img_tokens
+    )
 
     def step(carry, i):
         img_tokens, rng = carry

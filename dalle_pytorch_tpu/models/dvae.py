@@ -122,6 +122,10 @@ class DiscreteVAE(nn.Module):
 
     def encode_logits(self, img: jnp.ndarray) -> jnp.ndarray:
         """img: [B, H, W, C] -> token logits [B, h, w, num_tokens]."""
+        assert img.shape[1] == self.image_size and img.shape[2] == self.image_size, (
+            f"input must have the correct image size {self.image_size}, "
+            f"got {img.shape[1]}x{img.shape[2]}"
+        )
         x = self.norm(img)
         for conv in self.enc_convs:
             x = nn.relu(conv(x))

@@ -147,6 +147,7 @@ class Transformer(nn.Module):
     shared_attn_ids: Optional[Sequence[int]] = None
     shared_ff_ids: Optional[Sequence[int]] = None
     reversible: bool = False
+    attn_impl: str = "auto"  # "dense" | "flash" | "auto" (see models/attention.py)
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -181,6 +182,7 @@ class Transformer(nn.Module):
                     static_mask=_build_static_mask(
                         attn_type, self.seq_len, self.image_fmap_size, ind
                     ),
+                    attn_impl=self.attn_impl,
                     dtype=self.dtype,
                     name=f"attn_{attn_id}",
                 )

@@ -18,3 +18,4 @@ from dalle_pytorch_tpu.ops.attention_core import (
     stable_softmax,
     dense_attention,
 )
+from dalle_pytorch_tpu.ops.pallas_attention import flash_attention, mask_block_layout
