@@ -69,7 +69,9 @@ def main():
     )
     text = jnp.ones((batch, text_seq), jnp.int32)
     tokens = jnp.zeros((batch, image_seq), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), text, tokens)["params"]
+    # jit the init: eager init dispatches each op separately, which is
+    # painfully slow on remote/tunneled devices
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), text, tokens)["params"]
     state = TrainState.create(
         apply_fn=model.apply, params=params,
         tx=make_optimizer(3e-4, clip_grad_norm=0.5),

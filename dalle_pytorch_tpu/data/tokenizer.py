@@ -289,16 +289,61 @@ class YttmTokenizer(_TokenizerBase):
         )[0]
 
 
+class NativeBPETokenizer(_TokenizerBase):
+    """Framework-native C++ BPE (native/bpe.cpp via ctypes) — the in-repo
+    replacement for the reference's youtokentome C++ dependency
+    (`tokenizer.py:232-266`). Same tokenize/decode contract; batch encode
+    runs threaded in native code.
+    """
+
+    def __init__(self, bpe_path: Union[str, Path]):
+        from dalle_pytorch_tpu.data.native_bpe import NativeBPE
+
+        self.bpe = NativeBPE.load(bpe_path)
+        self.vocab_size = self.bpe.vocab_size
+
+    @classmethod
+    def train(cls, corpus: str, model_path: Union[str, Path], vocab_size: int = 8192):
+        from dalle_pytorch_tpu.data.native_bpe import NativeBPE
+
+        NativeBPE.train(corpus, vocab_size).save(model_path)
+        return cls(model_path)
+
+    def encode(self, text: str) -> List[int]:
+        return self.bpe.encode(_clean_text(text))
+
+    def tokenize(
+        self,
+        texts: Union[str, Sequence[str]],
+        context_length: int = 256,
+        truncate_text: bool = False,
+    ) -> np.ndarray:
+        if isinstance(texts, str):
+            texts = [texts]
+        return self.bpe.encode_batch(
+            [_clean_text(t) for t in texts], context_length, truncate=truncate_text
+        )
+
+    def decode(self, tokens, pad_tokens: set = frozenset()) -> str:
+        ids = [t for t in self._to_list(tokens) if t not in pad_tokens]
+        return self.bpe.decode(ids)
+
+
 def get_tokenizer(
     bpe_path: Optional[str] = None,
     hug: bool = False,
     chinese: bool = False,
     yttm: bool = False,
+    native: bool = False,
 ) -> _TokenizerBase:
     """Tokenizer selection mirroring the trainer flags
-    (`/root/reference/train_dalle.py:131-135`)."""
+    (`/root/reference/train_dalle.py:131-135`), plus the framework-native
+    C++ BPE backend."""
     if chinese:
         return ChineseTokenizer()
+    if native:
+        assert bpe_path, "--bpe_path required for native BPE tokenizer"
+        return NativeBPETokenizer(bpe_path)
     if yttm:
         assert bpe_path, "--bpe_path required for yttm tokenizer"
         return YttmTokenizer(bpe_path)

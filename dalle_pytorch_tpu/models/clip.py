@@ -119,3 +119,36 @@ class CLIP(nn.Module):
         labels = jnp.arange(b)
         loss = (cross_entropy(sim, labels) + cross_entropy(sim.T, labels)) / 2
         return loss
+
+
+def clip_scores(
+    clip: CLIP,
+    variables,
+    text: jnp.ndarray,
+    images: jnp.ndarray,
+    text_mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Per-pair CLIP similarity of (text[i], images[i]) — the quantity the
+    reference reranks generations with (`dalle_pytorch.py:569-571`)."""
+    return clip.apply(variables, text, images, text_mask=text_mask, return_loss=False)
+
+
+def rerank(
+    clip: CLIP,
+    variables,
+    text: jnp.ndarray,
+    images: jnp.ndarray,
+    text_mask: Optional[jnp.ndarray] = None,
+):
+    """Sort generated images (and scores) by descending CLIP similarity.
+
+    `text` is broadcast against images if a single prompt row is given.
+    Returns (sorted_images, sorted_scores, order).
+    """
+    if text.shape[0] == 1 and images.shape[0] > 1:
+        text = jnp.repeat(text, images.shape[0], axis=0)
+        if text_mask is not None:
+            text_mask = jnp.repeat(text_mask, images.shape[0], axis=0)
+    scores = clip_scores(clip, variables, text, images, text_mask)
+    order = jnp.argsort(-scores)
+    return images[order], scores[order], order

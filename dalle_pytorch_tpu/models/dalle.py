@@ -86,6 +86,7 @@ class DALLE(nn.Module):
     heads: int = 8
     dim_head: int = 64
     reversible: bool = False
+    reversible_impl: str = "remat"
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     attn_types: Optional[Sequence[str]] = None
@@ -151,6 +152,7 @@ class DALLE(nn.Module):
             shared_attn_ids=self.shared_attn_ids,
             shared_ff_ids=self.shared_ff_ids,
             reversible=self.reversible,
+            reversible_impl=self.reversible_impl,
             attn_impl=self.attn_impl,
             dtype=self.dtype,
         )

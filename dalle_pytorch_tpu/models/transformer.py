@@ -17,9 +17,19 @@ TPU-native re-design of the reference transformer
     positions, `transformer.py:306-330`), precomputed host-side;
   * `reverse_model=True` runs layers in reversed order — the fork's
     inverse-mapping trick (`reversible.py:141-144`);
-  * reversible mode maps to `jax.remat` per layer (activation recompute in
-    backward — the memory behavior `reversible.py:57-127` buys), with a true
-    custom-vjp reversible executor as a follow-up.
+  * reversible mode, two executors selected by `reversible_impl`:
+      - "remat": `jax.remat` per layer (recompute in backward — the memory
+        behavior `reversible.py:57-127` buys, cost O(depth) residuals);
+      - "revnet": a TRUE RevNet executor via `nn.custom_vjp` matching the
+        reference's `ReversibleBlock`/`_ReversibleFunction` math
+        (`reversible.py:57-127`): channels duplicated into (x1, x2) streams
+        (`reversible.py:158,165`), y1 = x1 + attn(x2), y2 = x2 + ff(y1),
+        output = mean of streams; the backward RECONSTRUCTS each block's
+        inputs from its outputs (x2 = y2 − g(y1), x1 = y1 − f(x2)) so
+        activation memory is O(1) in depth. The reference's CUDA RNG
+        state capture (`reversible.py:32-53`) is unnecessary here: the
+        revnet path requires deterministic execution (dropout rate 0),
+        which JAX guarantees under explicit PRNG keys.
 
 The executor unrolls layers in Python (static depth) so XLA sees one big
 fusable graph; weight-shared stacks may later scan.
@@ -147,6 +157,7 @@ class Transformer(nn.Module):
     shared_attn_ids: Optional[Sequence[int]] = None
     shared_ff_ids: Optional[Sequence[int]] = None
     reversible: bool = False
+    reversible_impl: str = "remat"  # "remat" | "revnet" | "revnet_naive" (test)
     attn_impl: str = "auto"  # "dense" | "flash" | "auto" (see models/attention.py)
     dtype: Any = jnp.float32
 
@@ -260,6 +271,110 @@ class Transformer(nn.Module):
             )
         return shift_token_step(h, ring, pos, self.text_len, fmap)
 
+    def _half_attn(self, i, x, key_mask, layer_cache, deterministic=True):
+        """Attention half-block f (norm → shift → attn → [sandwich] → scale),
+        the composition the reference wraps as `f` in `ReversibleBlock`
+        (`reversible.py:57-63`, built at `transformer.py:291-294`).
+        Returns (residual_branch, new_attn_cache, new_shift_ring)."""
+        cached = layer_cache is not None
+        pos = layer_cache["attn"]["index"] if cached else None
+        h = self.attn_norms[i](x)
+        ring = None
+        if self.shift_tokens:
+            h, ring = self._shift(
+                h, layer_cache.get("shift_attn") if cached else None, pos
+            )
+        h, attn_cache = self.attn_layers[i](
+            h,
+            key_mask=key_mask,
+            rotary=self.rotary_table,
+            cache=layer_cache["attn"] if cached else None,
+            deterministic=deterministic,
+        )
+        if self.sandwich_norm:
+            h = self.attn_norms_out[i](h)
+        return h * self.attn_scales[i].astype(h.dtype), attn_cache, ring
+
+    def _half_ff(self, i, x, layer_cache, pos, deterministic=True):
+        """Feed-forward half-block g (norm → shift → ff → [sandwich] → scale).
+        `pos` is the pre-update decode position (for the streaming shift).
+        Returns (residual_branch, new_shift_ring)."""
+        cached = layer_cache is not None
+        h = self.ff_norms[i](x)
+        ring = None
+        if self.shift_tokens:
+            h, ring = self._shift(
+                h, layer_cache.get("shift_ff") if cached else None, pos
+            )
+        h = self.ff_layers[i](h, deterministic=deterministic)
+        if self.sandwich_norm:
+            h = self.ff_norms_out[i](h)
+        return h * self.ff_scales[i].astype(h.dtype), ring
+
+    def _rev_f(self, x: jnp.ndarray, i: int, deterministic: bool = True):
+        return self._half_attn(i, x, None, None, deterministic)[0]
+
+    def _rev_g(self, x: jnp.ndarray, i: int, deterministic: bool = True):
+        return self._half_ff(i, x, None, None, deterministic)[0]
+
+    def _revnet(self, x: jnp.ndarray, order: Tuple[int, ...]):
+        """True reversible executor (`reversible.py:57-127` semantics).
+
+        Forward runs the (f, g) couplings; the custom backward reconstructs
+        activations block-by-block from the outputs, so nothing between
+        layer boundaries is kept live — the JAX analogue of
+        `_ReversibleFunction.backward` (`reversible.py:121-127`).
+        """
+
+        def fn(mdl, x1, x2):
+            for i in order:
+                x1 = x1 + mdl._rev_f(x2, i)
+                x2 = x2 + mdl._rev_g(x1, i)
+            return x1, x2
+
+        def fwd(mdl, x1, x2):
+            y1, y2 = fn(mdl, x1, x2)
+            variables = {"params": mdl.variables["params"]}
+            return (y1, y2), (y1, y2, variables)
+
+        mdl_def = self.clone(parent=None)
+
+        def bwd(residuals, tangents):
+            y1, y2, variables = residuals
+            dy1, dy2 = tangents
+
+            def f_pure(v, h, i):
+                return mdl_def.apply(v, h, i, method=Transformer._rev_f)
+
+            def g_pure(v, h, i):
+                return mdl_def.apply(v, h, i, method=Transformer._rev_g)
+
+            params_t = jax.tree_util.tree_map(jnp.zeros_like, variables)
+            for i in reversed(order):
+                g_out, g_vjp = jax.vjp(lambda v, h: g_pure(v, h, i), variables, y1)
+                x2 = y2 - g_out
+                dv_g, dy1_add = g_vjp(dy2)
+                dy1 = dy1 + dy1_add
+                f_out, f_vjp = jax.vjp(lambda v, h: f_pure(v, h, i), variables, x2)
+                x1 = y1 - f_out
+                dv_f, dx2_add = f_vjp(dy1)
+                dy2 = dy2 + dx2_add
+                params_t = jax.tree_util.tree_map(
+                    lambda a, b, c: a + b + c, params_t, dv_g, dv_f
+                )
+                y1, y2 = x1, x2
+            return (params_t, dy1, dy2)
+
+        if self.reversible_impl == "revnet_naive":
+            # autodiff-through-forward variant: same function, plain VJP.
+            # Exists so tests can check the custom backward against autodiff.
+            y1, y2 = fn(self, x, x)
+        else:
+            rev = nn.custom_vjp(fn, forward_fn=fwd, backward_fn=bwd)
+            y1, y2 = rev(self, x, x)
+        # channel-duplication mean-out (`reversible.py:158,165`)
+        return (y1 + y2) / 2
+
     def _layer(
         self,
         i: int,
@@ -270,36 +385,21 @@ class Transformer(nn.Module):
     ):
         """One (attn, ff) residual pair; returns (x, updated layer cache)."""
         cached = layer_cache is not None
-        new_cache = {} if cached else None
         pos = layer_cache["attn"]["index"] if cached else None
 
-        h = self.attn_norms[i](x)
-        if self.shift_tokens:
-            h, ring = self._shift(h, layer_cache.get("shift_attn") if cached else None, pos)
-            if cached:
-                new_cache["shift_attn"] = ring
-        h, attn_cache = self.attn_layers[i](
-            h,
-            key_mask=key_mask,
-            rotary=self.rotary_table,
-            cache=layer_cache["attn"] if cached else None,
-            deterministic=deterministic,
+        h, attn_cache, ring_attn = self._half_attn(
+            i, x, key_mask, layer_cache, deterministic
         )
-        if self.sandwich_norm:
-            h = self.attn_norms_out[i](h)
-        x = x + h * self.attn_scales[i].astype(h.dtype)
-        if cached:
-            new_cache["attn"] = attn_cache
+        x = x + h
+        h, ring_ff = self._half_ff(i, x, layer_cache, pos, deterministic)
+        x = x + h
 
-        h = self.ff_norms[i](x)
+        if not cached:
+            return x, None
+        new_cache = {"attn": attn_cache}
         if self.shift_tokens:
-            h, ring = self._shift(h, layer_cache.get("shift_ff") if cached else None, pos)
-            if cached:
-                new_cache["shift_ff"] = ring
-        h = self.ff_layers[i](h, deterministic=deterministic)
-        if self.sandwich_norm:
-            h = self.ff_norms_out[i](h)
-        x = x + h * self.ff_scales[i].astype(h.dtype)
+            new_cache["shift_attn"] = ring_attn
+            new_cache["shift_ff"] = ring_ff
         return x, new_cache
 
     def __call__(
@@ -311,6 +411,33 @@ class Transformer(nn.Module):
         deterministic: bool = True,
     ):
         order = range(self.depth - 1, -1, -1) if reverse_model else range(self.depth)
+        if self.reversible and self.reversible_impl != "remat":
+            if cache is not None:
+                # cached decode of the SAME two-stream function the revnet
+                # trains: (x1, x2) streams advance through cached halves.
+                x1 = x2 = x
+                new_cache = {}
+                for i in order:
+                    lc = cache[f"layer_{i}"]
+                    pos = lc["attn"]["index"]
+                    h, attn_cache, ring_a = self._half_attn(
+                        i, x2, key_mask, lc, deterministic
+                    )
+                    x1 = x1 + h
+                    h, ring_f = self._half_ff(i, x1, lc, pos, deterministic)
+                    x2 = x2 + h
+                    layer_new = {"attn": attn_cache}
+                    if self.shift_tokens:
+                        layer_new["shift_attn"] = ring_a
+                        layer_new["shift_ff"] = ring_f
+                    new_cache[f"layer_{i}"] = layer_new
+                return (x1 + x2) / 2, new_cache
+            assert key_mask is None, "revnet executor has no key-mask path"
+            assert deterministic or (self.attn_dropout == 0 and self.ff_dropout == 0), (
+                "revnet executor requires deterministic execution (no dropout); "
+                "use reversible_impl='remat' for dropout training"
+            )
+            return self._revnet(x, tuple(order))
         new_cache = {} if cache is not None else None
         for i in order:
             if self.reversible and cache is None:

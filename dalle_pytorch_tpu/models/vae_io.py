@@ -49,6 +49,180 @@ def _torch_conv_to_jax(w: np.ndarray) -> np.ndarray:
     return np.transpose(w, (2, 3, 1, 0))
 
 
+# --------------------------------------------------------------------------
+# Pure-XLA VQGAN graph (taming-transformers architecture), evaluated
+# directly against the converted torch state dict. Layout is NHWC
+# throughout (TPU-native); torch OIHW conv kernels are transposed at load.
+# Mirrors the modules the reference drives through taming
+# (`/root/reference/dalle_pytorch/vae.py:160-229`): Encoder/Decoder stacks
+# of GroupNorm+swish ResnetBlocks with optional spatial attention,
+# stride-2 downsampling with (0,1,0,1) padding, nearest-neighbour 2x
+# upsampling, and a nearest-codebook (or Gumbel argmax) quantizer.
+# --------------------------------------------------------------------------
+
+
+def _swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+class _VQGraph:
+    """Functional VQGAN evaluator over a flat {torch_key: array} dict."""
+
+    # prefixes the inference graph actually reads; taming checkpoints also
+    # carry GAN-discriminator / LPIPS weights under `loss.*` that would
+    # otherwise waste HBM
+    _USED_PREFIXES = (
+        "encoder.", "decoder.", "quantize.", "quant_conv.", "post_quant_conv.",
+    )
+
+    def __init__(self, state: dict, ddconfig: dict, num_tokens: int, is_gumbel: bool):
+        self.ddconfig = ddconfig
+        self.num_tokens = num_tokens
+        self.is_gumbel = is_gumbel
+        # convert once: conv kernels to HWIO jnp arrays, the rest as-is.
+        # Params live in this dict and are passed to the graph methods
+        # explicitly, so jit treats them as arguments (not baked constants).
+        self.p = {}
+        for k, v in state.items():
+            if not k.startswith(self._USED_PREFIXES):
+                continue
+            v = np.asarray(v)
+            if k.endswith("weight") and v.ndim == 4:
+                v = _torch_conv_to_jax(v)
+            self.p[k] = jnp.asarray(v)
+
+    def _has(self, key):
+        return f"{key}.weight" in self.p
+
+    def _conv(self, p, key, x, stride=1, pad="SAME"):
+        w = p[f"{key}.weight"]
+        out = jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype),
+            window_strides=(stride, stride),
+            padding=pad if isinstance(pad, str) else pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        b = p.get(f"{key}.bias")
+        return out if b is None else out + b.astype(x.dtype)
+
+    def _norm(self, p, key, x, groups=32, eps=1e-6):
+        b, h, w, c = x.shape
+        xg = x.reshape(b, h, w, groups, c // groups)
+        mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+        var = ((xg - mean) ** 2).mean(axis=(1, 2, 4), keepdims=True)
+        xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+        x = xg.reshape(b, h, w, c)
+        return x * p[f"{key}.weight"] + p[f"{key}.bias"]
+
+    def _resnet(self, p, key, x):
+        h = self._conv(p, f"{key}.conv1", _swish(self._norm(p, f"{key}.norm1", x)))
+        h = self._conv(p, f"{key}.conv2", _swish(self._norm(p, f"{key}.norm2", h)))
+        if self._has(f"{key}.nin_shortcut"):
+            x = self._conv(p, f"{key}.nin_shortcut", x)
+        elif self._has(f"{key}.conv_shortcut"):
+            x = self._conv(p, f"{key}.conv_shortcut", x)
+        return x + h
+
+    def _attn(self, p, key, x):
+        b, hh, ww, c = x.shape
+        h = self._norm(p, f"{key}.norm", x)
+        q = self._conv(p, f"{key}.q", h).reshape(b, hh * ww, c)
+        k = self._conv(p, f"{key}.k", h).reshape(b, hh * ww, c)
+        v = self._conv(p, f"{key}.v", h).reshape(b, hh * ww, c)
+        attn = jax.nn.softmax(
+            jnp.einsum("bqc,bkc->bqk", q, k) * (c ** -0.5), axis=-1
+        )
+        out = jnp.einsum("bqk,bkc->bqc", attn, v).reshape(b, hh, ww, c)
+        return x + self._conv(p, f"{key}.proj_out", out)
+
+    # ------------------------------------------------------------ encoder
+
+    def encode_z(self, p, x):
+        """images NHWC in [-1, 1] -> latent grid [B, h, w, z]."""
+        dd = self.ddconfig
+        ch_mult = tuple(dd["ch_mult"])
+        num_res = dd["num_res_blocks"]
+        attn_res = set(dd.get("attn_resolutions", []))
+        cur_res = dd["resolution"]
+
+        h = self._conv(p, "encoder.conv_in", x)
+        for i in range(len(ch_mult)):
+            for j in range(num_res):
+                h = self._resnet(p, f"encoder.down.{i}.block.{j}", h)
+                if cur_res in attn_res:
+                    h = self._attn(p, f"encoder.down.{i}.attn.{j}", h)
+            if i != len(ch_mult) - 1:
+                # taming Downsample: pad (left 0, right 1, top 0, bottom 1),
+                # stride-2 valid conv
+                h = self._conv(
+                    p, f"encoder.down.{i}.downsample.conv",
+                    h, stride=2, pad=[(0, 1), (0, 1)],
+                )
+                cur_res //= 2
+        h = self._resnet(p, "encoder.mid.block_1", h)
+        h = self._attn(p, "encoder.mid.attn_1", h)
+        h = self._resnet(p, "encoder.mid.block_2", h)
+        h = self._conv(p, "encoder.conv_out", _swish(self._norm(p, "encoder.norm_out", h)))
+        if self._has("quant_conv"):
+            h = self._conv(p, "quant_conv", h)
+        return h
+
+    def quantize_indices(self, p, z):
+        """latent grid -> flat codebook indices [B, h*w]."""
+        b, h, w, c = z.shape
+        if self.is_gumbel:
+            # GumbelQuantize hard path: argmax of the projection logits
+            logits = self._conv(p, "quantize.proj", z)
+            return jnp.argmax(logits, axis=-1).reshape(b, h * w).astype(jnp.int32)
+        emb = p["quantize.embedding.weight"]  # [n, c]
+        flat = z.reshape(-1, c)
+        d = (
+            (flat ** 2).sum(-1, keepdims=True)
+            - 2 * flat @ emb.T
+            + (emb ** 2).sum(-1)[None, :]
+        )
+        return jnp.argmin(d, axis=-1).reshape(b, h * w).astype(jnp.int32)
+
+    # ------------------------------------------------------------ decoder
+
+    def decode_indices(self, p, indices):
+        """flat indices [B, n] -> images NHWC in [0, 1]."""
+        dd = self.ddconfig
+        emb_key = "quantize.embed.weight" if self.is_gumbel else "quantize.embedding.weight"
+        emb = p[emb_key]
+        b, n = indices.shape
+        hw = int(math.isqrt(n))
+        z = emb[indices].reshape(b, hw, hw, emb.shape[-1])
+
+        ch_mult = tuple(dd["ch_mult"])
+        num_res = dd["num_res_blocks"]
+        attn_res = set(dd.get("attn_resolutions", []))
+        cur_res = dd["resolution"] // 2 ** (len(ch_mult) - 1)
+
+        if self._has("post_quant_conv"):
+            z = self._conv(p, "post_quant_conv", z)
+        h = self._conv(p, "decoder.conv_in", z)
+        h = self._resnet(p, "decoder.mid.block_1", h)
+        h = self._attn(p, "decoder.mid.attn_1", h)
+        h = self._resnet(p, "decoder.mid.block_2", h)
+        for i in reversed(range(len(ch_mult))):
+            for j in range(num_res + 1):
+                h = self._resnet(p, f"decoder.up.{i}.block.{j}", h)
+                if cur_res in attn_res:
+                    h = self._attn(p, f"decoder.up.{i}.attn.{j}", h)
+            if i != 0:
+                # taming Upsample: nearest 2x then 3x3 conv
+                bb, hh, ww, cc = h.shape
+                h = jnp.broadcast_to(
+                    h[:, :, None, :, None, :], (bb, hh, 2, ww, 2, cc)
+                ).reshape(bb, hh * 2, ww * 2, cc)
+                h = self._conv(p, f"decoder.up.{i}.upsample.conv", h)
+                cur_res *= 2
+        h = self._conv(p, "decoder.conv_out", _swish(self._norm(p, "decoder.norm_out", h)))
+        # reference clamps to [-1,1] then rescales to [0,1] (`vae.py:226-228`)
+        return (jnp.clip(h, -1.0, 1.0) + 1.0) * 0.5
+
+
 class OpenAIDiscreteVAE:
     """OpenAI's pretrained 8192-token dVAE (`vae.py:111-157`).
 
@@ -135,6 +309,7 @@ class VQGanVAE:
             config = yaml.safe_load(f)
         params = config["model"]["params"]
         ddconfig = params["ddconfig"]
+        self.ddconfig = ddconfig
         self.image_size = ddconfig["resolution"]
         f_factor = 2 ** (len(ddconfig["ch_mult"]) - 1)
         self.num_layers = int(math.log2(f_factor))
@@ -143,17 +318,21 @@ class VQGanVAE:
         self.is_gumbel = "Gumbel" in config["model"]["target"]
 
         state = torch.load(self.model_path, map_location="cpu")["state_dict"]
-        self._state = {k: v.numpy() for k, v in state.items()}
+        state = {k: v.numpy() for k, v in state.items()}
         emb_key = "quantize.embed.weight" if self.is_gumbel else "quantize.embedding.weight"
-        self.codebook = jnp.asarray(self._state[emb_key])
+        self.codebook = jnp.asarray(state[emb_key])
+        self._graph = _VQGraph(
+            state, self.ddconfig, self.num_tokens, self.is_gumbel
+        )
+        del state  # drop host copies (incl. GAN/LPIPS `loss.*` weights)
+        g = self._graph
+        self._encode_jit = jax.jit(lambda p, x: g.quantize_indices(p, g.encode_z(p, x)))
+        self._decode_jit = jax.jit(g.decode_indices)
 
     def get_codebook_indices(self, images: jnp.ndarray) -> jnp.ndarray:
-        raise NotImplementedError(
-            "VQGAN XLA conversion lands with the full torch->jax converter; "
-            "precompute tokens offline with taming-transformers for now"
-        )
+        """images NHWC in [0, 1] -> flat codebook indices (`vae.py:210-217`)."""
+        return self._encode_jit(self._graph.p, 2.0 * images - 1.0)
 
     def decode(self, img_seq: jnp.ndarray) -> jnp.ndarray:
-        raise NotImplementedError(
-            "VQGAN XLA conversion lands with the full torch->jax converter"
-        )
+        """flat indices -> images NHWC in [0, 1] (`vae.py:219-229`)."""
+        return self._decode_jit(self._graph.p, jnp.asarray(img_seq))

@@ -65,6 +65,7 @@ class DalleConfig:
     ff_dropout: float = 0.0
     attn_dropout: float = 0.0
     reversible: bool = False
+    reversible_impl: str = "remat"  # remat | revnet
     loss_img_weight: float = 7.0
     attn_types: str = "full"  # comma separated
     shift_tokens: bool = False
@@ -119,6 +120,7 @@ class TrainConfig:
     taming: bool = False
     hug: bool = False
     yttm: bool = False
+    native: bool = False  # framework-native C++ BPE (native/bpe.cpp)
     bpe_path: Optional[str] = None
     truncate_captions: bool = False
 

@@ -26,7 +26,8 @@ from dalle_pytorch_tpu.version import __version__
 
 def build_tokenizer(cfg: TrainConfig):
     return get_tokenizer(
-        bpe_path=cfg.bpe_path, hug=cfg.hug, chinese=cfg.chinese, yttm=cfg.yttm
+        bpe_path=cfg.bpe_path, hug=cfg.hug, chinese=cfg.chinese, yttm=cfg.yttm,
+        native=getattr(cfg, "native", False)
     )
 
 
@@ -189,6 +190,7 @@ def dalle_from_config(
         num_text_tokens=vocab_size,
         text_seq_len=m.text_seq_len,
         reversible=m.reversible,
+        reversible_impl=getattr(m, "reversible_impl", "remat"),
         attn_dropout=m.attn_dropout,
         ff_dropout=m.ff_dropout,
         attn_types=m.attn_types_tuple(),
@@ -252,3 +254,35 @@ def load_dalle_checkpoint(path: str):
         jax.tree.map(jnp.asarray, params["vae"]) if "vae" in params else None
     )
     return cfg, dalle_params, vae_params, meta
+
+
+def clip_hparams(clip) -> dict:
+    return {
+        "dim_text": clip.dim_text,
+        "dim_image": clip.dim_image,
+        "dim_latent": clip.dim_latent,
+        "num_text_tokens": clip.num_text_tokens,
+        "text_enc_depth": clip.text_enc_depth,
+        "text_seq_len": clip.text_seq_len,
+        "text_heads": clip.text_heads,
+        "num_visual_tokens": clip.num_visual_tokens,
+        "visual_enc_depth": clip.visual_enc_depth,
+        "visual_heads": clip.visual_heads,
+        "visual_image_size": clip.visual_image_size,
+        "visual_patch_size": clip.visual_patch_size,
+        "channels": clip.channels,
+    }
+
+
+def save_clip_checkpoint(path: str, clip, params) -> None:
+    """Single-file CLIP checkpoint (hparams + weights), the same logical
+    payload shape as the reference's `.pt` saves (`train_dalle.py:432-479`)."""
+    save_params_npz(path, params, metadata={"clip_hparams": clip_hparams(clip)})
+
+
+def load_clip_checkpoint(path: str, dtype=jnp.float32):
+    from dalle_pytorch_tpu.models.clip import CLIP
+
+    params, metadata = load_params_npz(path)
+    clip = CLIP(dtype=dtype, **metadata["clip_hparams"])
+    return clip, params

@@ -27,6 +27,13 @@ def parse_args():
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--cond_scale", type=float, default=1.0)
     p.add_argument("--outputs_dir", type=str, default="outputs")
+    p.add_argument(
+        "--clip_path",
+        type=str,
+        default=None,
+        help="CLIP checkpoint; generations are reranked by similarity "
+        "(`dalle_pytorch.py:569-571`) and saved best-first",
+    )
     p.add_argument("--gentxt", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument(
@@ -82,6 +89,12 @@ def main():
 
     from PIL import Image
 
+    clip = clip_params = None
+    if args.clip_path:
+        from dalle_pytorch_tpu.training.pipeline import load_clip_checkpoint
+
+        clip, clip_params = load_clip_checkpoint(args.clip_path)
+
     for raw_prompt in args.text.split("|"):
         prompt = raw_prompt.strip()
         if args.gentxt:
@@ -121,6 +134,31 @@ def main():
             else:  # pretrained wrappers decode to [0,1] already
                 images.append(np.asarray(vae.decode(toks)))
         images = np.concatenate(images, axis=0)
+
+        if clip is not None:
+            from dalle_pytorch_tpu.models.clip import rerank
+
+            # mismatches would fail silently (XLA gather clamps OOB indices)
+            assert images.shape[1] == clip.visual_image_size, (
+                f"CLIP checkpoint expects {clip.visual_image_size}px images "
+                f"but the VAE decodes {images.shape[1]}px"
+            )
+            assert tokenizer.vocab_size <= clip.num_text_tokens, (
+                f"tokenizer vocab {tokenizer.vocab_size} exceeds CLIP "
+                f"num_text_tokens {clip.num_text_tokens}"
+            )
+            clip_ids = tokenizer.tokenize(
+                prompt, clip.text_seq_len, truncate_text=True
+            )
+            sorted_imgs, scores, _ = rerank(
+                clip,
+                {"params": clip_params},
+                jnp.asarray(clip_ids),
+                jnp.asarray(images),
+                text_mask=jnp.asarray(clip_ids != 0),
+            )
+            images = np.asarray(sorted_imgs)
+            print("clip scores (best first):", np.asarray(scores)[:8])
 
         safe = "".join(c if c.isalnum() or c in " -." else "" for c in prompt)
         out_dir = Path(args.outputs_dir) / (safe.strip().replace(" ", "_")[:100] or "prompt")

@@ -1,0 +1,368 @@
+// Native byte-level BPE tokenizer core (train / encode / decode).
+//
+// TPU-framework equivalent of the reference's youtokentome C++ BPE
+// dependency (/root/reference/dalle_pytorch/tokenizer.py:232-266): the
+// reference delegates fast BPE to an external C++ library; here the
+// capability is provided natively. Tokenization is host-side work — the
+// arrays it produces feed jit'ted TPU steps — so this is plain portable
+// C++17 exposed through a C ABI for ctypes.
+//
+// Id space (matching the framework contract that id 0 is padding):
+//   0         PAD
+//   1         UNK (never produced by byte-level encoding; reserved)
+//   2..257    raw bytes 0..255
+//   258..     merge ranks, in training order
+//
+// Pre-tokenization: text is split into chunks of (optional single leading
+// space) + run of non-space bytes. Merges never cross chunk boundaries.
+// Decoding is exact byte concatenation, so encode->decode roundtrips.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <queue>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kPad = 0;
+constexpr int32_t kByteBase = 2;
+constexpr int32_t kMergeBase = 258;
+
+inline uint64_t pair_key(int32_t a, int32_t b) {
+  return (static_cast<uint64_t>(static_cast<uint32_t>(a)) << 32) |
+         static_cast<uint32_t>(b);
+}
+
+struct Model {
+  // merge rank r creates token kMergeBase + r from (left[r], right[r])
+  std::vector<int32_t> left, right;
+  std::unordered_map<uint64_t, int32_t> rank;       // pair -> rank
+  std::vector<std::string> token_bytes;             // id -> raw bytes
+
+  void finalize() {
+    token_bytes.resize(kMergeBase + left.size());
+    token_bytes[kPad] = "";
+    token_bytes[1] = "";
+    for (int b = 0; b < 256; ++b)
+      token_bytes[kByteBase + b] = std::string(1, static_cast<char>(b));
+    for (size_t r = 0; r < left.size(); ++r) {
+      token_bytes[kMergeBase + r] =
+          token_bytes[left[r]] + token_bytes[right[r]];
+      rank.emplace(pair_key(left[r], right[r]), static_cast<int32_t>(r));
+    }
+  }
+
+  int32_t vocab_size() const {
+    return kMergeBase + static_cast<int32_t>(left.size());
+  }
+};
+
+// split into chunks: (optional one leading space) + non-space run.
+// Lone whitespace runs are attached byte-by-byte to keep exact roundtrip.
+std::vector<std::string> chunks_of(const std::string& text) {
+  std::vector<std::string> out;
+  size_t i = 0, n = text.size();
+  while (i < n) {
+    std::string chunk;
+    if (text[i] == ' ' && i + 1 < n && text[i + 1] != ' ') {
+      chunk.push_back(' ');
+      ++i;
+    }
+    if (i < n && text[i] == ' ') {  // run of spaces (or trailing space)
+      chunk.push_back(' ');
+      ++i;
+      out.push_back(chunk);
+      continue;
+    }
+    while (i < n && text[i] != ' ') chunk.push_back(text[i++]);
+    if (!chunk.empty()) out.push_back(chunk);
+  }
+  return out;
+}
+
+std::vector<int32_t> bytes_to_ids(const std::string& s) {
+  std::vector<int32_t> ids;
+  ids.reserve(s.size());
+  for (unsigned char c : s) ids.push_back(kByteBase + c);
+  return ids;
+}
+
+// Greedy BPE encode of one chunk: repeatedly apply the lowest-rank pair.
+void encode_chunk(const Model& m, std::vector<int32_t>& ids) {
+  while (ids.size() >= 2) {
+    int32_t best_rank = INT32_MAX;
+    size_t best_i = 0;
+    for (size_t i = 0; i + 1 < ids.size(); ++i) {
+      auto it = m.rank.find(pair_key(ids[i], ids[i + 1]));
+      if (it != m.rank.end() && it->second < best_rank) {
+        best_rank = it->second;
+        best_i = i;
+      }
+    }
+    if (best_rank == INT32_MAX) break;
+    ids[best_i] = kMergeBase + best_rank;
+    ids.erase(ids.begin() + best_i + 1);
+  }
+}
+
+std::vector<int32_t> encode_text(const Model& m, const std::string& text) {
+  std::vector<int32_t> out;
+  for (const auto& chunk : chunks_of(text)) {
+    auto ids = bytes_to_ids(chunk);
+    encode_chunk(m, ids);
+    out.insert(out.end(), ids.begin(), ids.end());
+  }
+  return out;
+}
+
+// ------------------------------------------------------------- training
+
+struct Word {
+  std::vector<int32_t> ids;
+  int64_t freq = 0;
+};
+
+struct Trainer {
+  std::vector<Word> words;
+  std::unordered_map<uint64_t, int64_t> pair_count;
+  std::unordered_map<uint64_t, std::unordered_set<int32_t>> pair_words;
+
+  void add_pair(uint64_t key, int64_t freq, int32_t word_idx) {
+    pair_count[key] += freq;
+    pair_words[key].insert(word_idx);
+  }
+
+  void count_all() {
+    for (size_t w = 0; w < words.size(); ++w) {
+      const auto& ids = words[w].ids;
+      for (size_t i = 0; i + 1 < ids.size(); ++i)
+        add_pair(pair_key(ids[i], ids[i + 1]), words[w].freq,
+                 static_cast<int32_t>(w));
+    }
+  }
+
+  // pairs whose count changed since last heap push (for lazy re-push)
+  std::vector<uint64_t> touched;
+
+  // merge the pair (a, b) -> new_id across all words containing it.
+  // Per affected word: retract its pair contributions, rebuild, re-add —
+  // O(word_len) and straightforwardly correct; the heap handles selection.
+  void apply_merge(int32_t a, int32_t b, int32_t new_id) {
+    uint64_t key = pair_key(a, b);
+    auto wit = pair_words.find(key);
+    if (wit == pair_words.end()) return;
+    std::vector<int32_t> affected(wit->second.begin(), wit->second.end());
+
+    for (int32_t w : affected) {
+      auto& ids = words[w].ids;
+      int64_t f = words[w].freq;
+      bool contains = false;
+      for (size_t i = 0; i + 1 < ids.size(); ++i)
+        if (ids[i] == a && ids[i + 1] == b) {
+          contains = true;
+          break;
+        }
+      if (!contains) continue;  // stale membership entry
+      for (size_t i = 0; i + 1 < ids.size(); ++i) {
+        uint64_t k = pair_key(ids[i], ids[i + 1]);
+        pair_count[k] -= f;
+        touched.push_back(k);
+      }
+      std::vector<int32_t> merged;
+      merged.reserve(ids.size());
+      for (size_t i = 0; i < ids.size();) {
+        if (i + 1 < ids.size() && ids[i] == a && ids[i + 1] == b) {
+          merged.push_back(new_id);
+          i += 2;
+        } else {
+          merged.push_back(ids[i++]);
+        }
+      }
+      ids.swap(merged);
+      for (size_t i = 0; i + 1 < ids.size(); ++i) {
+        uint64_t k = pair_key(ids[i], ids[i + 1]);
+        add_pair(k, f, w);
+        touched.push_back(k);
+      }
+    }
+    pair_count.erase(key);
+    pair_words.erase(key);
+  }
+};
+
+Model* train_model(const std::string& corpus, int32_t vocab_size) {
+  auto* model = new Model();
+  Trainer tr;
+  {
+    std::unordered_map<std::string, int64_t> word_freq;
+    std::istringstream stream(corpus);
+    std::string line;
+    while (std::getline(stream, line))
+      for (const auto& chunk : chunks_of(line)) ++word_freq[chunk];
+    tr.words.reserve(word_freq.size());
+    for (auto& kv : word_freq)
+      tr.words.push_back({bytes_to_ids(kv.first), kv.second});
+  }
+  tr.count_all();
+
+  // lazy max-heap over (count, key): entries are re-pushed when counts
+  // change and validated against the live map on pop.
+  using Entry = std::pair<int64_t, uint64_t>;
+  std::priority_queue<Entry> heap;
+  for (const auto& kv : tr.pair_count) heap.emplace(kv.second, kv.first);
+
+  int32_t target_merges = vocab_size - kMergeBase;
+  for (int32_t r = 0; r < target_merges;) {
+    if (heap.empty()) break;
+    auto [count, key] = heap.top();
+    heap.pop();
+    auto it = tr.pair_count.find(key);
+    if (it == tr.pair_count.end() || it->second != count) continue;  // stale
+    if (count < 2) break;  // nothing worth merging
+    int32_t a = static_cast<int32_t>(key >> 32);
+    int32_t b = static_cast<int32_t>(key & 0xffffffffu);
+    model->left.push_back(a);
+    model->right.push_back(b);
+    tr.apply_merge(a, b, kMergeBase + r);
+    for (uint64_t k : tr.touched) {
+      auto cit = tr.pair_count.find(k);
+      if (cit != tr.pair_count.end() && cit->second > 0)
+        heap.emplace(cit->second, k);
+    }
+    tr.touched.clear();
+    ++r;
+  }
+  model->finalize();
+  return model;
+}
+
+bool save_model(const Model& m, const std::string& path) {
+  std::ofstream f(path);
+  if (!f) return false;
+  f << "NATIVEBPE v1\n" << m.left.size() << "\n";
+  for (size_t r = 0; r < m.left.size(); ++r)
+    f << m.left[r] << " " << m.right[r] << "\n";
+  return static_cast<bool>(f);
+}
+
+Model* load_model(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return nullptr;
+  std::string magic, version;
+  f >> magic >> version;
+  if (magic != "NATIVEBPE") return nullptr;
+  size_t n;
+  f >> n;
+  auto* m = new Model();
+  m->left.resize(n);
+  m->right.resize(n);
+  for (size_t r = 0; r < n; ++r) f >> m->left[r] >> m->right[r];
+  if (!f) {
+    delete m;
+    return nullptr;
+  }
+  // ids must be byte tokens or earlier merges, else finalize() would index
+  // out of bounds (corrupt / truncated / hand-edited file)
+  for (size_t r = 0; r < n; ++r) {
+    int32_t hi = kMergeBase + static_cast<int32_t>(r);
+    if (m->left[r] < kByteBase || m->left[r] >= hi ||
+        m->right[r] < kByteBase || m->right[r] >= hi) {
+      delete m;
+      return nullptr;
+    }
+  }
+  m->finalize();
+  return m;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* bpe_train(const char* corpus, int32_t vocab_size) {
+  return train_model(corpus, vocab_size);
+}
+
+void* bpe_load(const char* model_path) { return load_model(model_path); }
+
+int bpe_save(void* handle, const char* model_path) {
+  return save_model(*static_cast<Model*>(handle), model_path) ? 0 : -1;
+}
+
+void bpe_free(void* handle) { delete static_cast<Model*>(handle); }
+
+int32_t bpe_vocab_size(void* handle) {
+  return static_cast<Model*>(handle)->vocab_size();
+}
+
+// encode one text; returns number of ids (<= max_len after truncation)
+int32_t bpe_encode(void* handle, const char* text, int32_t* out,
+                   int32_t max_len) {
+  auto ids = encode_text(*static_cast<Model*>(handle), text);
+  int32_t n = static_cast<int32_t>(std::min<size_t>(ids.size(), max_len));
+  std::copy(ids.begin(), ids.begin() + n, out);
+  return static_cast<int32_t>(ids.size());
+}
+
+// threaded batch encode into a zero-padded [n_texts, max_len] buffer.
+// texts are NUL-separated in one blob with offsets; returns 0, or the
+// (1-based) index of the first text longer than max_len when
+// truncate == 0 (mirroring the tokenize() overflow error contract).
+int32_t bpe_encode_batch(void* handle, const char* blob,
+                         const int64_t* offsets, int32_t n_texts,
+                         int32_t* out, int32_t max_len, int32_t truncate,
+                         int32_t n_threads) {
+  const Model& m = *static_cast<Model*>(handle);
+  std::vector<int32_t> overflow(std::max(n_threads, 1), 0);
+  auto work = [&](int32_t t) {
+    for (int32_t i = t; i < n_texts; i += n_threads) {
+      std::string text(blob + offsets[i]);
+      auto ids = encode_text(m, text);
+      if (static_cast<int32_t>(ids.size()) > max_len && !truncate) {
+        if (!overflow[t]) overflow[t] = i + 1;
+        continue;
+      }
+      int32_t n = static_cast<int32_t>(std::min<size_t>(ids.size(), max_len));
+      std::copy(ids.begin(), ids.begin() + n, out + int64_t(i) * max_len);
+    }
+  };
+  if (n_threads <= 1) {
+    n_threads = 1;
+    work(0);
+  } else {
+    std::vector<std::thread> threads;
+    for (int32_t t = 0; t < n_threads; ++t) threads.emplace_back(work, t);
+    for (auto& th : threads) th.join();
+  }
+  for (int32_t t = 0; t < n_threads; ++t)
+    if (overflow[t]) return overflow[t];
+  return 0;
+}
+
+// decode ids -> utf-8 bytes; pad/unknown ids are skipped. Returns byte
+// count written (excluding NUL); out must hold max_bytes.
+int32_t bpe_decode(void* handle, const int32_t* ids, int32_t n, char* out,
+                   int32_t max_bytes) {
+  const Model& m = *static_cast<Model*>(handle);
+  std::string s;
+  for (int32_t i = 0; i < n; ++i) {
+    int32_t id = ids[i];
+    if (id <= kPad || id == 1 || id >= m.vocab_size()) continue;
+    s += m.token_bytes[id];
+  }
+  int32_t nbytes = static_cast<int32_t>(
+      std::min<size_t>(s.size(), max_bytes > 0 ? max_bytes - 1 : 0));
+  std::memcpy(out, s.data(), nbytes);
+  out[nbytes] = '\0';
+  return nbytes;
+}
+
+}  // extern "C"

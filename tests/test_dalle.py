@@ -234,8 +234,9 @@ class TestCachedDecode:
             dict(shift_tokens=True),
             dict(shift_tokens=True, attn_types=("full", "axial_row")),
             dict(rotary_emb=False, stable=True, sandwich_norm=True),
+            dict(reversible=True, reversible_impl="revnet", shift_tokens=True),
         ],
-        ids=["plain", "shift", "shift+axial", "posemb+stable+sandwich"],
+        ids=["plain", "shift", "shift+axial", "posemb+stable+sandwich", "revnet"],
     )
     def test_cached_matches_full_forward(self, batch, kw):
         model = make_dalle(**kw)

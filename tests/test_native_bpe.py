@@ -1,0 +1,107 @@
+"""Native C++ BPE core (native/bpe.cpp via ctypes) — the framework's
+replacement for the reference's youtokentome dependency
+(`/root/reference/dalle_pytorch/tokenizer.py:232-266`).
+
+Skipped when no C++ toolchain is present.
+"""
+
+import shutil
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog\n"
+    "a small red circle above a large blue square\n"
+    "the small blue triangle next to the red circle\n"
+    "large green square below the small yellow triangle\n"
+) * 40
+
+
+@pytest.fixture(scope="module")
+def bpe():
+    from dalle_pytorch_tpu.data.native_bpe import NativeBPE
+
+    return NativeBPE.train(CORPUS, vocab_size=400)
+
+
+class TestNativeBPE:
+    def test_vocab_size_bounded(self, bpe):
+        assert 258 < bpe.vocab_size <= 400
+
+    @pytest.mark.parametrize(
+        "text",
+        [
+            "the quick red fox",
+            "unseen wörds häppen",  # utf-8 multi-byte
+            "  leading and   multiple spaces ",
+            "",
+        ],
+    )
+    def test_roundtrip_exact(self, bpe, text):
+        assert bpe.decode(bpe.encode(text)) == text
+
+    def test_trained_word_compresses(self, bpe):
+        assert len(bpe.encode("the")) == 1
+        assert len(bpe.encode("circle")) <= 2
+
+    def test_save_load_identical(self, bpe, tmp_path):
+        from dalle_pytorch_tpu.data.native_bpe import NativeBPE
+
+        path = tmp_path / "model.bpe"
+        bpe.save(path)
+        bpe2 = NativeBPE.load(path)
+        assert bpe2.vocab_size == bpe.vocab_size
+        text = "the lazy brown circle"
+        assert bpe2.encode(text) == bpe.encode(text)
+
+    def test_batch_encode_matches_single(self, bpe):
+        texts = ["the quick brown fox", "a small red circle", "dog"]
+        batch = bpe.encode_batch(texts, max_len=16)
+        assert batch.shape == (3, 16) and batch.dtype == np.int32
+        for row, t in zip(batch, texts):
+            single = bpe.encode(t)
+            assert list(row[: len(single)]) == single
+            assert (row[len(single) :] == 0).all()
+
+    def test_batch_overflow_raises_without_truncate(self, bpe):
+        with pytest.raises(RuntimeError, match="too long"):
+            bpe.encode_batch(["word " * 100], max_len=4, truncate=False)
+
+    def test_batch_truncates(self, bpe):
+        out = bpe.encode_batch(["word " * 100], max_len=4, truncate=True)
+        assert (out[0] != 0).all()
+
+    def test_threaded_batch_consistent(self, bpe):
+        texts = [f"the quick fox number {i}" for i in range(64)]
+        a = bpe.encode_batch(texts, max_len=24, n_threads=1)
+        b = bpe.encode_batch(texts, max_len=24, n_threads=8)
+        assert (a == b).all()
+
+
+class TestNativeBPETokenizer:
+    def test_tokenizer_contract(self, bpe, tmp_path):
+        from dalle_pytorch_tpu.data.tokenizer import NativeBPETokenizer, get_tokenizer
+
+        path = tmp_path / "model.bpe"
+        bpe.save(path)
+        tok = get_tokenizer(bpe_path=str(path), native=True)
+        assert isinstance(tok, NativeBPETokenizer)
+        arr = tok.tokenize(["the quick fox", "a red circle"], context_length=12)
+        assert arr.shape == (2, 12) and arr.dtype == np.int32
+        assert tok.decode(arr[0]) == "the quick fox"
+        with pytest.raises(RuntimeError):
+            tok.tokenize("fox " * 100, context_length=4)
+        assert tok.tokenize("fox " * 100, 4, truncate_text=True).shape == (1, 4)
+
+    def test_corrupt_model_rejected(self, tmp_path):
+        from dalle_pytorch_tpu.data.native_bpe import NativeBPE
+
+        bad = tmp_path / "bad.bpe"
+        bad.write_text("NATIVEBPE v1\n2\n999999 -5\n3 4\n")
+        with pytest.raises(FileNotFoundError):
+            NativeBPE.load(bad)
