@@ -61,7 +61,8 @@ class Attention(nn.Module):
     dropout: float = 0.0
     stable: bool = False
     static_mask: Optional[np.ndarray] = None  # [S, S] bool, True = attend
-    attn_impl: str = "auto"  # "dense" | "flash" (Pallas) | "auto"
+    attn_impl: str = "auto"  # "dense" | "flash" (Pallas) | "ring" | "auto"
+    sp_mesh: Any = None  # Mesh with an "sp" axis, required for attn_impl="ring"
     dtype: Any = jnp.float32
 
     def _use_flash(self, n: int, key_mask) -> bool:
@@ -136,7 +137,27 @@ class Attention(nn.Module):
             if rotary is not None:
                 rot = jnp.expand_dims(rotary[:n], (0, 1))
                 q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
-            if self._use_flash(n, key_mask):
+            if self.attn_impl == "ring":
+                # sequence-parallel exact attention: tokens sharded over the
+                # mesh "sp" axis, KV blocks rotate via ppermute (parallel/
+                # ring.py). Long-context path beyond the reference's
+                # sparsity-based scaling (SURVEY.md §5.7).
+                from dalle_pytorch_tpu.parallel.ring import ring_attention_sharded
+
+                assert self.sp_mesh is not None, 'attn_impl="ring" needs sp_mesh'
+                assert self.static_mask is None and key_mask is None, (
+                    "ring attention supports plain causal/full attention only"
+                )
+                sp = self.sp_mesh.shape["sp"]
+                assert n % sp == 0, (
+                    f"sequence length {n} must divide the sp axis ({sp}); note "
+                    "the uncached generate_images() re-forwards growing "
+                    "prefixes — use the KV-cached decode path with ring models"
+                )
+                out = ring_attention_sharded(
+                    self.sp_mesh, q, k, v, causal=self.causal
+                )
+            elif self._use_flash(n, key_mask):
                 out = flash_attention(
                     q, k, v,
                     mask=self._full_mask(n, n) if self.static_mask is not None else None,

@@ -105,7 +105,8 @@ class DALLE(nn.Module):
     img_loss_coeff: Optional[float] = None
     text_loss_coeff_inv: float = 7.0
     img_loss_coeff_inv: float = 1.0
-    attn_impl: str = "auto"  # "dense" | "flash" | "auto" (see models/attention.py)
+    attn_impl: str = "auto"  # "dense" | "flash" | "ring" | "auto"
+    sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
     dtype: Any = jnp.float32
 
     @property
@@ -154,6 +155,7 @@ class DALLE(nn.Module):
             reversible=self.reversible,
             reversible_impl=self.reversible_impl,
             attn_impl=self.attn_impl,
+            sp_mesh=self.sp_mesh,
             dtype=self.dtype,
         )
 

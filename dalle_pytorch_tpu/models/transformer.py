@@ -158,7 +158,8 @@ class Transformer(nn.Module):
     shared_ff_ids: Optional[Sequence[int]] = None
     reversible: bool = False
     reversible_impl: str = "remat"  # "remat" | "revnet" | "revnet_naive" (test)
-    attn_impl: str = "auto"  # "dense" | "flash" | "auto" (see models/attention.py)
+    attn_impl: str = "auto"  # "dense" | "flash" | "ring" | "auto"
+    sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -194,6 +195,7 @@ class Transformer(nn.Module):
                         attn_type, self.seq_len, self.image_fmap_size, ind
                     ),
                     attn_impl=self.attn_impl,
+                    sp_mesh=self.sp_mesh,
                     dtype=self.dtype,
                     name=f"attn_{attn_id}",
                 )

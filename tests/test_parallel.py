@@ -131,3 +131,61 @@ class TestShardedTrainStep:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
             )
+
+
+class TestRingInModel:
+    """attn_impl="ring": sequence-parallel DALLE must match the dense model
+    bit-for-bit in function value and gradients (long-context training path,
+    beyond the reference's sparsity-only sequence scaling, SURVEY.md §5.7)."""
+
+    def _models(self, mesh):
+        from dalle_pytorch_tpu.models.dalle import DALLE
+
+        kw = dict(
+            dim=32, depth=2, heads=2, dim_head=16, num_image_tokens=32,
+            image_fmap_size=4, num_text_tokens=30, text_seq_len=8,
+            shift_tokens=True, rotary_emb=True,
+        )
+        dense = DALLE(attn_impl="dense", **kw)
+        ring = DALLE(attn_impl="ring", sp_mesh=mesh, **kw)
+        return dense, ring
+
+    def test_forward_and_grads_match_dense(self):
+        mesh = make_mesh(dp=1, sp=8)
+        dense, ring = self._models(mesh)
+        text = jnp.asarray(
+            np.random.RandomState(0).randint(1, 30, size=(2, 8)), jnp.int32
+        )
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, 32, size=(2, 16)), jnp.int32
+        )
+        params = dense.init(jax.random.PRNGKey(0), text, toks)
+
+        def loss(v, m):
+            return m.apply(v, text, toks, return_loss=True)[0]
+
+        l_dense = loss(params, dense)
+        l_ring = loss(params, ring)
+        np.testing.assert_allclose(
+            float(l_dense), float(l_ring), rtol=2e-5
+        )
+        g_dense = jax.grad(loss)(params, dense)
+        g_ring = jax.grad(loss)(params, ring)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(g_dense), jax.tree_util.tree_leaves(g_ring)
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_ring_requires_mesh(self):
+        from dalle_pytorch_tpu.models.dalle import DALLE
+
+        model = DALLE(
+            dim=32, depth=1, heads=2, dim_head=16, num_image_tokens=32,
+            image_fmap_size=4, num_text_tokens=30, text_seq_len=8,
+            attn_impl="ring",
+        )
+        text = jnp.ones((1, 8), jnp.int32)
+        toks = jnp.zeros((1, 16), jnp.int32)
+        with pytest.raises(AssertionError, match="sp_mesh"):
+            model.init(jax.random.PRNGKey(0), text, toks)
