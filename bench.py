@@ -1,8 +1,8 @@
 """Benchmark: DALLE training throughput (image-tokens/sec/chip) + MFU.
 
-Runs the flagship train step (dim 1024 / depth 12 / 256 text + 256 image
-tokens, bf16 compute) on the available accelerator and prints ONE JSON
-line. The reference publishes no numbers (BASELINE.md) — its only runtime
+Runs the flagship train step (dim 1024 / depth 12, OpenAI-dVAE geometry:
+256 text + 1024 image tokens, bf16 compute) on the available accelerator
+and prints ONE JSON line. The reference publishes no numbers (BASELINE.md) — its only runtime
 metric is `sample_per_sec` (`/root/reference/train_dalle.py:578-581`) — so
 `vs_baseline` is reported against the ≥45%-MFU design target from
 BASELINE.json (value 1.0 == exactly hitting the target scaled to this
@@ -52,20 +52,28 @@ def transformer_train_flops(dim, depth, heads, dim_head, seq, ff_mult=4) -> floa
 
 
 def main():
+    import os
+
     from dalle_pytorch_tpu.models.dalle import DALLE
     from dalle_pytorch_tpu.training import TrainState, make_optimizer, make_dalle_train_step
 
+    # BASELINE.json ladder config: DALLE dim=1024 depth=12 with OpenAI-dVAE
+    # geometry (f/8: 32x32 = 1024 image tokens, seq 1280). Env overrides for
+    # A/B runs: BENCH_BATCH, BENCH_FMAP, BENCH_ATTN (dense|flash|auto).
     dim, depth, heads, dim_head = 1024, 12, 16, 64
-    text_seq, fmap = 256, 16
+    text_seq = 256
+    fmap = int(os.environ.get("BENCH_FMAP", "32"))
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    attn_impl = os.environ.get("BENCH_ATTN", "auto")
     image_seq = fmap * fmap
     seq = text_seq + image_seq
-    batch = 32
 
     model = DALLE(
         dim=dim, depth=depth, heads=heads, dim_head=dim_head,
         num_image_tokens=8192, image_fmap_size=fmap,
         num_text_tokens=10000, text_seq_len=text_seq,
-        shift_tokens=True, rotary_emb=True, dtype=jnp.bfloat16,
+        shift_tokens=True, rotary_emb=True, attn_impl=attn_impl,
+        dtype=jnp.bfloat16,
     )
     text = jnp.ones((batch, text_seq), jnp.int32)
     tokens = jnp.zeros((batch, image_seq), jnp.int32)
@@ -84,7 +92,7 @@ def main():
     state, metrics = step(state, batch_dict, rng)
     jax.block_until_ready(metrics["loss"])
 
-    n_steps = 20
+    n_steps = int(os.environ.get("BENCH_STEPS", "20"))
     t0 = time.perf_counter()
     for i in range(n_steps):
         rng, r = jax.random.split(rng)
@@ -109,7 +117,7 @@ def main():
                 "samples_per_sec": round(steps_per_sec * batch, 2),
                 "device": jax.devices()[0].device_kind,
                 "n_chips": n_chips,
-                "config": f"dim{dim}-depth{depth}-seq{seq}-bs{batch}-bf16",
+                "config": f"dim{dim}-depth{depth}-seq{seq}-bs{batch}-{attn_impl}-bf16",
             }
         )
     )

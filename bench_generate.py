@@ -1,0 +1,79 @@
+"""Benchmark: p50 latency of KV-cached image generation.
+
+The BASELINE.json inference north star: `generate.py` producing 256x256
+samples (OpenAI-dVAE geometry: 1024 image tokens autoregressively decoded
+through the scan-based KV cache). Prints ONE JSON line with the p50
+end-to-end latency for one batch of samples (transformer decode only; VAE
+pixel decode is a single extra forward and is reported separately).
+
+Env overrides: GEN_BATCH (default 4), GEN_FMAP (32), GEN_RUNS (5),
+GEN_COND_SCALE (1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from dalle_pytorch_tpu.models.dalle import DALLE, generate_images_cached
+
+    batch = int(os.environ.get("GEN_BATCH", "4"))
+    fmap = int(os.environ.get("GEN_FMAP", "32"))
+    runs = int(os.environ.get("GEN_RUNS", "5"))
+    cond_scale = float(os.environ.get("GEN_COND_SCALE", "1.0"))
+    text_seq = 256
+
+    model = DALLE(
+        dim=1024, depth=12, heads=16, dim_head=64,
+        num_image_tokens=8192, image_fmap_size=fmap,
+        num_text_tokens=10000, text_seq_len=text_seq,
+        shift_tokens=True, rotary_emb=True, dtype=jnp.bfloat16,
+    )
+    text = jnp.ones((batch, text_seq), jnp.int32)
+    tokens = jnp.zeros((batch, fmap * fmap), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), text, tokens)
+
+    def sample(rng):
+        return generate_images_cached(
+            model, params, rng, text, cond_scale=cond_scale
+        )
+
+    # warmup / compile
+    out = sample(jax.random.PRNGKey(1))
+    jax.block_until_ready(out)
+
+    times = []
+    for i in range(runs):
+        t0 = time.perf_counter()
+        out = sample(jax.random.PRNGKey(2 + i))
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    p50 = times[len(times) // 2]
+
+    print(
+        json.dumps(
+            {
+                "metric": "generate_p50_latency_batch",
+                "value": round(p50, 3),
+                "unit": "s",
+                "vs_baseline": None,  # reference publishes no latency numbers
+                "batch": batch,
+                "image_tokens": fmap * fmap,
+                "tokens_per_sec": round(batch * fmap * fmap / p50, 1),
+                "device": jax.devices()[0].device_kind,
+                "config": f"dim1024-depth12-fmap{fmap}-bs{batch}"
+                          f"-cond{cond_scale}-bf16-cached",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

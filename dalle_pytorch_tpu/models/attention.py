@@ -148,6 +148,10 @@ class Attention(nn.Module):
                 assert self.static_mask is None and key_mask is None, (
                     "ring attention supports plain causal/full attention only"
                 )
+                # the streaming LSE accumulator is inherently max-subtracted;
+                # reject the stable flag rather than silently diverge from
+                # the dense stable-softmax numerics
+                assert not self.stable, 'attn_impl="ring" does not take stable='
                 sp = self.sp_mesh.shape["sp"]
                 assert n % sp == 0, (
                     f"sequence length {n} must divide the sp axis ({sp}); note "

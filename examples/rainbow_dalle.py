@@ -109,7 +109,7 @@ def main():
     save_image_grid(denorm(recon), out_dir / "recon.png")
 
     # --------------------------------------------------------------- DALLE
-    fmap = args.image_size // (2 ** vae.num_layers)
+    fmap = vae.fmap_size
     model = DALLE(
         dim=128, depth=4, heads=4, dim_head=32,
         num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
@@ -162,12 +162,14 @@ def main():
         per_tok = float((sampled == gt).mean())
         return exact, per_tok, sampled
 
-    train_idx = range(min(args.eval_samples, n_train))
-    test_idx = range(n_train, min(n_train + args.eval_samples, len(data)))
-    tr_exact, tr_tok, sampled = exact_accuracy(list(train_idx))
-    te_exact, te_tok, _ = exact_accuracy(list(test_idx))
-    print(f"train: exact {tr_exact:.2f}, per-token {tr_tok:.3f} | "
-          f"test: exact {te_exact:.2f}, per-token {te_tok:.3f}")
+    train_idx = list(range(min(args.eval_samples, n_train)))
+    test_idx = list(range(n_train, min(n_train + args.eval_samples, len(data))))
+    tr_exact, tr_tok, sampled = exact_accuracy(train_idx)
+    report = f"train: exact {tr_exact:.2f}, per-token {tr_tok:.3f}"
+    if test_idx:
+        te_exact, te_tok, _ = exact_accuracy(test_idx)
+        report += f" | test: exact {te_exact:.2f}, per-token {te_tok:.3f}"
+    print(report)
     print("(reference notebook bar at convergence: exact 1.0 train / ~0.3 test)")
 
     gen = vae.apply({"params": vstate.params}, jnp.asarray(sampled),
