@@ -7,5 +7,7 @@ from dalle_pytorch_tpu.data.tokenizer import (
     get_tokenizer,
 )
 from dalle_pytorch_tpu.data.rainbow import RainbowDataset
-from dalle_pytorch_tpu.data.loader import TextImageDataset, Cub2011, MnistDataset
+from dalle_pytorch_tpu.data.loader import (
+    TextImageDataset, Cub2011, MnistDataset, TokenDataset,
+)
 from dalle_pytorch_tpu.data.webdataset import TarImageTextDataset

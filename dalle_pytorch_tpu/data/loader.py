@@ -305,3 +305,51 @@ class TextImageDataset:
                 return
             texts, images = zip(*(self.item(int(i)) for i in sel))
             yield {"text": np.stack(texts), "images": np.stack(images)}
+
+
+class TokenDataset:
+    """Precomputed-token dataset (`precompute_tokens.py` output).
+
+    The offline-encode counterpart of the reference's in-forward frozen-VAE
+    encode (`dalle_pytorch.py:619-627`): batches carry `image_tokens`
+    directly, so the train step skips the VAE entirely (SURVEY.md §7 hard
+    parts: "precompute tokens as an offline pass — better TPU pattern").
+    """
+
+    def __init__(self, npz_path, tokenizer, text_len: int):
+        data = np.load(npz_path, allow_pickle=False)
+        self.captions = [str(c) for c in data["captions"]]
+        self.image_tokens = np.asarray(data["image_tokens"], np.int32)
+        self.num_tokens = int(data["num_tokens"])
+        self.image_size = int(data["image_size"])
+        self.num_layers = int(data["num_layers"])
+        self.vae_class_name = str(data["vae_class_name"])
+        self.tokenizer = tokenizer
+        self.text_len = text_len
+        assert len(self.captions) == self.image_tokens.shape[0]
+
+    def __len__(self) -> int:
+        return len(self.captions)
+
+    def batches(
+        self,
+        batch_size: int,
+        shuffle_seed: Optional[int] = None,
+        shard: Tuple[int, int] = (0, 1),
+        drop_last: bool = True,
+    ) -> Iterator[dict]:
+        order = np.arange(len(self))
+        if shuffle_seed is not None:
+            np.random.RandomState(shuffle_seed).shuffle(order)
+        order = host_shard_order(order, shard)
+        for start in range(0, len(order), batch_size):
+            sel = order[start : start + batch_size]
+            if drop_last and len(sel) < batch_size:
+                return
+            yield {
+                "text": self.tokenizer.tokenize(
+                    [self.captions[i] for i in sel], self.text_len,
+                    truncate_text=True,
+                ),
+                "image_tokens": self.image_tokens[sel],
+            }

@@ -170,17 +170,24 @@ class DALLE(nn.Module):
                 "logits_bias", nn.initializers.zeros, (self.total_tokens,)
             )
 
-        # static logits-range masks; True = BLOCKED (reference `:450-464`)
-        seq = np.arange(self.total_seq_len)[:, None]
-        vocab = np.arange(self.total_tokens)[None, :]
-        mask = ((seq >= self.text_seq_len) & (vocab < self.total_text_tokens)) | (
-            (seq < self.text_seq_len) & (vocab >= self.total_text_tokens)
-        )
-        self._logits_mask = mask
-        # inverse mode: image occupies the front of the sequence (`:463`)
-        self._logits_mask_inv = np.concatenate(
-            [mask[self.text_seq_len :], mask[: self.text_seq_len]], axis=0
-        )
+        # logits-range masks (reference `:450-464`) are computed on the fly
+        # from iotas in _logits_blocked — a [total_seq, total_tokens] bool
+        # constant would bake ~20MB into the executable for nothing.
+
+    def _logits_blocked(self, seq_len: int, inverse: bool) -> jnp.ndarray:
+        """[seq_len, total_tokens] bool, True = BLOCKED (reference `:450-464`).
+
+        Text positions may only emit text-vocab ids and image positions
+        image-vocab ids; `inverse` rotates the rows by text_seq_len since
+        the image occupies the front of the sequence (`:463`).
+        """
+        rows = jnp.arange(seq_len)
+        if inverse:
+            rows = (rows + self.text_seq_len) % self.total_seq_len
+        vocab = jnp.arange(self.total_tokens)[None, :]
+        is_text_row = (rows < self.text_seq_len)[:, None]
+        is_text_vocab = vocab < self.total_text_tokens
+        return is_text_row != is_text_vocab
 
     def to_logits(self, out: jnp.ndarray) -> jnp.ndarray:
         if self.stable:
@@ -255,8 +262,7 @@ class DALLE(nn.Module):
         )
         logits = self.to_logits(out)
 
-        lmask = self._logits_mask_inv if inverse_mapping else self._logits_mask
-        lmask = jnp.asarray(lmask[:seq_len])[None]
+        lmask = self._logits_blocked(seq_len, inverse_mapping)[None]
         logits = jnp.where(lmask, NEG_MASK_VALUE, logits.astype(jnp.float32))
 
         if not return_loss:

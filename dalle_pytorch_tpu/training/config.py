@@ -111,6 +111,7 @@ class TrainConfig:
     vqgan_model_path: Optional[str] = None
     vqgan_config_path: Optional[str] = None
     image_text_folder: Optional[str] = None
+    tokens_path: Optional[str] = None  # precompute_tokens.py artifact
     wds: str = ""
     output_dir: str = "checkpoints"
     dalle_output_file_name: str = "dalle"

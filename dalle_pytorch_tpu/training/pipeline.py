@@ -69,6 +69,7 @@ def build_dataset(cfg: TrainConfig, tokenizer, image_size: int):
                     cfg.model.text_seq_len,
                     shuffle_seed=shuffle_seed,
                     shard=shard,
+                    **kw,
                 )
 
         return _RainbowAdapter()

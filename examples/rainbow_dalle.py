@@ -7,7 +7,10 @@ synthetic dataset of colored shapes with compositional captions, train the
 DiscreteVAE, inspect reconstructions, train DALLE on a train split, and
 measure exact image-token-sequence accuracy on train vs. held-out captions
 (the notebook reports 1.0 train / ~0.3 test at convergence; reach it by
-raising --vae-steps/--dalle-steps).
+raising --vae-steps/--dalle-steps). Note exact match is bounded above by
+caption ambiguity: repeated (size, color, shape) combos differ by a small
+deterministic center jitter the caption does not determine, so at larger
+--num-samples per-token accuracy is the cleaner signal.
 
 Run (CPU ok for small settings):
   python examples/rainbow_dalle.py --num-samples 512 --dalle-steps 300

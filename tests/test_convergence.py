@@ -1,0 +1,49 @@
+"""Golden learning-signal integration test (SURVEY.md §4d).
+
+Mirrors the reference's de-facto integration bar — the rainbow notebook's
+exact image-token-sequence accuracy (`examples/rainbow_dalle.ipynb` cells
+43-44: 1.0 train at convergence) — at a scale small enough for CI: overfit
+16 samples and require near-perfect exact-match accuracy plus a genuinely
+trained (non-collapsed) dVAE.
+"""
+
+import os
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+ENV = {**os.environ, "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"}
+
+
+class TestRainbowConvergence:
+    def test_overfit_reaches_exact_accuracy(self, tmp_path):
+        result = subprocess.run(
+            [
+                sys.executable, str(REPO / "examples" / "rainbow_dalle.py"),
+                "--num-samples", "16", "--train-frac", "1.0",
+                "--image-size", "16", "--batch-size", "16",
+                "--vae-steps", "250", "--dalle-steps", "250",
+                "--eval-samples", "16", "--out-dir", str(tmp_path), "--cpu",
+            ],
+            capture_output=True, text=True, timeout=1200, cwd=tmp_path, env=ENV,
+        )
+        assert result.returncode == 0, (
+            f"example failed:\n{result.stdout[-2000:]}\n{result.stderr[-2000:]}"
+        )
+        out = result.stdout
+
+        m = re.search(r"hard-recon MSE: ([\d.]+); codebook usage: (\d+)/", out)
+        assert m, f"no recon line in:\n{out}"
+        mse, usage = float(m.group(1)), int(m.group(2))
+        assert mse < 0.05, f"dVAE failed to reconstruct (MSE {mse})"
+        assert usage >= 2, f"dVAE codebook collapsed ({usage} codes)"
+
+        m = re.search(r"train: exact ([\d.]+), per-token ([\d.]+)", out)
+        assert m, f"no accuracy line in:\n{out}"
+        exact, per_tok = float(m.group(1)), float(m.group(2))
+        assert per_tok > 0.95, f"per-token accuracy only {per_tok}"
+        assert exact >= 0.9, f"exact-sequence accuracy only {exact}"
+
+        assert (tmp_path / "generated.png").exists()

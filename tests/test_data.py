@@ -237,3 +237,56 @@ class TestCrop:
         out = random_resized_crop(img, 32, rng)
         assert out.shape == (32, 32, 3)
         assert 0.0 <= out.min() and out.max() <= 1.0
+
+
+class TestTokenDataset:
+    """Offline token precompute (precompute_tokens.py + TokenDataset) — the
+    offline counterpart of the in-forward frozen-VAE encode
+    (`dalle_pytorch.py:619-627`)."""
+
+    def test_roundtrip(self, tmp_path):
+        import subprocess, sys, os
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parents[1]
+        env = {**os.environ, "PYTHONPATH": str(repo),
+               "DALLE_TPU_FORCE_PLATFORM": "cpu"}
+
+        # tiny dVAE checkpoint
+        import jax, jax.numpy as jnp
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+        from dalle_pytorch_tpu.training.pipeline import save_vae_checkpoint
+
+        vae = DiscreteVAE(image_size=16, num_layers=2, num_tokens=32,
+                          codebook_dim=16, hidden_dim=16)
+        params = vae.init(
+            {"params": jax.random.PRNGKey(0), "gumbel": jax.random.PRNGKey(1)},
+            jnp.zeros((1, 16, 16, 3)),
+        )["params"]
+        save_vae_checkpoint(str(tmp_path / "vae.npz"), vae, params)
+
+        out = subprocess.run(
+            [sys.executable, str(repo / "precompute_tokens.py"),
+             "--image_text_folder", "rainbow:20",
+             "--vae_path", str(tmp_path / "vae.npz"),
+             "--batch_size", "8", "--output", str(tmp_path / "tok.npz")],
+            capture_output=True, text=True, timeout=600, env=env, cwd=tmp_path,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+
+        from dalle_pytorch_tpu.data.loader import TokenDataset
+        from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+
+        ds = TokenDataset(tmp_path / "tok.npz", ByteTokenizer(), text_len=16)
+        assert len(ds) == 20  # drop_last=False keeps the ragged tail
+        assert ds.num_tokens == 32 and ds.image_size == 16
+        batches = list(ds.batches(8, shuffle_seed=0))
+        assert len(batches) == 2  # 20 // 8 full batches
+        b = batches[0]
+        assert b["text"].shape == (8, 16)
+        assert b["image_tokens"].shape == (8, 16)  # 4x4 fmap
+        assert b["image_tokens"].dtype == np.int32
+        # captions roundtrip through the tokenizer
+        text = ByteTokenizer().decode(b["text"][0])
+        # text_len=16 may truncate the shape word; size words survive
+        assert any(w in text for w in ("small", "medium", "large"))

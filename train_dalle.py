@@ -26,6 +26,8 @@ def parse_args():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--config", type=str, default=None)
     p.add_argument("--image_text_folder", type=str, default=None)
+    p.add_argument("--tokens_path", type=str, default=None,
+                   help="precompute_tokens.py artifact; trains from tokens")
     p.add_argument("--vae_path", type=str, default=None)
     p.add_argument("--dalle_path", type=str, default=None, help="resume checkpoint")
     p.add_argument("--taming", action="store_true")
@@ -82,7 +84,7 @@ def main():
 
             _set_dotted(cfg, k.strip(), v.strip())
     for k in ("epochs", "batch_size", "learning_rate", "image_text_folder",
-              "vae_path", "exp"):
+              "tokens_path", "vae_path", "exp"):
         v = getattr(args, k)
         if v is not None:
             setattr(cfg, k, v)
@@ -97,7 +99,20 @@ def main():
     if args.dalle_path and vae_params_resume is not None:
         vae_params = vae_params_resume
     image_fmap_size = vae.image_size // (2 ** vae.num_layers)
-    dataset = build_dataset(cfg, tokenizer, image_size=vae.image_size)
+    if cfg.tokens_path:
+        # offline-precomputed tokens (precompute_tokens.py): the train step
+        # skips the VAE encode entirely — the better TPU pattern
+        from dalle_pytorch_tpu.data.loader import TokenDataset
+
+        dataset = TokenDataset(
+            cfg.tokens_path, tokenizer, cfg.model.text_seq_len
+        )
+        assert dataset.num_tokens == vae.num_tokens, (
+            f"tokens were precomputed with a {dataset.num_tokens}-code VAE "
+            f"but --vae_path has {vae.num_tokens}"
+        )
+    else:
+        dataset = build_dataset(cfg, tokenizer, image_size=vae.image_size)
     print(f"{len(dataset)} image-text pairs for training")
 
     model = dalle_from_config(
@@ -128,7 +143,7 @@ def main():
     txt_sh = batch_sharding(mesh, extra_dims=1)
     state = jax.device_put(state, state_sh)
 
-    in_step_encode = isinstance(vae, DiscreteVAE)
+    in_step_encode = isinstance(vae, DiscreteVAE) and not cfg.tokens_path
     if in_step_encode:
         img_sh = batch_sharding(mesh, extra_dims=3)
         vae_sh = partition_params(vae_params, mesh)
@@ -204,7 +219,10 @@ def main():
                 rng, r = jax.random.split(rng)
                 state, metrics = step_fn(state, dev_batch, r, vae_params)
             else:
-                tokens = vae.get_codebook_indices(jnp.asarray(batch["images"]))
+                if "image_tokens" in batch:  # precomputed (TokenDataset)
+                    tokens = jnp.asarray(batch["image_tokens"])
+                else:  # pretrained torch-backed VAE: host-side encode
+                    tokens = vae.get_codebook_indices(jnp.asarray(batch["images"]))
                 dev_batch = {
                     "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
                     "image_tokens": jax.device_put(tokens, txt_sh),
