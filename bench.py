@@ -12,10 +12,11 @@ chip count).
 from __future__ import annotations
 
 import json
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+METRIC = "dalle_train_image_tokens_per_sec_per_chip"
+UNIT = "img-tok/s/chip"
 
 
 # published bf16 peak FLOP/s per chip
@@ -30,6 +31,8 @@ PEAK_FLOPS = {
 
 
 def peak_flops_per_chip() -> float:
+    import jax
+
     kind = jax.devices()[0].device_kind.lower()
     for key, val in PEAK_FLOPS.items():
         if key in kind:
@@ -53,6 +56,9 @@ def transformer_train_flops(dim, depth, heads, dim_head, seq, ff_mult=4) -> floa
 
 def main():
     import os
+
+    import jax
+    import jax.numpy as jnp
 
     from dalle_pytorch_tpu.models.dalle import DALLE
     from dalle_pytorch_tpu.training import TrainState, make_optimizer, make_dalle_train_step
@@ -109,9 +115,10 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "dalle_train_image_tokens_per_sec_per_chip",
+                "metric": METRIC,
                 "value": round(img_tok_per_sec_chip, 1),
-                "unit": "img-tok/s/chip",
+                "unit": UNIT,
+                "ok": True,
                 "vs_baseline": round(mfu / 0.45, 4),
                 "mfu": round(mfu, 4),
                 "samples_per_sec": round(steps_per_sec * batch, 2),
@@ -124,4 +131,21 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        main()
+    else:
+        from bench_common import run_guarded
+
+        run_guarded(
+            METRIC,
+            UNIT,
+            __file__,
+            child_timeout=1800.0,
+            # CPU fallback: shrink to something that finishes, still a
+            # valid (clearly-labelled) record rather than a dead signal.
+            cpu_env_defaults={
+                "BENCH_BATCH": "1",
+                "BENCH_FMAP": "16",
+                "BENCH_STEPS": "3",
+            },
+        )

@@ -1,0 +1,132 @@
+"""Shared wedge-guard harness for the bench entry points.
+
+The TPU tunnel backend has a known failure mode where `jax.devices()`
+hangs indefinitely for every process after a killed device job. A bench
+that hangs (or dies with a stack trace) records nothing; the contract
+with the driver is ONE JSON line, always. So every bench runs as:
+
+  parent (never touches a JAX backend)
+    ├─ probe subprocess: tiny matmul under a hard timeout → platform info
+    └─ child subprocess: the real measurement under a generous timeout
+
+and the parent turns every failure mode — wedged tunnel, OOM, crash,
+hang — into a clean structured-failure JSON line with exit code 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+PROBE_CODE = (
+    "import json, time, jax, jax.numpy as jnp\n"
+    "t0 = time.perf_counter()\n"
+    "x = jnp.ones((256, 256))\n"
+    "y = float((x @ x).sum())\n"
+    "d = jax.devices()[0]\n"
+    "print(json.dumps({'platform': d.platform, 'device_kind': d.device_kind,\n"
+    "                  'n_devices': jax.device_count(),\n"
+    "                  'probe_s': round(time.perf_counter() - t0, 2),\n"
+    "                  'matmul': y}))\n"
+)
+
+
+def _last_json_line(text: str):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def probe_device(timeout: float = 90.0):
+    """Tiny matmul in a subprocess. Returns device info dict or None."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    return _last_json_line(proc.stdout)
+
+
+def emit_failure(metric: str, unit: str, error: str) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": 0,
+                "unit": unit,
+                "vs_baseline": 0.0,
+                "ok": False,
+                "error": error,
+            }
+        )
+    )
+
+
+def run_guarded(
+    metric: str,
+    unit: str,
+    script: str,
+    child_timeout: float = 1800.0,
+    cpu_env_defaults: dict | None = None,
+) -> None:
+    """Probe, then run `script --child` and forward its JSON line.
+
+    `cpu_env_defaults` are env vars applied (setdefault) when the probed
+    platform is CPU, to shrink the workload to something that finishes.
+    """
+    info = probe_device()
+    if info is None:
+        emit_failure(
+            metric,
+            unit,
+            "device probe failed: accelerator backend unavailable or wedged "
+            "(timed small matmul did not complete in 90s)",
+        )
+        return
+
+    env = dict(os.environ)
+    if info.get("platform") == "cpu":
+        for k, v in (cpu_env_defaults or {}).items():
+            env.setdefault(k, v)
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(script), "--child"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            timeout=child_timeout,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        emit_failure(
+            metric, unit, f"bench child exceeded {child_timeout:.0f}s watchdog"
+        )
+        return
+
+    result = _last_json_line(proc.stdout)
+    if proc.returncode != 0 or result is None:
+        tail = "\n".join(
+            (proc.stderr or proc.stdout or "").splitlines()[-12:]
+        )
+        emit_failure(
+            metric,
+            unit,
+            f"bench child rc={proc.returncode}, no JSON produced: {tail}",
+        )
+        return
+    print(json.dumps(result))

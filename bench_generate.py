@@ -14,13 +14,17 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+METRIC = "generate_p50_latency_batch"
+UNIT = "s"
 
 
 def main():
+    import jax
+    import jax.numpy as jnp
+
     from dalle_pytorch_tpu.models.dalle import DALLE, generate_images_cached
 
     batch = int(os.environ.get("GEN_BATCH", "4"))
@@ -60,9 +64,10 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "generate_p50_latency_batch",
+                "metric": METRIC,
                 "value": round(p50, 3),
-                "unit": "s",
+                "unit": UNIT,
+                "ok": True,
                 "vs_baseline": None,  # reference publishes no latency numbers
                 "batch": batch,
                 "image_tokens": fmap * fmap,
@@ -76,4 +81,19 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        main()
+    else:
+        from bench_common import run_guarded
+
+        run_guarded(
+            METRIC,
+            UNIT,
+            __file__,
+            child_timeout=1800.0,
+            cpu_env_defaults={
+                "GEN_BATCH": "1",
+                "GEN_FMAP": "8",
+                "GEN_RUNS": "2",
+            },
+        )

@@ -277,7 +277,7 @@ class TextImageDataset:
                 i = int(self.rng.randint(0, len(self.dataset)))
         raise RuntimeError("too many corrupt samples in a row")
 
-    def item(self, i: int) -> Tuple[np.ndarray, np.ndarray]:
+    def item(self, i: int) -> Tuple[np.ndarray, np.ndarray, str]:
         caption, img = self._sample(i)
         text = self.tokenizer.tokenize(
             caption, self.text_len, truncate_text=self.truncate_captions
@@ -285,7 +285,7 @@ class TextImageDataset:
         img = random_resized_crop(
             img, self.image_size, self.rng, scale=(self.resize_ratio, 1.0)
         )
-        return text, img
+        return text, img, caption
 
     def batches(
         self,
@@ -293,18 +293,28 @@ class TextImageDataset:
         shuffle_seed: Optional[int] = None,
         shard: Tuple[int, int] = (0, 1),
         drop_last: bool = True,
+        start_batch: int = 0,
     ) -> Iterator[dict]:
-        """Host-sharded minibatch stream: {"text": [B,T], "images": [B,H,W,3]}."""
+        """Host-sharded minibatch stream: {"text": [B,T] token ids,
+        "images": [B,H,W,3], "captions": [B] raw strings} — raw captions
+        ride along so consumers (precompute_tokens, sample logging) never
+        have to lossily decode token ids back to text. `start_batch` skips
+        the first N batches by index (O(1) — mid-epoch resume without
+        paying decode/augment for already-consumed data)."""
         order = np.arange(len(self.dataset))
         if shuffle_seed is not None:
             np.random.RandomState(shuffle_seed).shuffle(order)
         order = host_shard_order(order, shard)
-        for start in range(0, len(order), batch_size):
+        for start in range(start_batch * batch_size, len(order), batch_size):
             sel = order[start : start + batch_size]
             if drop_last and len(sel) < batch_size:
                 return
-            texts, images = zip(*(self.item(int(i)) for i in sel))
-            yield {"text": np.stack(texts), "images": np.stack(images)}
+            texts, images, caps = zip(*(self.item(int(i)) for i in sel))
+            yield {
+                "text": np.stack(texts),
+                "images": np.stack(images),
+                "captions": list(caps),
+            }
 
 
 class TokenDataset:
@@ -337,19 +347,21 @@ class TokenDataset:
         shuffle_seed: Optional[int] = None,
         shard: Tuple[int, int] = (0, 1),
         drop_last: bool = True,
+        start_batch: int = 0,
     ) -> Iterator[dict]:
         order = np.arange(len(self))
         if shuffle_seed is not None:
             np.random.RandomState(shuffle_seed).shuffle(order)
         order = host_shard_order(order, shard)
-        for start in range(0, len(order), batch_size):
+        for start in range(start_batch * batch_size, len(order), batch_size):
             sel = order[start : start + batch_size]
             if drop_last and len(sel) < batch_size:
                 return
+            caps = [self.captions[i] for i in sel]
             yield {
                 "text": self.tokenizer.tokenize(
-                    [self.captions[i] for i in sel], self.text_len,
-                    truncate_text=True,
+                    caps, self.text_len, truncate_text=True
                 ),
                 "image_tokens": self.image_tokens[sel],
+                "captions": caps,
             }

@@ -110,7 +110,7 @@ class RainbowDataset:
 
     def batches(self, batch_size: int, tokenizer, text_seq_len: int, *,
                 shuffle_seed: int | None = None, shard: Tuple[int, int] = (0, 1),
-                drop_last: bool = True):
+                drop_last: bool = True, start_batch: int = 0):
         """Yield {"text": [B,T] int32, "images": [B,H,W,3] float32} batches.
 
         `shard=(i, n)` gives host i of n its interleaved subset — the
@@ -123,7 +123,7 @@ class RainbowDataset:
         if shuffle_seed is not None:
             np.random.RandomState(shuffle_seed).shuffle(order)
         order = host_shard_order(order, shard)
-        for start in range(0, len(order), batch_size):
+        for start in range(start_batch * batch_size, len(order), batch_size):
             sel = order[start : start + batch_size]
             if drop_last and len(sel) < batch_size:
                 return
@@ -131,4 +131,5 @@ class RainbowDataset:
             yield {
                 "text": tokenizer.tokenize(texts, text_seq_len, truncate_text=True),
                 "images": np.stack([self.image(i) for i in sel]),
+                "captions": texts,
             }

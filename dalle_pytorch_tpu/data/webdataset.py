@@ -100,6 +100,7 @@ class TarImageTextDataset:
         resize_ratio: float = 0.75,
         tokenizer=None,
         seed: int = 0,
+        shuffle_buffer: int = 1000,
     ):
         self.shards = expand_shards(urls)
         assert self.shards, f"no shards matched {urls}"
@@ -115,6 +116,7 @@ class TarImageTextDataset:
         self.truncate = truncate_captions
         self.resize_ratio = resize_ratio
         self.rng = np.random.RandomState(seed)
+        self.shuffle_buffer = shuffle_buffer
 
     def _decode(self, sample: dict) -> Optional[Tuple[str, np.ndarray]]:
         from PIL import Image
@@ -135,23 +137,75 @@ class TarImageTextDataset:
             print(f"[wds] skipping undecodable sample: {e}")
             return None
 
-    def samples(self, shard: Tuple[int, int] = (0, 1)) -> Iterator[Tuple[str, np.ndarray]]:
-        """Shard-level host split: host i reads every n-th tar shard."""
+    def samples(
+        self,
+        shard: Tuple[int, int] = (0, 1),
+        shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Tuple[str, np.ndarray]]:
+        """Shard-level host split: host i reads every n-th tar shard.
+
+        With `shuffle_seed`, the per-host shard order is permuted and
+        samples pass through a reservoir-style shuffle buffer — the
+        streaming equivalent of the reference's `wds.WebDataset` shuffle
+        stage (`/root/reference/train_dalle.py:257-278`). Different seeds
+        (e.g. seed+epoch) give a fresh order every epoch.
+        """
         if shard[1] > 1 and len(self.shards) < shard[1]:
             raise ValueError(
                 f"{len(self.shards)} tar shards cannot be split across "
                 f"{shard[1]} hosts — provide at least one shard per host"
             )
         my_shards = self.shards[shard[0] :: shard[1]]
-        for url in my_shards:
-            for raw in _iter_tar_samples(url):
-                decoded = self._decode(raw)
-                if decoded is not None:
-                    yield decoded
+        rng = None
+        if shuffle_seed is not None:
+            rng = np.random.RandomState(shuffle_seed)
+            my_shards = [my_shards[i] for i in rng.permutation(len(my_shards))]
 
-    def batches(self, batch_size: int, shard: Tuple[int, int] = (0, 1)) -> Iterator[dict]:
-        texts, images = [], []
-        for caption, img in self.samples(shard):
+        def raw_stream() -> Iterator[dict]:
+            for url in my_shards:
+                yield from _iter_tar_samples(url)
+
+        def shuffled_raw() -> Iterator[dict]:
+            # Buffer RAW tar samples (compressed bytes, ~100KB each), not
+            # decoded arrays — decoding before the 1000-slot buffer would
+            # hold ~GBs of pixels per host. Decode happens on yield, with
+            # failures filtered after the shuffle stage, exactly like the
+            # reference's shuffle->decode(warn_and_continue) pipeline order.
+            if rng is None or self.shuffle_buffer <= 1:
+                yield from raw_stream()
+                return
+            buf: List[dict] = []
+            for item in raw_stream():
+                buf.append(item)
+                if len(buf) >= self.shuffle_buffer:
+                    j = rng.randint(len(buf))
+                    buf[j], buf[-1] = buf[-1], buf[j]
+                    yield buf.pop()
+            rng.shuffle(buf)
+            yield from buf
+
+        for raw in shuffled_raw():
+            decoded = self._decode(raw)
+            if decoded is not None:
+                yield decoded
+
+    def batches(
+        self,
+        batch_size: int,
+        shuffle_seed: Optional[int] = None,
+        shard: Tuple[int, int] = (0, 1),
+        start_batch: int = 0,
+    ) -> Iterator[dict]:
+        """`start_batch` skips already-consumed batches on resume. For a
+        streaming tar source the skip must still read+decode the stream to
+        keep the sample order identical — unavoidable without an index."""
+        stream = self.samples(shard, shuffle_seed=shuffle_seed)
+        if start_batch:
+            import itertools
+
+            stream = itertools.islice(stream, start_batch * batch_size, None)
+        texts, images, captions = [], [], []
+        for caption, img in stream:
             texts.append(
                 self.tokenizer.tokenize(caption, self.text_len, self.truncate)[0]
             )
@@ -160,6 +214,11 @@ class TarImageTextDataset:
                     img, self.image_size, self.rng, scale=(self.resize_ratio, 1.0)
                 )
             )
+            captions.append(caption)
             if len(texts) == batch_size:
-                yield {"text": np.stack(texts), "images": np.stack(images)}
-                texts, images = [], []
+                yield {
+                    "text": np.stack(texts),
+                    "images": np.stack(images),
+                    "captions": captions,
+                }
+                texts, images, captions = [], [], []

@@ -219,15 +219,23 @@ def save_dalle_checkpoint(
     epoch: int,
     vae_class_name: str,
     vae_hparams: Optional[dict] = None,
+    opt_state: Any = None,
+    train_meta: Optional[dict] = None,
 ):
     """Portable single-file DALLE ckpt carrying the reference's payload
-    ({hparams, vae_params, epoch, version, vae_class_name, weights},
-    `train_dalle.py:432-439,472-479`). `vae_hparams` records the ACTUAL
-    frozen VAE geometry (not cfg.vae, which may be stale when the VAE came
-    from --vae_path)."""
+    ({hparams, vae_params, epoch, version, vae_class_name, weights,
+    opt_state, scheduler_state}, `train_dalle.py:432-439,472-479`).
+    `vae_hparams` records the ACTUAL frozen VAE geometry (not cfg.vae,
+    which may be stale when the VAE came from --vae_path). `opt_state`
+    is stored as leaves in tree-flatten order — restorable into any
+    optimizer with the same structure (i.e. the same config).
+    `train_meta` carries scheduler/global-step state for exact resume."""
     trees = {"dalle": dalle_params}
     if vae_params is not None:
         trees["vae"] = vae_params
+    if opt_state is not None:
+        leaves = jax.tree_util.tree_leaves(opt_state)
+        trees["opt"] = {f"{i:04d}": np.asarray(leaf) for i, leaf in enumerate(leaves)}
     save_params_npz(
         path,
         trees,
@@ -238,12 +246,15 @@ def save_dalle_checkpoint(
             "vae_class_name": vae_class_name,
             "vae_hparams": vae_hparams,
             "config": config_to_dict(cfg),
+            "train": train_meta or {},
         },
     )
 
 
 def load_dalle_checkpoint(path: str):
-    """Returns (cfg, dalle_params, vae_params_or_None, metadata)."""
+    """Returns (cfg, dalle_params, vae_params_or_None, metadata,
+    opt_leaves_or_None). Restore the optimizer with
+    `restore_opt_state(fresh_opt_state, opt_leaves)`."""
     params, meta = load_params_npz(path)
     assert meta.get("type") == "DALLE", f"{path} is not a DALLE checkpoint"
     cfg = TrainConfig()
@@ -254,7 +265,34 @@ def load_dalle_checkpoint(path: str):
     vae_params = (
         jax.tree.map(jnp.asarray, params["vae"]) if "vae" in params else None
     )
-    return cfg, dalle_params, vae_params, meta
+    opt_leaves = None
+    if "opt" in params:
+        # numeric sort: lexicographic would scramble order past 9999 leaves
+        opt_leaves = [params["opt"][k] for k in sorted(params["opt"], key=int)]
+    return cfg, dalle_params, vae_params, meta, opt_leaves
+
+
+def restore_opt_state(fresh_opt_state: Any, opt_leaves):
+    """Rebuild a saved optimizer state into `fresh_opt_state`'s structure
+    (the resume half of the reference's `opt.load_state_dict`,
+    `/root/reference/train_dalle.py:330-338`). Returns the restored state,
+    or `fresh_opt_state` unchanged (with a warning) on mismatch — e.g.
+    when resuming with a changed optimizer config."""
+    if opt_leaves is None:
+        return fresh_opt_state
+    treedef = jax.tree_util.tree_structure(fresh_opt_state)
+    fresh_leaves = jax.tree_util.tree_leaves(fresh_opt_state)
+    if len(fresh_leaves) != len(opt_leaves) or any(
+        jnp.shape(a) != jnp.shape(b) for a, b in zip(fresh_leaves, opt_leaves)
+    ):
+        print(
+            "WARNING: checkpoint optimizer state does not match the current "
+            "optimizer (config changed?) — starting with a fresh optimizer"
+        )
+        return fresh_opt_state
+    return jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(leaf) for leaf in opt_leaves]
+    )
 
 
 def clip_hparams(clip) -> dict:

@@ -65,7 +65,7 @@ def main():
 
     ckpt_path = Path(args.dalle_path)
     assert ckpt_path.exists(), f"trained DALL-E {ckpt_path} must exist"
-    cfg, dalle_params, vae_params, meta = load_dalle_checkpoint(str(ckpt_path))
+    cfg, dalle_params, vae_params, meta, _ = load_dalle_checkpoint(str(ckpt_path))
 
     assert meta.get("vae_class_name") == "DiscreteVAE" or vae_params is None, (
         "checkpoint was trained with a pretrained VAE wrapper; provide it"

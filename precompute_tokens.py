@@ -29,6 +29,14 @@ def parse_args():
     p.add_argument("--vqgan_config_path", type=str, default=None)
     p.add_argument("--batch_size", type=int, default=64)
     p.add_argument("--output", type=str, default="tokens.npz")
+    # tokenizer flags only affect the dataset's tokenize pass (which this
+    # CLI ignores — captions are stored RAW); exposed so folder modes that
+    # tokenize eagerly never error on long captions with exotic vocabs
+    p.add_argument("--bpe_path", type=str, default=None)
+    p.add_argument("--native", action="store_true")
+    p.add_argument("--hug", action="store_true")
+    p.add_argument("--chinese", action="store_true")
+    p.add_argument("--yttm", action="store_true")
     return p.parse_args()
 
 
@@ -69,20 +77,23 @@ def main():
     cfg = TrainConfig()
     cfg.image_text_folder = args.image_text_folder
     cfg.truncate_captions = True
+    for flag in ("bpe_path", "native", "hug", "chinese", "yttm"):
+        if getattr(args, flag):
+            setattr(cfg, flag, getattr(args, flag))
     tokenizer = build_tokenizer(cfg)
     dataset = build_dataset(cfg, tokenizer, image_size=vae.image_size)
     print(f"encoding {len(dataset)} samples at {vae.image_size}px")
 
     captions, token_chunks = [], []
-    # iterate the dataset's own batch stream but keep the raw captions:
-    # re-derive them via item access where available, else decode ids
+    # every dataset's batch stream carries RAW caption strings — stored
+    # verbatim, so the artifact is tokenizer-agnostic and lossless
+    # (train-time runs tokenize them with whatever tokenizer they select)
     n_done = 0
     for batch in dataset.batches(args.batch_size, shuffle_seed=None,
                                  drop_last=False):
         toks = np.asarray(encode(jnp.asarray(batch["images"])), np.int32)
         token_chunks.append(toks)
-        for row in batch["text"]:
-            captions.append(tokenizer.decode(row))
+        captions.extend(batch["captions"])
         n_done += toks.shape[0]
         if n_done % (args.batch_size * 10) < args.batch_size:
             print(f"  {n_done} done")

@@ -30,6 +30,11 @@ def parse_args():
                    help="precompute_tokens.py artifact; trains from tokens")
     p.add_argument("--vae_path", type=str, default=None)
     p.add_argument("--dalle_path", type=str, default=None, help="resume checkpoint")
+    p.add_argument(
+        "--resume", action="store_true",
+        help="resume full train state from the latest Orbax step checkpoint "
+             "in output_dir (preemption recovery)",
+    )
     p.add_argument("--taming", action="store_true")
     p.add_argument("--exp", type=str, default=None, choices=["f", "ff", "r", "ro"])
     p.add_argument("--epochs", type=int, default=None)
@@ -68,16 +73,16 @@ def main():
     )
     from dalle_pytorch_tpu.training.pipeline import (
         build_tokenizer, build_dataset, build_vae, dalle_from_config,
-        save_dalle_checkpoint, load_dalle_checkpoint,
+        save_dalle_checkpoint, load_dalle_checkpoint, restore_opt_state,
     )
     from dalle_pytorch_tpu.utils import param_count
 
     cfg = load_config(args.config, args.set)
     resume_meta = None
+    opt_leaves_resume = None
     if args.dalle_path:  # RESUME (`train_dalle.py:139-161`)
-        cfg, dalle_params_resume, vae_params_resume, resume_meta = (
-            load_dalle_checkpoint(args.dalle_path)
-        )
+        cfg, dalle_params_resume, vae_params_resume, resume_meta, \
+            opt_leaves_resume = load_dalle_checkpoint(args.dalle_path)
         for ov in args.set:
             k, v = ov.split("=", 1)
             from dalle_pytorch_tpu.training.config import _set_dotted
@@ -111,9 +116,18 @@ def main():
             f"tokens were precomputed with a {dataset.num_tokens}-code VAE "
             f"but --vae_path has {vae.num_tokens}"
         )
+        assert dataset.image_tokens.shape[1] == image_fmap_size**2, (
+            f"tokens artifact has {dataset.image_tokens.shape[1]} tokens per "
+            f"image (VAE {dataset.image_size}px/{dataset.num_layers} layers) "
+            f"but the model expects {image_fmap_size}^2 = {image_fmap_size**2} "
+            f"— wrong --tokens_path for this VAE?"
+        )
     else:
         dataset = build_dataset(cfg, tokenizer, image_size=vae.image_size)
-    print(f"{len(dataset)} image-text pairs for training")
+    try:
+        print(f"{len(dataset)} image-text pairs for training")
+    except TypeError:  # streaming tar shards have no cheap length
+        print("streaming dataset for training (length unknown)")
 
     model = dalle_from_config(
         cfg,
@@ -135,6 +149,14 @@ def main():
         apply_fn=model.apply, params=params,
         tx=make_optimizer(cfg.learning_rate, clip_grad_norm=cfg.clip_grad_norm),
     )
+    resume_train = (resume_meta or {}).get("train", {})
+    if opt_leaves_resume is not None:
+        # full-state resume: Adam moments + injected lr + step counter come
+        # back exactly (`/root/reference/train_dalle.py:330-338`)
+        state = state.replace(
+            opt_state=restore_opt_state(state.opt_state, opt_leaves_resume),
+            step=int(resume_train.get("global_step", 0)),
+        )
 
     mesh = make_mesh(
         dp=cfg.mesh.dp, fsdp=cfg.mesh.fsdp, tp=cfg.mesh.tp, sp=cfg.mesh.sp
@@ -173,6 +195,14 @@ def main():
 
     run_dir = Path(cfg.output_dir)
     ckpt = CheckpointManager(run_dir / "dalle_ckpt", keep_n=cfg.keep_n_checkpoints)
+    orbax_resume_meta = None
+    if args.resume:
+        restored, orbax_resume_meta, rstep = ckpt.restore(state)
+        if restored is not None:
+            state = restored
+            print(f"resumed full train state from Orbax step {rstep}")
+        else:
+            print("no Orbax checkpoint found in output_dir; starting fresh")
     logger = MetricsLogger(
         project=cfg.wandb_name, config={"cli": "train_dalle"},
         enabled=is_root(), debug=cfg.debug, out_dir=str(run_dir / "logs"),
@@ -180,6 +210,9 @@ def main():
     meter = ThroughputMeter()
     profiler = ProfilerHook(cfg.flops_profiler)
     plateau = ReduceLROnPlateau() if cfg.lr_decay else None
+    if plateau is not None and resume_train.get("plateau"):
+        # scheduler state resumes too (`train_dalle.py:354-355`)
+        plateau.load_state_dict(resume_train["plateau"])
 
     from dalle_pytorch_tpu.training.pipeline import dvae_hparams
 
@@ -190,24 +223,47 @@ def main():
                 None if not in_step_encode else jax.device_get(vae_params),
                 epoch, type(vae).__name__,
                 vae_hparams=dvae_hparams(vae) if in_step_encode else None,
+                opt_state=jax.device_get(state.opt_state),
+                train_meta={
+                    "global_step": global_step,
+                    "plateau": plateau.state_dict() if plateau else None,
+                },
             )
 
     # fail-early smoke save (`train_dalle.py:488-491`)
     out_file = run_dir / f"{cfg.dalle_output_file_name}.npz"
     resume_epoch = (resume_meta or {}).get("epoch", 0)
+    global_step = int(resume_train.get("global_step", 0))
+    if orbax_resume_meta:
+        resume_epoch = int(orbax_resume_meta.get("epoch", resume_epoch))
+        global_step = int(orbax_resume_meta.get("step", global_step))
+        if plateau is not None and orbax_resume_meta.get("plateau"):
+            plateau.load_state_dict(orbax_resume_meta["plateau"])
     export(out_file, resume_epoch)
-
-    global_step = 0
     shard = (jax.process_index(), jax.process_count())
     stop = False
+    # mid-epoch resume: skip the batches the checkpointed run already
+    # consumed this epoch, so resume ≡ uninterrupted (no double-training)
+    skip_batches = int((orbax_resume_meta or {}).get("epoch_batch", 0))
     for epoch in range(resume_epoch, cfg.epochs):
         if stop:
             break
         epoch_losses = []
         last_loss = None
-        for batch in dataset.batches(
-            cfg.batch_size, shuffle_seed=cfg.seed + epoch, shard=shard
-        ):
+        epoch_batch = 0
+        batch_iter = dataset.batches(
+            cfg.batch_size, shuffle_seed=cfg.seed + epoch, shard=shard,
+            start_batch=skip_batches if epoch == resume_epoch else 0,
+        )
+        if epoch == resume_epoch and skip_batches:
+            epoch_batch = skip_batches
+            # carry the interrupted epoch's loss history so the epoch-end
+            # plateau step sees the same inputs as an uninterrupted run —
+            # even when the skip consumes the whole epoch
+            epoch_losses = list(orbax_resume_meta.get("epoch_losses") or [])
+            if orbax_resume_meta.get("last_loss") is not None:
+                last_loss = float(orbax_resume_meta["last_loss"])
+        for batch in batch_iter:
             profiler.before_step(global_step)
             if in_step_encode:
                 dev_batch = {
@@ -216,7 +272,11 @@ def main():
                         jnp.asarray(batch["images"]), batch_shardings["images"]
                     ),
                 }
-                rng, r = jax.random.split(rng)
+                # fold_in(global_step), not sequential split: the key stream
+                # is a pure function of the step index, so a mid-epoch
+                # resume replays the exact dropout/null-cond randomness an
+                # uninterrupted run would use
+                r = jax.random.fold_in(rng, global_step)
                 state, metrics = step_fn(state, dev_batch, r, vae_params)
             else:
                 if "image_tokens" in batch:  # precomputed (TokenDataset)
@@ -227,10 +287,11 @@ def main():
                     "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
                     "image_tokens": jax.device_put(tokens, txt_sh),
                 }
-                rng, r = jax.random.split(rng)
+                r = jax.random.fold_in(rng, global_step)
                 state, metrics = step_fn(state, dev_batch, r)
 
             global_step += 1
+            epoch_batch += 1
             last_loss = metrics["loss"]  # lazy device scalar; no sync here
             log = {}
             if global_step % 10 == 0:
@@ -248,12 +309,21 @@ def main():
             if global_step % cfg.save_every_n_steps == 0:
                 ckpt.save(
                     global_step, jax.device_get(state),
-                    metadata={"epoch": epoch, "step": global_step},
+                    metadata={
+                        "epoch": epoch, "step": global_step,
+                        "epoch_batch": epoch_batch,
+                        "epoch_losses": epoch_losses,
+                        "last_loss": (
+                            float(last_loss) if last_loss is not None else None
+                        ),
+                        "plateau": plateau.state_dict() if plateau else None,
+                    },
                 )
 
             if cfg.log_images_freq and global_step % cfg.log_images_freq == 0 \
                     and is_root() and in_step_encode:
-                rng, gr = jax.random.split(rng)
+                # disjoint from the train-step keys (extra fold_in tag)
+                gr = jax.random.fold_in(jax.random.fold_in(rng, global_step), 1)
                 toks = generate_images(
                     model, {"params": state.params},
                     gr, jnp.asarray(batch["text"][:1]), filter_thres=0.9,
@@ -261,7 +331,9 @@ def main():
                 image = vae.apply(
                     {"params": vae_params}, toks, method=DiscreteVAE.decode
                 )
-                caption = tokenizer.decode(batch["text"][0])
+                caption = batch.get("captions", [None])[0] or tokenizer.decode(
+                    batch["text"][0]
+                )
                 logger.log_images(
                     np.asarray(image) * 0.5 + 0.5, caption, "image", global_step
                 )
@@ -285,7 +357,9 @@ def main():
                 float(np.mean(epoch_losses)), get_learning_rate(state)
             )
             state = set_learning_rate(state, new_lr)
-        export(out_file, epoch)
+        # epoch+1: this epoch is DONE — a --dalle_path resume starts the
+        # next one (epoch would retrain data the restored Adam already saw)
+        export(out_file, epoch + 1)
 
     export(out_file, cfg.epochs)
     ckpt.wait()
