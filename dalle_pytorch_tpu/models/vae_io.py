@@ -223,12 +223,121 @@ class _VQGraph:
         return (jnp.clip(h, -1.0, 1.0) + 1.0) * 0.5
 
 
+# --------------------------------------------------------------------------
+# Pure-XLA OpenAI dVAE graph. The released encoder.pkl/decoder.pkl
+# (`/root/reference/dalle_pytorch/vae.py:31-32,116-140`) carry the dall_e
+# package's Encoder/Decoder: an `input` conv, `group_1..group_N` of
+# residual blocks (res path = 4 relu+convs scaled by post_gain
+# = 1/n_layers², id path = 1x1 conv on width change), maxpool (encoder) /
+# nearest-2x upsample (decoder) between groups, and a relu+1x1 `output`
+# head. Structure (group/block counts, kernel sizes, widths) is inferred
+# from the state dict itself, so any geometry the pickles describe works.
+# Layout is NHWC; torch OIHW kernels are transposed once at load.
+# --------------------------------------------------------------------------
+
+
+class _OpenAIGraph:
+    """Functional dall_e dVAE evaluator over flat {torch_key: array} dicts."""
+
+    def __init__(self, enc_state: dict, dec_state: dict):
+        self.enc = self._convert(enc_state)
+        self.dec = self._convert(dec_state)
+        self.enc_groups, self.enc_blocks = self._structure(self.enc)
+        self.dec_groups, self.dec_blocks = self._structure(self.dec)
+
+    @staticmethod
+    def _convert(state: dict) -> dict:
+        """Normalize: numpy -> HWIO jnp; accept both dall_e's `.w`/`.b`
+        conv param names and standard `.weight`/`.bias`."""
+        out = {}
+        for k, v in state.items():
+            v = np.asarray(v, dtype=np.float32)
+            if k.endswith(".weight"):
+                k = k[: -len(".weight")] + ".w"
+            elif k.endswith(".bias"):
+                k = k[: -len(".bias")] + ".b"
+            if k.endswith(".w") and v.ndim == 4:
+                v = _torch_conv_to_jax(v)
+            out[k] = jnp.asarray(v)
+        return out
+
+    @staticmethod
+    def _structure(p: dict):
+        import re
+
+        groups, blocks = 0, 0
+        for k in p:
+            m = re.search(r"group_(\d+)\.block_(\d+)\.", k)
+            if m:
+                groups = max(groups, int(m.group(1)))
+                blocks = max(blocks, int(m.group(2)))
+        assert groups and blocks, "unrecognized dVAE state dict layout"
+        return groups, blocks
+
+    @staticmethod
+    def _conv(p, key, x, stride=1):
+        w = p[f"{key}.w"]
+        kh, kw = w.shape[0], w.shape[1]
+        out = jax.lax.conv_general_dilated(
+            x, w.astype(x.dtype),
+            window_strides=(stride, stride),
+            padding=[((kh - 1) // 2, kh // 2), ((kw - 1) // 2, kw // 2)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        b = p.get(f"{key}.b")
+        return out if b is None else out + b.reshape(-1).astype(x.dtype)
+
+    def _block(self, p, key, x, post_gain):
+        """id_path(x) + post_gain * res_path(x) (dall_e Encoder/DecoderBlock)."""
+        h = x
+        for i in (1, 2, 3, 4):
+            h = self._conv(p, f"{key}.res_path.conv_{i}", jax.nn.relu(h))
+        if f"{key}.id_path.w" in p:
+            x = self._conv(p, f"{key}.id_path", x)
+        return x + post_gain * h
+
+    def encode_logits(self, p, x):
+        """pixel-mapped images NHWC -> token logits [B, h, w, vocab]."""
+        post_gain = 1.0 / (self.enc_groups * self.enc_blocks) ** 2
+        h = self._conv(p, "blocks.input", x)
+        for g in range(1, self.enc_groups + 1):
+            for blk in range(1, self.enc_blocks + 1):
+                h = self._block(p, f"blocks.group_{g}.block_{blk}", h, post_gain)
+            if g != self.enc_groups:  # MaxPool2d(2) between groups
+                h = jax.lax.reduce_window(
+                    h, -jnp.inf, jax.lax.max,
+                    (1, 2, 2, 1), (1, 2, 2, 1), "VALID",
+                )
+        return self._conv(p, "blocks.output.conv", jax.nn.relu(h))
+
+    def decode_pixels(self, p, indices):
+        """flat indices [B, n] -> raw decoder output NHWC (pre-sigmoid)."""
+        # input 1x1 conv on a one-hot == embedding gather of its kernel:
+        # O(n·c) instead of an 8192-wide matmul per position
+        w = p["blocks.input.w"]  # [1, 1, vocab, n_init]
+        emb = w.reshape(w.shape[2], w.shape[3])
+        b, n = indices.shape
+        hw = int(math.isqrt(n))
+        h = emb[indices].reshape(b, hw, hw, -1) + p["blocks.input.b"].reshape(-1)
+        post_gain = 1.0 / (self.dec_groups * self.dec_blocks) ** 2
+        for g in range(1, self.dec_groups + 1):
+            for blk in range(1, self.dec_blocks + 1):
+                h = self._block(p, f"blocks.group_{g}.block_{blk}", h, post_gain)
+            if g != self.dec_groups:  # Upsample(scale 2, nearest)
+                bb, hh, ww, cc = h.shape
+                h = jnp.broadcast_to(
+                    h[:, :, None, :, None, :], (bb, hh, 2, ww, 2, cc)
+                ).reshape(bb, hh * 2, ww * 2, cc)
+        return self._conv(p, "blocks.output.conv", jax.nn.relu(h))
+
+
 class OpenAIDiscreteVAE:
     """OpenAI's pretrained 8192-token dVAE (`vae.py:111-157`).
 
-    Loads the torch pickles (via torch, host-side) and converts the conv
-    stacks to jitted XLA convolutions. Geometry: 256px, f/8 (num_layers=3),
-    8192 tokens.
+    Loads the torch pickles ONCE (host-side) into plain arrays and runs
+    encode/decode as jitted XLA graphs — no torch in the hot path, so the
+    in-train-step frozen-VAE encode (`dalle_pytorch.py:619-627`) stays on
+    chip. Geometry: 256px, f/8 (num_layers=3), 8192 tokens.
     """
 
     image_size = 256
@@ -242,13 +351,31 @@ class OpenAIDiscreteVAE:
         self.dec_path = _require(cache / OPENAI_VAE_DECODER_NAME, "OpenAI dVAE decoder")
         self._load()
 
-    def _load(self):
-        import torch  # host-side conversion only
+    @staticmethod
+    def _state_dict(obj) -> dict:
+        """torch pickles may hold a module (dall_e classes / jit script) or
+        a bare state dict; normalize to {key: numpy}."""
+        if hasattr(obj, "state_dict"):
+            obj = obj.state_dict()
+        return {k: np.asarray(v.cpu() if hasattr(v, "cpu") else v)
+                for k, v in obj.items()}
 
-        self._enc = torch.load(self.enc_path, map_location="cpu")
-        self._dec = torch.load(self.dec_path, map_location="cpu")
-        self._enc.eval()
-        self._dec.eval()
+    def _load(self):
+        import torch  # host-side, load-time only
+
+        enc = torch.load(self.enc_path, map_location="cpu")
+        dec = torch.load(self.dec_path, map_location="cpu")
+        self._graph = _OpenAIGraph(self._state_dict(enc), self._state_dict(dec))
+        del enc, dec
+        g = self._graph
+        # geometry from the pickles themselves (the class defaults describe
+        # the released 256px/f8/8192 model; synthetic/test pickles differ)
+        self.num_tokens = int(g.enc["blocks.output.conv.w"].shape[-1])
+        self.num_layers = g.enc_groups - 1  # one maxpool between groups
+        self._encode_jit = jax.jit(
+            lambda p, x: jnp.argmax(g.encode_logits(p, x), axis=-1)
+        )
+        self._decode_jit = jax.jit(g.decode_pixels)
 
     @staticmethod
     def map_pixels(x: jnp.ndarray, eps: float = 0.1) -> jnp.ndarray:
@@ -260,32 +387,16 @@ class OpenAIDiscreteVAE:
         """(`vae.py:52-53`)"""
         return jnp.clip((x - eps) / (1 - 2 * eps), 0, 1)
 
-    # NOTE round-1 implementation runs the original torch graph on host CPU
-    # (weights are a full torch.jit module, not a plain state dict). A
-    # converter to pure-XLA convs is planned; the interface already isolates
-    # callers from it.
     def get_codebook_indices(self, images: jnp.ndarray) -> jnp.ndarray:
-        import torch
-
-        x = np.asarray(self.map_pixels(images)).transpose(0, 3, 1, 2)
-        with torch.no_grad():
-            z = self._enc(torch.from_numpy(x).float())
-        return jnp.asarray(torch.argmax(z, dim=1).flatten(1).numpy(), dtype=jnp.int32)
+        """images NHWC [0,1] -> flat token indices (`vae.py:126-130`)."""
+        idx = self._encode_jit(self._graph.enc, self.map_pixels(images))
+        return idx.reshape(idx.shape[0], -1).astype(jnp.int32)
 
     def decode(self, img_seq: jnp.ndarray) -> jnp.ndarray:
-        import torch
-        import torch.nn.functional as F
-
-        n = img_seq.shape[1]
-        hw = int(math.isqrt(n))
-        seq = torch.from_numpy(np.asarray(img_seq)).long()
-        with torch.no_grad():
-            z = F.one_hot(seq, num_classes=self.num_tokens)
-            z = z.view(-1, hw, hw, self.num_tokens).permute(0, 3, 1, 2).float()
-            out = self._dec(z).float()
-            out = torch.sigmoid(out[:, :3])
-        images = jnp.asarray(out.permute(0, 2, 3, 1).numpy())
-        return self.unmap_pixels(images)
+        """flat indices -> images NHWC [0,1] (`vae.py:132-140`): sigmoid of
+        the first 3 output channels, then unmap_pixels."""
+        out = self._decode_jit(self._graph.dec, jnp.asarray(img_seq))
+        return self.unmap_pixels(jax.nn.sigmoid(out[..., :3]))
 
 
 class VQGanVAE:

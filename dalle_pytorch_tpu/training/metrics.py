@@ -85,6 +85,22 @@ class MetricsLogger:
                 imgs = imgs[None]
             save_image_grid(imgs, self.out_dir / f"{name}_{step}.png")
 
+    def log_model_artifact(self, path, name: str = "trained-dalle") -> None:
+        """Upload a checkpoint as a run artifact (the reference's per-epoch
+        wandb.save / Artifact upload, `/root/reference/train_dalle.py:
+        481-484`, `train_vae.py:305-310`). No-op without a live wandb run
+        (the file already sits on disk in that case)."""
+        if not self.enabled or self.run is None:
+            return
+        try:
+            import wandb
+
+            art = wandb.Artifact(name, type="model")
+            art.add_file(str(path))
+            self.run.log_artifact(art)
+        except Exception as e:  # artifact upload must never kill training
+            print(f"[metrics] artifact upload failed: {e}")
+
     def finish(self) -> None:
         if self.run is not None:
             self.run.finish()

@@ -321,22 +321,26 @@ def main():
                 )
 
             if cfg.log_images_freq and global_step % cfg.log_images_freq == 0 \
-                    and is_root() and in_step_encode:
-                # disjoint from the train-step keys (extra fold_in tag)
+                    and is_root():
+                # in-loop sample generation in EVERY configuration —
+                # trainable dVAE, precomputed tokens, VQGAN/OpenAI — like
+                # the reference (`train_dalle.py:564-576`)
+                # (disjoint from the train-step keys: extra fold_in tag)
                 gr = jax.random.fold_in(jax.random.fold_in(rng, global_step), 1)
                 toks = generate_images(
                     model, {"params": state.params},
                     gr, jnp.asarray(batch["text"][:1]), filter_thres=0.9,
                 )
-                image = vae.apply(
-                    {"params": vae_params}, toks, method=DiscreteVAE.decode
-                )
+                if isinstance(vae, DiscreteVAE):
+                    image = np.asarray(vae.apply(
+                        {"params": vae_params}, toks, method=DiscreteVAE.decode
+                    )) * 0.5 + 0.5  # dVAE decodes to [-1, 1]
+                else:  # pretrained wrappers decode straight to [0, 1]
+                    image = np.asarray(vae.decode(toks))
                 caption = batch.get("captions", [None])[0] or tokenizer.decode(
                     batch["text"][0]
                 )
-                logger.log_images(
-                    np.asarray(image) * 0.5 + 0.5, caption, "image", global_step
-                )
+                logger.log_images(image, caption, "image", global_step)
 
             rate = meter.update(global_step, cfg.batch_size)
             if rate is not None:
@@ -360,6 +364,7 @@ def main():
         # epoch+1: this epoch is DONE — a --dalle_path resume starts the
         # next one (epoch would retrain data the restored Adam already saw)
         export(out_file, epoch + 1)
+        logger.log_model_artifact(out_file)  # `train_dalle.py:481-484`
 
     export(out_file, cfg.epochs)
     ckpt.wait()

@@ -161,6 +161,8 @@ def main():
         if is_root():
             save_vae_checkpoint(args.output, vae, jax.device_get(state.params), epoch)
             print(f"epoch {epoch} done; checkpoint -> {args.output}")
+            # per-epoch model artifact (`train_vae.py:305-310`)
+            logger.log_model_artifact(args.output, "trained-vae")
 
     if is_root():
         save_vae_checkpoint(args.output, vae, jax.device_get(state.params), cfg.epochs)
