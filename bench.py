@@ -65,11 +65,18 @@ def main():
 
     # BASELINE.json ladder config: DALLE dim=1024 depth=12 with OpenAI-dVAE
     # geometry (f/8: 32x32 = 1024 image tokens, seq 1280). Env overrides for
-    # A/B runs: BENCH_BATCH, BENCH_FMAP, BENCH_ATTN (dense|flash|auto).
+    # A/B runs: BENCH_BATCH, BENCH_FMAP, BENCH_ATTN (dense|flash|auto),
+    # BENCH_REMAT (per-layer rematerialization; without it the bf16
+    # [B,1280,4096] GEGLU activations of all 12 layers stay live through the
+    # backward and batch 16 blows 16G HBM — the round-2 failure mode),
+    # BENCH_ACCUM (gradient accumulation: global batch stays BENCH_BATCH,
+    # split into BENCH_ACCUM scanned microbatches).
     dim, depth, heads, dim_head = 1024, 12, 16, 64
     text_seq = 256
     fmap = int(os.environ.get("BENCH_FMAP", "32"))
     batch = int(os.environ.get("BENCH_BATCH", "16"))
+    accum = int(os.environ.get("BENCH_ACCUM", "1"))
+    remat = os.environ.get("BENCH_REMAT", "1") == "1"
     attn_impl = os.environ.get("BENCH_ATTN", "auto")
     image_seq = fmap * fmap
     seq = text_seq + image_seq
@@ -79,6 +86,7 @@ def main():
         num_image_tokens=8192, image_fmap_size=fmap,
         num_text_tokens=10000, text_seq_len=text_seq,
         shift_tokens=True, rotary_emb=True, attn_impl=attn_impl,
+        reversible=remat, reversible_impl="remat",
         dtype=jnp.bfloat16,
     )
     text = jnp.ones((batch, text_seq), jnp.int32)
@@ -90,7 +98,7 @@ def main():
         apply_fn=model.apply, params=params,
         tx=make_optimizer(3e-4, clip_grad_norm=0.5),
     )
-    step = jax.jit(make_dalle_train_step(model), donate_argnums=0)
+    step = jax.jit(make_dalle_train_step(model, grad_accum=accum), donate_argnums=0)
     batch_dict = {"text": text, "image_tokens": tokens}
     rng = jax.random.PRNGKey(1)
 
@@ -107,27 +115,46 @@ def main():
     dt = time.perf_counter() - t0
 
     n_chips = jax.device_count()
+    platform = jax.devices()[0].platform
+    is_fallback = platform == "cpu"
     steps_per_sec = n_steps / dt
     img_tok_per_sec_chip = steps_per_sec * batch * image_seq / n_chips
     flops_per_step = transformer_train_flops(dim, depth, heads, dim_head, seq) * batch
     mfu = flops_per_step * steps_per_sec / (peak_flops_per_chip() * n_chips)
 
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": round(img_tok_per_sec_chip, 1),
-                "unit": UNIT,
-                "ok": True,
-                "vs_baseline": round(mfu / 0.45, 4),
-                "mfu": round(mfu, 4),
-                "samples_per_sec": round(steps_per_sec * batch, 2),
-                "device": jax.devices()[0].device_kind,
-                "n_chips": n_chips,
-                "config": f"dim{dim}-depth{depth}-seq{seq}-bs{batch}-{attn_impl}-bf16",
-            }
-        )
-    )
+    out = {
+        "metric": METRIC,
+        "value": round(img_tok_per_sec_chip, 1),
+        "unit": UNIT,
+        "ok": True,
+        # vs_baseline only means something against a real chip's peak;
+        # CPU runs are smoke signals, not perf data (VERDICT r2 weak #7).
+        "vs_baseline": None if is_fallback else round(mfu / 0.45, 4),
+        "mfu": None if is_fallback else round(mfu, 4),
+        "samples_per_sec": round(steps_per_sec * batch, 2),
+        "device": jax.devices()[0].device_kind,
+        "n_chips": n_chips,
+        "config": (
+            f"dim{dim}-depth{depth}-seq{seq}-gbs{batch}-accum{accum}-{attn_impl}"
+            f"-remat{int(remat)}-bf16"
+        ),
+    }
+    if is_fallback:
+        out["fallback"] = True
+    print(json.dumps(out))
+
+
+def _microbatch_of(env) -> "int | None":
+    """Live microbatch implied by an env dict; None when invalid (accum
+    must evenly divide the global batch for `_microbatch`'s reshape)."""
+    try:
+        b = int(env.get("BENCH_BATCH", "16"))
+        a = int(env.get("BENCH_ACCUM", "1"))
+    except ValueError:
+        return None
+    if a <= 0 or b <= 0 or b % a:
+        return None
+    return b // a
 
 
 if __name__ == "__main__":
@@ -148,4 +175,13 @@ if __name__ == "__main__":
                 "BENCH_FMAP": "16",
                 "BENCH_STEPS": "3",
             },
+            # halve-microbatch-on-OOM ladder: BENCH_BATCH is the global
+            # batch (BENCH_ACCUM scan-splits it), so the metric stays
+            # comparable at batch 16 while the live microbatch shrinks.
+            oom_ladder=[
+                {"BENCH_ACCUM": "2"},
+                {"BENCH_ACCUM": "4"},
+                {"BENCH_ACCUM": "8"},
+            ],
+            microbatch_of=_microbatch_of,
         )

@@ -19,6 +19,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 PROBE_CODE = (
     "import json, time, jax, jax.numpy as jnp\n"
@@ -76,17 +77,44 @@ def emit_failure(metric: str, unit: str, error: str) -> None:
     )
 
 
+_OOM_SIGNATURES = (
+    "RESOURCE_EXHAUSTED",
+    "Allocation type: HLO temp",
+    "out of memory",
+    "OOM",
+)
+
+
+def _looks_like_oom(text: str) -> bool:
+    return any(sig in text for sig in _OOM_SIGNATURES)
+
+
 def run_guarded(
     metric: str,
     unit: str,
     script: str,
     child_timeout: float = 1800.0,
     cpu_env_defaults: dict | None = None,
+    oom_ladder: list[dict] | None = None,
+    microbatch_of=None,
 ) -> None:
     """Probe, then run `script --child` and forward its JSON line.
 
     `cpu_env_defaults` are env vars applied (setdefault) when the probed
     platform is CPU, to shrink the workload to something that finishes.
+
+    `oom_ladder` is a list of env-override dicts tried in order whenever the
+    child dies with an OOM signature (RESOURCE_EXHAUSTED / HLO-temp
+    allocation failure). One bad geometry must never zero a round again
+    (round-2 postmortem): each rung shrinks the workload (smaller microbatch
+    + grad accumulation) and the final record notes how many retries it took.
+    `child_timeout` is the TOTAL budget across all rungs, so the one-JSON-
+    line contract holds under any outer driver deadline > child_timeout.
+
+    `microbatch_of(env) -> int | None` (optional) reports the live
+    microbatch implied by an env dict; rungs that are invalid (None) or
+    don't shrink the microbatch below the last attempt that actually ran
+    (e.g. the caller already set a larger accumulation) are skipped.
     """
     info = probe_device()
     if info is None:
@@ -98,35 +126,69 @@ def run_guarded(
         )
         return
 
-    env = dict(os.environ)
+    base_env = dict(os.environ)
     if info.get("platform") == "cpu":
         for k, v in (cpu_env_defaults or {}).items():
-            env.setdefault(k, v)
+            base_env.setdefault(k, v)
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(script), "--child"],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            timeout=child_timeout,
-            env=env,
-        )
-    except subprocess.TimeoutExpired:
-        emit_failure(
-            metric, unit, f"bench child exceeded {child_timeout:.0f}s watchdog"
-        )
-        return
+    deadline = time.monotonic() + child_timeout
+    rungs = [{}] + list(oom_ladder or [])
+    last_error = ""
+    last_mb = None
+    n_run = 0
+    for overrides in rungs:
+        env = dict(base_env)
+        env.update(overrides)
+        if microbatch_of is not None:
+            mb = microbatch_of(env)
+            if mb is None or (last_mb is not None and mb >= last_mb):
+                continue
+        else:
+            mb = None
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            emit_failure(
+                metric,
+                unit,
+                f"bench budget ({child_timeout:.0f}s) exhausted after "
+                f"{n_run} attempt(s): {last_error}",
+            )
+            return
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(script), "--child"],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                timeout=remaining,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            emit_failure(
+                metric,
+                unit,
+                f"bench child exceeded the {child_timeout:.0f}s total budget "
+                f"on attempt {n_run + 1}",
+            )
+            return
+        n_run += 1
+        last_mb = mb
 
-    result = _last_json_line(proc.stdout)
-    if proc.returncode != 0 or result is None:
-        tail = "\n".join(
-            (proc.stderr or proc.stdout or "").splitlines()[-12:]
-        )
-        emit_failure(
-            metric,
-            unit,
-            f"bench child rc={proc.returncode}, no JSON produced: {tail}",
-        )
-        return
-    print(json.dumps(result))
+        result = _last_json_line(proc.stdout)
+        if proc.returncode == 0 and result is not None:
+            if n_run > 1:
+                result["oom_retries"] = n_run - 1
+            print(json.dumps(result))
+            return
+
+        err_text = proc.stderr or proc.stdout or ""
+        last_error = "\n".join(err_text.splitlines()[-12:])
+        if not _looks_like_oom(err_text):
+            break
+
+    emit_failure(
+        metric,
+        unit,
+        f"bench child failed after {n_run} attempt(s), "
+        f"no JSON produced: {last_error}",
+    )

@@ -154,7 +154,7 @@ class Attention(nn.Module):
                 assert not self.stable, 'attn_impl="ring" does not take stable='
                 sp = self.sp_mesh.shape["sp"]
                 assert n % sp == 0, (
-                    f"sequence length {n} must divide the sp axis ({sp}); note "
+                    f"sequence length {n} must be divisible by the sp axis ({sp}); note "
                     "the uncached generate_images() re-forwards growing "
                     "prefixes — use the KV-cached decode path with ring models"
                 )

@@ -214,7 +214,9 @@ Model* train_model(const std::string& corpus, int32_t vocab_size) {
   tr.count_all();
 
   // lazy max-heap over (count, key): entries are re-pushed when counts
-  // change and validated against the live map on pop.
+  // change and validated against the live map on pop. Selection is fully
+  // deterministic across platforms: std::pair ordering breaks count ties
+  // on the packed (left<<32|right) key, never on hash-map iteration order.
   using Entry = std::pair<int64_t, uint64_t>;
   std::priority_queue<Entry> heap;
   for (const auto& kv : tr.pair_count) heap.emplace(kv.second, kv.first);
@@ -342,9 +344,13 @@ int32_t bpe_encode_batch(void* handle, const char* blob,
     for (int32_t t = 0; t < n_threads; ++t) threads.emplace_back(work, t);
     for (auto& th : threads) th.join();
   }
+  // each thread records its own first overflow (its stripe is ascending),
+  // so the global minimum is the first offending text overall — matching
+  // the single-threaded tokenize() error contract.
+  int32_t first = 0;
   for (int32_t t = 0; t < n_threads; ++t)
-    if (overflow[t]) return overflow[t];
-  return 0;
+    if (overflow[t] && (!first || overflow[t] < first)) first = overflow[t];
+  return first;
 }
 
 // decode ids -> utf-8 bytes; pad/unknown ids are skipped. Returns byte
