@@ -136,6 +136,15 @@ def run_guarded(
     last_error = ""
     last_mb = None
     n_run = 0
+    if microbatch_of is not None and microbatch_of(base_env) is None:
+        emit_failure(
+            metric,
+            unit,
+            "invalid bench env: the configured batch/accum combination is "
+            "not divisible (check BENCH_BATCH / BENCH_ACCUM)",
+        )
+        return
+
     for overrides in rungs:
         env = dict(base_env)
         env.update(overrides)

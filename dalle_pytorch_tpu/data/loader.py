@@ -263,6 +263,9 @@ class TextImageDataset:
         self.truncate_captions = truncate_captions
         self.resize_ratio = resize_ratio
         self.rng = np.random.RandomState(seed)
+        # caption -> token ids; captions are deterministic across epochs,
+        # only the image crop is stochastic, so tokenize each caption once
+        self._token_cache: dict = {}
 
     def __len__(self) -> int:
         return len(self.dataset)
@@ -279,9 +282,13 @@ class TextImageDataset:
 
     def item(self, i: int) -> Tuple[np.ndarray, np.ndarray, str]:
         caption, img = self._sample(i)
-        text = self.tokenizer.tokenize(
-            caption, self.text_len, truncate_text=self.truncate_captions
-        )[0]
+        text = self._token_cache.get(caption)
+        if text is None:
+            text = self.tokenizer.tokenize(
+                caption, self.text_len, truncate_text=self.truncate_captions
+            )[0]
+            if len(self._token_cache) < 500_000:  # ~0.5 GB worst case
+                self._token_cache[caption] = text
         img = random_resized_crop(
             img, self.image_size, self.rng, scale=(self.resize_ratio, 1.0)
         )

@@ -21,6 +21,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import html
+import warnings
 from functools import lru_cache
 from pathlib import Path
 from typing import List, Optional, Sequence, Union
@@ -352,4 +353,25 @@ def get_tokenizer(
         return HugTokenizer(bpe_path)
     if bpe_path:
         return SimpleTokenizer(bpe_path)
+    # No flags: use the shipped 8k-token native BPE vocabulary (the
+    # analogue of the reference's vendored CLIP vocab, `tokenizer.py:64-68`)
+    # — trained by scripts/train_default_vocab.py and committed to the repo.
+    default_model = Path(__file__).parent / "default_bpe_8k.model"
+    if default_model.exists():
+        try:
+            return NativeBPETokenizer(default_model)
+        except Exception as e:  # e.g. no C++ toolchain to build the backend
+            warnings.warn(
+                f"default BPE vocabulary found but unusable ({e}); falling "
+                "back to the 257-symbol ByteTokenizer",
+                stacklevel=2,
+            )
+    else:
+        warnings.warn(
+            "no default BPE vocabulary "
+            f"({default_model} missing — run scripts/train_default_vocab.py); "
+            "falling back to the 257-symbol ByteTokenizer, which trains "
+            "byte-level models only",
+            stacklevel=2,
+        )
     return ByteTokenizer()

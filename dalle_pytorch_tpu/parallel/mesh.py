@@ -42,11 +42,29 @@ def initialize_distributed(
     """Multi-host init (once per host, before any jax call).
 
     Replaces `deepspeed.init_distributed()` / `hvd.init()`
-    (`deepspeed_backend.py:36-39`, `horovod_backend.py`). On TPU pods the
-    arguments are auto-detected from the environment; on CPU/GPU fleets
-    pass them explicitly.
+    (`deepspeed_backend.py:36-39`, `horovod_backend.py`). Rendezvous info
+    comes from (in precedence order) explicit arguments, the
+    DALLE_TPU_COORDINATOR / DALLE_TPU_NUM_PROCS / DALLE_TPU_PROC_ID env
+    vars set by `launch.py`, or — when DALLE_TPU_DIST=1 — TPU-pod
+    auto-detection. With none of those present this is a no-op, so the
+    trainers can call it unconditionally.
     """
+    import os
+
+    env = os.environ
+    if coordinator_address is None:
+        coordinator_address = env.get("DALLE_TPU_COORDINATOR")
+    if num_processes is None and "DALLE_TPU_NUM_PROCS" in env:
+        num_processes = int(env["DALLE_TPU_NUM_PROCS"])
+    if process_id is None and "DALLE_TPU_PROC_ID" in env:
+        process_id = int(env["DALLE_TPU_PROC_ID"])
+
     if num_processes is not None and num_processes <= 1:
+        return
+    if coordinator_address is None and num_processes is None:
+        if env.get("DALLE_TPU_DIST") == "1":
+            # TPU pod: everything auto-detected from the metadata service
+            jax.distributed.initialize()
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -97,8 +97,18 @@ def ring_attention_sharded(
     batch_axes=("dp", "fsdp"),
     seq_axis: str = "sp",
 ) -> jnp.ndarray:
-    """Wrapper: q,k,v [B, H, N, D] with N sharded over `seq_axis`."""
-    spec = P(batch_axes, None, seq_axis, None)
+    """Wrapper: q,k,v [B, H, N, D] with N sharded over `seq_axis`.
+
+    The batch axis is sharded over `batch_axes` when its size divides their
+    product, else replicated — so abstract traces with unsharded batches
+    (model.init with batch 1, small eval forwards) still compile; training
+    batches (sized by the data loader to dp*fsdp) get the real sharding.
+    """
+    dp_extent = 1
+    for a in batch_axes:
+        dp_extent *= mesh.shape.get(a, 1)
+    b_axes = batch_axes if q.shape[0] % dp_extent == 0 else None
+    spec = P(b_axes, None, seq_axis, None)
     fn = jax.shard_map(
         partial(ring_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh,

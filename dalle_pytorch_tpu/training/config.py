@@ -76,6 +76,10 @@ class DalleConfig:
     stable_softmax: bool = False
     sandwich_norm: bool = False
     num_text_tokens: int = 10000  # overridden by tokenizer vocab size
+    # attention kernel selection: "dense" | "flash" (Pallas) | "ring"
+    # (sequence-parallel over the mesh sp axis) | "auto" (dense below
+    # AUTO_FLASH_MIN_SEQ, flash above; ring when mesh.sp > 1)
+    attn_impl: str = "auto"
 
     def attn_types_tuple(self) -> Tuple[str, ...]:
         return tuple(s.strip() for s in self.attn_types.split(",") if s.strip())
@@ -135,6 +139,10 @@ class TrainConfig:
     keep_n_checkpoints: Optional[int] = None
     batch_size: int = 4
     ga_steps: int = 1
+    # batches assembled ahead of the step by the prefetch thread
+    # (DataLoader-workers equivalent, `train_dalle.py:309-316`); 0 would
+    # mean no lookahead but still off-thread assembly
+    prefetch_depth: int = 2
     learning_rate: float = 3e-4
     clip_grad_norm: float = 0.5
     lr_decay: bool = False

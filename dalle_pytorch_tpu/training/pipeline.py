@@ -178,9 +178,42 @@ def build_vae(cfg: TrainConfig, dtype=jnp.float32):
 
 
 def dalle_from_config(
-    cfg: TrainConfig, num_image_tokens: int, image_fmap_size: int, vocab_size: int
+    cfg: TrainConfig,
+    num_image_tokens: int,
+    image_fmap_size: int,
+    vocab_size: int,
+    sp_mesh=None,
 ) -> DALLE:
+    """`sp_mesh`: pass the trainer's mesh when cfg.mesh.sp > 1 — the model
+    then runs ring attention (sequence-parallel over the "sp" axis) for
+    long-context training; with sp == 1 the mesh axis is inert and the
+    configured attn_impl ("auto"/"dense"/"flash") applies."""
     m = cfg.model
+    attn_impl = m.attn_impl
+    if sp_mesh is not None and sp_mesh.shape.get("sp", 1) > 1:
+        if attn_impl in ("auto", "ring"):
+            attn_impl = "ring"
+        else:
+            raise ValueError(
+                f'mesh.sp={sp_mesh.shape["sp"]} requires ring attention, but '
+                f"model.attn_impl={attn_impl!r} was set explicitly; use "
+                '"ring" or "auto" (or set mesh.sp=1)'
+            )
+        if m.stable_softmax:
+            raise ValueError(
+                "ring attention (mesh.sp > 1) is incompatible with "
+                "model.stable_softmax; its streaming accumulator is already "
+                "max-subtracted"
+            )
+    else:
+        if attn_impl == "ring":
+            raise ValueError(
+                'model.attn_impl="ring" needs a sequence-parallel mesh: set '
+                "mesh.sp>1 in the trainer (generate/decode paths never use "
+                "ring attention — KV-cached decode serves long-context "
+                "models there)"
+            )
+        sp_mesh = None  # inert axis: don't thread a mesh the model won't use
     return DALLE(
         dim=m.dim,
         depth=m.depth,
@@ -207,6 +240,8 @@ def dalle_from_config(
         img_loss_coeff=cfg.img_loss_coeff,
         text_loss_coeff_inv=cfg.text_loss_coeff_inv,
         img_loss_coeff_inv=cfg.img_loss_coeff_inv,
+        attn_impl=attn_impl,
+        sp_mesh=sp_mesh,
         dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
     )
 

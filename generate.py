@@ -80,6 +80,11 @@ def main():
     fmap = vae.image_size // (2 ** vae.num_layers)
 
     tokenizer = build_tokenizer(cfg)
+    if cfg.model.attn_impl == "ring":
+        # ring attention is a training-time layout (sequence sharded over
+        # the mesh sp axis); KV-cached decode never runs it, so a
+        # ring-trained checkpoint generates with the dense/auto kernel
+        cfg.model.attn_impl = "auto"
     model = dalle_from_config(
         cfg, num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
         vocab_size=max(tokenizer.vocab_size, 1),

@@ -1,0 +1,162 @@
+"""Multi-host launcher for TPU pods and CPU/GPU fleets.
+
+The reference ships SLURM/submitit launchers with automatic requeue
+(`/root/reference/config/hydra/launcher/grogu.yaml`, `matrix.yaml`,
+hydra-submitit). The TPU-native equivalent is thinner by design: on Cloud
+TPU pods, `jax.distributed.initialize()` auto-detects the coordinator and
+process topology from the TPU metadata service, so a "launcher" only needs
+to (1) run the same command on every host, (2) wire rendezvous flags when
+auto-detection is unavailable (CPU/GPU fleets, SLURM), and (3) requeue on
+preemption — resume is already free via `--resume` (Orbax full-state
+checkpoints, mid-epoch position included).
+
+Usage — on every host of the fleet (rank and count from SLURM when
+present, else flags):
+
+  python launch.py --coordinator 10.0.0.1:1234 --num-hosts 4 --host-id 0 \
+      -- train_dalle.py --image_text_folder data/ --resume ...
+
+  # SLURM (one task per host); requeue-on-preemption with --requeue:
+  srun python launch.py --requeue -- train_dalle.py ... --resume
+
+  # TPU pod slice (args auto-detected, launch.py is optional):
+  gcloud compute tpus tpu-vm ssh $TPU --worker=all \
+      --command="cd repo && python launch.py -- train_dalle.py ... --resume"
+
+The child inherits DALLE_TPU_COORDINATOR / DALLE_TPU_NUM_PROCS /
+DALLE_TPU_PROC_ID; the trainers call `initialize_distributed()` which reads
+them (or TPU auto-detection) before the first jax call.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+# exit codes that mean "the scheduler preempted us", worth a requeue
+_PREEMPT_CODES = {-signal.SIGTERM, -signal.SIGINT, 143, 130}
+
+
+def first_slurm_host(nodelist: str) -> str:
+    """First hostname of a SLURM nodelist expression.
+
+    Handles plain lists ("a,b"), bracket ranges ("node[1-4]") and
+    hyphenated names with ranges ("gpu-node-[01-04,07]") — the prefix
+    before "[" concatenated with the first index of the range.
+    """
+    if not nodelist:
+        return ""
+    head = nodelist.split(",")[0] if "[" not in nodelist else nodelist
+    if "[" in head:
+        prefix, rest = head.split("[", 1)
+        first_idx = rest.split(",")[0].split("-")[0].rstrip("]")
+        return prefix + first_idx
+    return head
+
+
+def slurm_defaults() -> dict:
+    """Rendezvous info from SLURM env (the reference's submitit launchers
+    run under the same variables)."""
+    env = os.environ
+    if not env.get("SLURM_PROCID"):  # absent or empty (cleared)
+        return {}
+    nodelist = env.get("SLURM_STEP_NODELIST", env.get("SLURM_NODELIST", ""))
+    first = first_slurm_host(nodelist)
+    return {
+        "host_id": int(env["SLURM_PROCID"]),
+        "num_hosts": int(env.get("SLURM_NTASKS", "1")),
+        "coordinator": f"{first}:12345" if first else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (omit on TPU pods: auto)")
+    ap.add_argument("--num-hosts", type=int, default=None)
+    ap.add_argument("--host-id", type=int, default=None)
+    ap.add_argument("--requeue", action="store_true",
+                    help="relaunch the command after preemption-style exits "
+                         "(SIGTERM/SIGINT); combine with --resume for exact "
+                         "continuation")
+    ap.add_argument("--max-requeues", type=int, default=100)
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="-- script.py args...")
+    args = ap.parse_args(argv)
+
+    cmd = list(args.command)
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        ap.error("no command given; usage: launch.py [flags] -- train_dalle.py ...")
+
+    slurm = slurm_defaults()
+    coordinator = args.coordinator or slurm.get("coordinator")
+    num_hosts = args.num_hosts if args.num_hosts is not None else slurm.get("num_hosts")
+    host_id = args.host_id if args.host_id is not None else slurm.get("host_id")
+
+    env = dict(os.environ)
+    if coordinator:
+        env["DALLE_TPU_COORDINATOR"] = coordinator
+    if num_hosts is not None:
+        env["DALLE_TPU_NUM_PROCS"] = str(num_hosts)
+    if host_id is not None:
+        env["DALLE_TPU_PROC_ID"] = str(host_id)
+    if coordinator is None and num_hosts is None and host_id is None:
+        # no explicit rendezvous anywhere: the TPU-pod case — tell
+        # initialize_distributed() to run jax.distributed.initialize()
+        # with full auto-detection (metadata service)
+        env.setdefault("DALLE_TPU_DIST", "1")
+
+    # Schedulers preempt by signalling the whole process group; without a
+    # handler the launcher would die alongside the child and the requeue
+    # loop below would never run. Forward the signal, reap the child, then
+    # decide to requeue.
+    pending_sig = []
+
+    def forward(signum, frame):
+        pending_sig.append(signum)
+        if child[0] is not None and child[0].poll() is None:
+            child[0].send_signal(signum)
+
+    child = [None]
+    old_handlers = {
+        s: signal.signal(s, forward) for s in (signal.SIGTERM, signal.SIGINT)
+    }
+
+    full = [sys.executable, *cmd]
+    attempts = 0
+    try:
+        while True:
+            pending_sig.clear()
+            child[0] = subprocess.Popen(full, env=env)
+            rc = child[0].wait()
+            if rc == 0:
+                return 0
+            preempted = rc in _PREEMPT_CODES or bool(pending_sig)
+            if not args.requeue or not preempted:
+                return rc
+            attempts += 1
+            if attempts > args.max_requeues:
+                print(
+                    f"launch.py: giving up after {attempts - 1} requeues",
+                    file=sys.stderr,
+                )
+                return rc
+            print(
+                f"launch.py: command exited {rc} (preemption-style); "
+                f"requeue {attempts}/{args.max_requeues}",
+                file=sys.stderr,
+            )
+    finally:
+        for s, h in old_handlers.items():
+            signal.signal(s, h)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""Train the shipped default BPE vocabulary.
+
+The reference vendors a 262k-line CLIP BPE vocab so `get_tokenizer()` works
+out of the box (`/root/reference/dalle_pytorch/tokenizer.py:64-68`,
+`data/bpe_simple_vocab_16e6.txt`). This repo's equivalent: an 8k-token
+model trained with the in-repo native C++ BPE on text available inside the
+image — every rainbow caption (the built-in synthetic dataset) plus
+public-domain/permissive English prose (Python stdlib docstrings, installed
+package METADATA/README text, Debian copyright files) — committed as
+`dalle_pytorch_tpu/data/default_bpe_8k.model` (~100 KB).
+
+Rerun to regenerate:  python scripts/train_default_vocab.py
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import hashlib
+import io
+import os
+import sys
+import tokenize
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+OUT = REPO / "dalle_pytorch_tpu" / "data" / "default_bpe_8k.model"
+VOCAB_SIZE = 8192
+
+
+def rainbow_captions() -> list[str]:
+    from dalle_pytorch_tpu.data.rainbow import RainbowDataset
+
+    ds = RainbowDataset()
+    return [ds.caption(i) for i in range(len(ds))]
+
+
+def stdlib_docstrings(limit_files: int = 400) -> list[str]:
+    """English prose from Python's own (PSF-licensed) stdlib docstrings."""
+    out = []
+    stdlib = Path(os.path.dirname(os.__file__))
+    files = sorted(stdlib.glob("*.py"))[:limit_files]
+    for f in files:
+        try:
+            tree = ast.parse(f.read_text(errors="ignore"))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(
+                node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                doc = ast.get_docstring(node)
+                if doc and len(doc) > 40:
+                    out.append(doc)
+    return out
+
+
+def package_metadata(cap_bytes: int = 4_000_000) -> list[str]:
+    """Long-description prose from installed package METADATA files."""
+    out, total = [], 0
+    for f in sorted(glob.glob(os.path.join(sys.prefix, "lib/*/site-packages/*.dist-info/METADATA"))):
+        try:
+            text = Path(f).read_text(errors="ignore")
+        except OSError:
+            continue
+        # skip the header block (key: value lines), keep the body prose
+        body = text.split("\n\n", 1)
+        body = body[1] if len(body) == 2 else ""
+        if len(body) < 200:
+            continue
+        out.append(body)
+        total += len(body)
+        if total > cap_bytes:
+            break
+    return out
+
+
+def debian_copyright(cap_files: int = 60) -> list[str]:
+    """Debian copyright texts, deduplicated by content hash."""
+    seen, out = set(), []
+    for f in sorted(glob.glob("/usr/share/doc/*/copyright")):
+        try:
+            text = Path(f).read_text(errors="ignore")
+        except OSError:
+            continue
+        h = hashlib.sha1(text.encode()).hexdigest()
+        if h in seen:
+            continue
+        seen.add(h)
+        out.append(text)
+        if len(out) >= cap_files:
+            break
+    return out
+
+
+def main():
+    parts = []
+    caps = rainbow_captions()
+    # repeat the captions so the target domain outweighs incidental prose
+    parts.extend(caps * 20)
+    docs = stdlib_docstrings()
+    parts.extend(docs)
+    meta = package_metadata()
+    parts.extend(meta)
+    deb = debian_copyright()
+    parts.extend(deb)
+    corpus = "\n".join(parts)
+    print(
+        f"corpus: {len(caps)} captions x20, {len(docs)} docstrings, "
+        f"{len(meta)} package bodies, {len(deb)} copyright files "
+        f"-> {len(corpus) / 1e6:.1f} MB"
+    )
+
+    from dalle_pytorch_tpu.data.native_bpe import NativeBPE
+
+    bpe = NativeBPE.train(corpus, vocab_size=VOCAB_SIZE)
+    bpe.save(OUT)
+    print(f"trained vocab_size={bpe.vocab_size} -> {OUT} ({OUT.stat().st_size} bytes)")
+
+    # smoke: round-trip a caption and some prose
+    for text in [caps[0], "a quick brown fox jumps over the lazy dog"]:
+        ids = bpe.encode(text)
+        assert bpe.decode(ids) == text, (text, bpe.decode(ids))
+        print(f"  {len(text)} chars -> {len(ids)} tokens: {text[:50]!r}")
+
+
+if __name__ == "__main__":
+    main()

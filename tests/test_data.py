@@ -69,8 +69,27 @@ class TestSimpleTokenizer:
         assert tok.vocab_size == 512 + 5 + 2
 
     def test_get_tokenizer_dispatch(self, bpe_file):
-        assert isinstance(get_tokenizer(), ByteTokenizer)
+        # no flags -> the shipped 8k default vocab (round-3: no more silent
+        # ByteTokenizer degradation)
+        from dalle_pytorch_tpu.data.tokenizer import NativeBPETokenizer
+
+        default = get_tokenizer()
+        assert isinstance(default, NativeBPETokenizer)
+        assert default.vocab_size == 8192
+        ids = default.tokenize("small red circle", context_length=8)
+        assert default.decode(ids[0]) == "small red circle"
         assert isinstance(get_tokenizer(bpe_path=str(bpe_file)), SimpleTokenizer)
+
+    def test_byte_fallback_warns(self, monkeypatch, tmp_path):
+        """A missing default vocab must degrade LOUDLY, not silently."""
+        import dalle_pytorch_tpu.data.tokenizer as tok
+
+        monkeypatch.setattr(
+            tok, "NativeBPETokenizer",
+            type("Broken", (), {"__init__": lambda self, p: (_ for _ in ()).throw(OSError("no toolchain"))}),
+        )
+        with pytest.warns(UserWarning, match="ByteTokenizer"):
+            assert isinstance(get_tokenizer(), ByteTokenizer)
 
 
 class TestRainbow:

@@ -1,0 +1,63 @@
+"""Prefetcher: input/compute overlap, error propagation, early close."""
+
+import time
+
+import pytest
+
+from dalle_pytorch_tpu.data.prefetch import Prefetcher
+
+
+def slow_producer(n, delay):
+    for i in range(n):
+        time.sleep(delay)
+        yield i
+
+
+class TestPrefetcher:
+    def test_order_and_completion(self):
+        out = list(Prefetcher(range(10), transform=lambda x: x * 2))
+        assert out == [x * 2 for x in range(10)]
+
+    def test_overlap(self):
+        """Producer and consumer sleeps overlap: total ~= max, not sum."""
+        n, delay = 8, 0.05
+        pf = Prefetcher(slow_producer(n, delay), depth=2)
+        t0 = time.perf_counter()
+        count = 0
+        for _ in pf:
+            time.sleep(delay)  # consumer "compute"
+            count += 1
+        total = time.perf_counter() - t0
+        assert count == n
+        # serial would be >= 2*n*delay = 0.8s; overlapped ~ n*delay + delay
+        assert total < 1.6 * n * delay, f"no overlap: {total:.3f}s"
+
+    def test_wait_fraction_bounds(self):
+        pf = Prefetcher(slow_producer(4, 0.03))
+        for _ in pf:
+            pass
+        assert 0.0 <= pf.wait_fraction <= 1.0
+        # consumer did no work, so it mostly waited
+        assert pf.wait_fraction > 0.5
+
+    def test_error_propagates(self):
+        def bad():
+            yield 1
+            raise RuntimeError("boom")
+
+        pf = Prefetcher(bad())
+        assert next(pf) == 1
+        with pytest.raises(RuntimeError, match="boom"):
+            for _ in pf:
+                pass
+
+    def test_transform_error_propagates(self):
+        pf = Prefetcher([1, 2], transform=lambda x: 1 // 0)
+        with pytest.raises(ZeroDivisionError):
+            list(pf)
+
+    def test_close_mid_stream(self):
+        pf = Prefetcher(slow_producer(100, 0.01), depth=2)
+        next(pf)
+        pf.close()  # must not hang or leak the thread
+        assert not pf._thread.is_alive()

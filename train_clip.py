@@ -43,6 +43,11 @@ def main():
     import numpy as np
 
     from dalle_pytorch_tpu.models.clip import CLIP
+    from dalle_pytorch_tpu.parallel import initialize_distributed
+
+    # multi-host rendezvous (launch.py env vars / TPU pod auto); no-op
+    # single-host. Must run before the first device query.
+    initialize_distributed()
     from dalle_pytorch_tpu.training.config import TrainConfig
     from dalle_pytorch_tpu.training.steps import (
         TrainState, make_optimizer, make_clip_train_step,

@@ -62,10 +62,16 @@ def main():
     from dalle_pytorch_tpu.parallel import (
         make_mesh, batch_sharding, state_shardings, partition_params, is_root,
     )
+    from dalle_pytorch_tpu.parallel import initialize_distributed
+
+    # multi-host rendezvous (launch.py env vars / TPU pod auto); no-op
+    # single-host. Must run before the first device query.
+    initialize_distributed()
     from dalle_pytorch_tpu.training import (
         TrainState, make_optimizer, make_dalle_train_step, ReduceLROnPlateau,
         set_learning_rate, get_learning_rate,
     )
+    from dalle_pytorch_tpu.data.prefetch import Prefetcher
     from dalle_pytorch_tpu.training.config import load_config
     from dalle_pytorch_tpu.training.checkpoint import CheckpointManager
     from dalle_pytorch_tpu.training.metrics import (
@@ -129,11 +135,17 @@ def main():
     except TypeError:  # streaming tar shards have no cheap length
         print("streaming dataset for training (length unknown)")
 
+    # mesh before model: attn_impl="ring" (mesh.sp > 1) shards the model's
+    # attention over the sp axis, so the model needs the mesh at build time
+    mesh = make_mesh(
+        dp=cfg.mesh.dp, fsdp=cfg.mesh.fsdp, tp=cfg.mesh.tp, sp=cfg.mesh.sp
+    )
     model = dalle_from_config(
         cfg,
         num_image_tokens=vae.num_tokens,
         image_fmap_size=image_fmap_size,
         vocab_size=max(tokenizer.vocab_size, 1),
+        sp_mesh=mesh,
     )
 
     rng = jax.random.PRNGKey(cfg.seed)
@@ -158,9 +170,6 @@ def main():
             step=int(resume_train.get("global_step", 0)),
         )
 
-    mesh = make_mesh(
-        dp=cfg.mesh.dp, fsdp=cfg.mesh.fsdp, tp=cfg.mesh.tp, sp=cfg.mesh.sp
-    )
     state_sh = state_shardings(state, mesh)
     txt_sh = batch_sharding(mesh, extra_dims=1)
     state = jax.device_put(state, state_sh)
@@ -251,9 +260,38 @@ def main():
         epoch_losses = []
         last_loss = None
         epoch_batch = 0
-        batch_iter = dataset.batches(
-            cfg.batch_size, shuffle_seed=cfg.seed + epoch, shard=shard,
-            start_batch=skip_batches if epoch == resume_epoch else 0,
+        def assemble(batch):
+            """Host->device batch assembly, run ahead of the step in the
+            prefetch thread so decode/tokenize/transfer overlap compute
+            (the DataLoader-workers equivalent, ref `:309-316`). Returns
+            (device_batch, captions) — captions ride separately because the
+            device batch's pytree must match the step's in_shardings."""
+            caps = batch.get("captions")
+            if in_step_encode:
+                dev = {
+                    "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
+                    "images": jax.device_put(
+                        jnp.asarray(batch["images"]), batch_shardings["images"]
+                    ),
+                }
+            else:
+                if "image_tokens" in batch:  # precomputed (TokenDataset)
+                    tokens = jnp.asarray(batch["image_tokens"])
+                else:  # pretrained torch-backed VAE: host-side encode
+                    tokens = vae.get_codebook_indices(jnp.asarray(batch["images"]))
+                dev = {
+                    "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
+                    "image_tokens": jax.device_put(tokens, txt_sh),
+                }
+            return dev, caps
+
+        batch_iter = Prefetcher(
+            dataset.batches(
+                cfg.batch_size, shuffle_seed=cfg.seed + epoch, shard=shard,
+                start_batch=skip_batches if epoch == resume_epoch else 0,
+            ),
+            transform=assemble,
+            depth=cfg.prefetch_depth,
         )
         if epoch == resume_epoch and skip_batches:
             epoch_batch = skip_batches
@@ -263,95 +301,87 @@ def main():
             epoch_losses = list(orbax_resume_meta.get("epoch_losses") or [])
             if orbax_resume_meta.get("last_loss") is not None:
                 last_loss = float(orbax_resume_meta["last_loss"])
-        for batch in batch_iter:
-            profiler.before_step(global_step)
-            if in_step_encode:
-                dev_batch = {
-                    "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
-                    "images": jax.device_put(
-                        jnp.asarray(batch["images"]), batch_shardings["images"]
-                    ),
-                }
+        try:
+            for dev_batch, captions in batch_iter:
+                profiler.before_step(global_step)
                 # fold_in(global_step), not sequential split: the key stream
                 # is a pure function of the step index, so a mid-epoch
                 # resume replays the exact dropout/null-cond randomness an
                 # uninterrupted run would use
                 r = jax.random.fold_in(rng, global_step)
-                state, metrics = step_fn(state, dev_batch, r, vae_params)
-            else:
-                if "image_tokens" in batch:  # precomputed (TokenDataset)
-                    tokens = jnp.asarray(batch["image_tokens"])
-                else:  # pretrained torch-backed VAE: host-side encode
-                    tokens = vae.get_codebook_indices(jnp.asarray(batch["images"]))
-                dev_batch = {
-                    "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
-                    "image_tokens": jax.device_put(tokens, txt_sh),
-                }
-                r = jax.random.fold_in(rng, global_step)
-                state, metrics = step_fn(state, dev_batch, r)
+                if in_step_encode:
+                    state, metrics = step_fn(state, dev_batch, r, vae_params)
+                else:
+                    state, metrics = step_fn(state, dev_batch, r)
 
-            global_step += 1
-            epoch_batch += 1
-            last_loss = metrics["loss"]  # lazy device scalar; no sync here
-            log = {}
-            if global_step % 10 == 0:
-                step_loss = float(last_loss)
-                epoch_losses.append(step_loss)
-                log.update(
-                    epoch=epoch, iter=global_step, loss=step_loss,
-                    forward_loss=float(metrics.get("forward_loss", 0.0)),
-                    inverse_loss=float(metrics.get("inverse_loss", 0.0)),
-                )
-                if "accuracy" in metrics:
-                    log["accuracy"] = float(metrics["accuracy"])
-                print(epoch, global_step, f"loss - {step_loss:.5f}")
+                global_step += 1
+                epoch_batch += 1
+                last_loss = metrics["loss"]  # lazy device scalar; no sync here
+                log = {}
+                if global_step % 10 == 0:
+                    step_loss = float(last_loss)
+                    epoch_losses.append(step_loss)
+                    log.update(
+                        epoch=epoch, iter=global_step, loss=step_loss,
+                        forward_loss=float(metrics.get("forward_loss", 0.0)),
+                        inverse_loss=float(metrics.get("inverse_loss", 0.0)),
+                    )
+                    if "accuracy" in metrics:
+                        log["accuracy"] = float(metrics["accuracy"])
+                    print(epoch, global_step, f"loss - {step_loss:.5f}")
 
-            if global_step % cfg.save_every_n_steps == 0:
-                ckpt.save(
-                    global_step, jax.device_get(state),
-                    metadata={
-                        "epoch": epoch, "step": global_step,
-                        "epoch_batch": epoch_batch,
-                        "epoch_losses": epoch_losses,
-                        "last_loss": (
-                            float(last_loss) if last_loss is not None else None
-                        ),
-                        "plateau": plateau.state_dict() if plateau else None,
-                    },
-                )
+                if global_step % cfg.save_every_n_steps == 0:
+                    ckpt.save(
+                        global_step, jax.device_get(state),
+                        metadata={
+                            "epoch": epoch, "step": global_step,
+                            "epoch_batch": epoch_batch,
+                            "epoch_losses": epoch_losses,
+                            "last_loss": (
+                                float(last_loss) if last_loss is not None else None
+                            ),
+                            "plateau": plateau.state_dict() if plateau else None,
+                        },
+                    )
 
-            if cfg.log_images_freq and global_step % cfg.log_images_freq == 0 \
-                    and is_root():
-                # in-loop sample generation in EVERY configuration —
-                # trainable dVAE, precomputed tokens, VQGAN/OpenAI — like
-                # the reference (`train_dalle.py:564-576`)
-                # (disjoint from the train-step keys: extra fold_in tag)
-                gr = jax.random.fold_in(jax.random.fold_in(rng, global_step), 1)
-                toks = generate_images(
-                    model, {"params": state.params},
-                    gr, jnp.asarray(batch["text"][:1]), filter_thres=0.9,
-                )
-                if isinstance(vae, DiscreteVAE):
-                    image = np.asarray(vae.apply(
-                        {"params": vae_params}, toks, method=DiscreteVAE.decode
-                    )) * 0.5 + 0.5  # dVAE decodes to [-1, 1]
-                else:  # pretrained wrappers decode straight to [0, 1]
-                    image = np.asarray(vae.decode(toks))
-                caption = batch.get("captions", [None])[0] or tokenizer.decode(
-                    batch["text"][0]
-                )
-                logger.log_images(image, caption, "image", global_step)
+                if cfg.log_images_freq and global_step % cfg.log_images_freq == 0 \
+                        and is_root():
+                    # in-loop sample generation in EVERY configuration —
+                    # trainable dVAE, precomputed tokens, VQGAN/OpenAI — like
+                    # the reference (`train_dalle.py:564-576`)
+                    # (disjoint from the train-step keys: extra fold_in tag)
+                    gr = jax.random.fold_in(jax.random.fold_in(rng, global_step), 1)
+                    toks = generate_images(
+                        model, {"params": state.params},
+                        gr, jnp.asarray(dev_batch["text"][:1]), filter_thres=0.9,
+                    )
+                    if isinstance(vae, DiscreteVAE):
+                        image = np.asarray(vae.apply(
+                            {"params": vae_params}, toks, method=DiscreteVAE.decode
+                        )) * 0.5 + 0.5  # dVAE decodes to [-1, 1]
+                    else:  # pretrained wrappers decode straight to [0, 1]
+                        image = np.asarray(vae.decode(toks))
+                    caption = (captions or [None])[0] or tokenizer.decode(
+                        np.asarray(dev_batch["text"][0])
+                    )
+                    logger.log_images(image, caption, "image", global_step)
 
-            rate = meter.update(global_step, cfg.batch_size)
-            if rate is not None:
-                log["sample_per_sec"] = rate
-                print(epoch, global_step, f"sample_per_sec - {rate:.2f}")
-            if log:
-                logger.log(log, step=global_step)
-            if profiler.after_step(global_step):
-                print("Profiler has finished running. Stopping training early.")
-                stop = True
-                break
+                rate = meter.update(global_step, cfg.batch_size)
+                if rate is not None:
+                    log["sample_per_sec"] = rate
+                    # input-boundedness: share of wall time blocked on the host
+                    # pipeline (~0 = fully overlapped)
+                    log["input_wait_frac"] = round(batch_iter.wait_fraction, 4)
+                    print(epoch, global_step, f"sample_per_sec - {rate:.2f}")
+                if log:
+                    logger.log(log, step=global_step)
+                if profiler.after_step(global_step):
+                    print("Profiler has finished running. Stopping training early.")
+                    stop = True
+                    break
+
+        finally:
+            batch_iter.close()
 
         if plateau is not None and last_loss is not None:
             # epoch-average of the sampled losses (+ the final step), the

@@ -49,6 +49,11 @@ def main():
     import jax.numpy as jnp
 
     from dalle_pytorch_tpu.parallel import make_mesh, batch_sharding, state_shardings, is_root
+    from dalle_pytorch_tpu.parallel import initialize_distributed
+
+    # multi-host rendezvous (launch.py env vars / TPU pod auto); no-op
+    # single-host. Must run before the first device query.
+    initialize_distributed()
     from dalle_pytorch_tpu.training import (
         TrainState, make_optimizer, make_vae_train_step, ExponentialDecay,
         set_learning_rate, get_learning_rate,
