@@ -87,6 +87,7 @@ class DALLE(nn.Module):
     dim_head: int = 64
     reversible: bool = False
     reversible_impl: str = "remat"
+    remat_policy: Optional[str] = None  # jax.checkpoint_policies name
     attn_dropout: float = 0.0
     ff_dropout: float = 0.0
     attn_types: Optional[Sequence[str]] = None
@@ -154,6 +155,7 @@ class DALLE(nn.Module):
             shared_ff_ids=self.shared_ff_ids,
             reversible=self.reversible,
             reversible_impl=self.reversible_impl,
+            remat_policy=self.remat_policy,
             attn_impl=self.attn_impl,
             sp_mesh=self.sp_mesh,
             dtype=self.dtype,

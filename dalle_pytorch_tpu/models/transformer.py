@@ -158,6 +158,11 @@ class Transformer(nn.Module):
     shared_ff_ids: Optional[Sequence[int]] = None
     reversible: bool = False
     reversible_impl: str = "remat"  # "remat" | "revnet" | "revnet_naive" (test)
+    # jax.checkpoint policy name for the remat executor (e.g.
+    # "dots_with_no_batch_dims_saveable" keeps matmul outputs and only
+    # recomputes cheap elementwise work in the backward — much faster than
+    # full recompute for a modest memory cost). None = save nothing.
+    remat_policy: Optional[str] = None
     attn_impl: str = "auto"  # "dense" | "flash" | "ring" | "auto"
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
     dtype: Any = jnp.float32
@@ -450,7 +455,12 @@ class Transformer(nn.Module):
                 def layer_fn(mdl, y, i=i):
                     return mdl._layer(i, y, key_mask, None, deterministic)[0]
 
-                x = nn.remat(layer_fn)(self, x)
+                policy = (
+                    getattr(jax.checkpoint_policies, self.remat_policy)
+                    if self.remat_policy
+                    else None
+                )
+                x = nn.remat(layer_fn, policy=policy)(self, x)
             else:
                 x, layer_cache = self._layer(
                     i, x, key_mask, cache[f"layer_{i}"] if cache else None, deterministic

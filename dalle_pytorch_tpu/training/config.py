@@ -66,6 +66,9 @@ class DalleConfig:
     attn_dropout: float = 0.0
     reversible: bool = False
     reversible_impl: str = "remat"  # remat | revnet
+    # jax.checkpoint policy for the remat executor (e.g.
+    # "dots_with_no_batch_dims_saveable"); None = full recompute
+    remat_policy: "Optional[str]" = None
     loss_img_weight: float = 7.0
     attn_types: str = "full"  # comma separated
     shift_tokens: bool = False

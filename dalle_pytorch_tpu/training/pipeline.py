@@ -177,6 +177,21 @@ def build_vae(cfg: TrainConfig, dtype=jnp.float32):
     return OpenAIDiscreteVAE(), None
 
 
+# ready-to-use jax.checkpoint_policies predicates (the module's other
+# attributes are factories that require arguments)
+REMAT_POLICIES = frozenset(
+    {
+        "everything_saveable",
+        "nothing_saveable",
+        "dots_saveable",
+        "dots_with_no_batch_dims_saveable",
+        "checkpoint_dots",
+        "checkpoint_dots_with_no_batch_dims",
+    }
+    & set(dir(jax.checkpoint_policies))
+)
+
+
 def dalle_from_config(
     cfg: TrainConfig,
     num_image_tokens: int,
@@ -189,6 +204,16 @@ def dalle_from_config(
     long-context training; with sp == 1 the mesh axis is inert and the
     configured attn_impl ("auto"/"dense"/"flash") applies."""
     m = cfg.model
+    remat_policy = getattr(m, "remat_policy", None)
+    if remat_policy is not None and remat_policy not in REMAT_POLICIES:
+        # jax.checkpoint_policies also contains policy FACTORIES
+        # (save_only_these_names, ...) that need arguments — passing one
+        # directly as a policy silently disables remat, so only the
+        # ready-to-use predicates are accepted here
+        raise ValueError(
+            f"unknown model.remat_policy {remat_policy!r}; valid names: "
+            f"{sorted(REMAT_POLICIES)}"
+        )
     attn_impl = m.attn_impl
     if sp_mesh is not None and sp_mesh.shape.get("sp", 1) > 1:
         if attn_impl in ("auto", "ring"):
@@ -225,6 +250,7 @@ def dalle_from_config(
         text_seq_len=m.text_seq_len,
         reversible=m.reversible,
         reversible_impl=getattr(m, "reversible_impl", "remat"),
+        remat_policy=remat_policy,
         attn_dropout=m.attn_dropout,
         ff_dropout=m.ff_dropout,
         attn_types=m.attn_types_tuple(),

@@ -28,7 +28,9 @@ import json
 import os
 import sys
 import time
-from functools import partial
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 K = int(os.environ.get("PROBE_K", "8"))
 
@@ -54,6 +56,11 @@ def run_probe(name, build, flops_per_iter, emit, k=K):
 
 def main():
     import jax
+
+    # the image's sitecustomize pins jax_platforms="axon,cpu"; the env var
+    # alone cannot override it — must update config after import
+    if os.environ.get("PROBE_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["PROBE_PLATFORM"])
     import jax.numpy as jnp
     from jax import lax
 
@@ -106,7 +113,10 @@ def main():
             params = module.init(jax.random.PRNGKey(1), x)
 
             def loss(p, x):
-                return module.apply(p, x).astype(jnp.float32).mean()
+                out = module.apply(p, x)
+                if isinstance(out, tuple):  # Attention returns (out, cache)
+                    out = out[0]
+                return out.astype(jnp.float32).mean()
 
             g = jax.grad(loss, argnums=1)
 

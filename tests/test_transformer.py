@@ -118,6 +118,31 @@ class TestTransformer:
         for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
+    def test_remat_policy_grad_parity(self):
+        """A selective checkpoint policy (save matmul outputs, recompute
+        elementwise) must not change outputs or grads — only the
+        memory/recompute trade."""
+        tfm_plain = make_transformer(depth=2)
+        tfm_pol = make_transformer(
+            depth=2, reversible=True,
+            remat_policy="dots_with_no_batch_dims_saveable",
+        )
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, SEQ, 32))
+        variables = tfm_plain.init(jax.random.PRNGKey(1), x)
+        np.testing.assert_allclose(
+            np.asarray(tfm_plain.apply(variables, x)),
+            np.asarray(tfm_pol.apply(variables, x)),
+            atol=1e-6,
+        )
+        g1 = jax.grad(lambda p: (tfm_plain.apply({"params": p}, x) ** 2).sum())(
+            variables["params"]
+        )
+        g2 = jax.grad(lambda p: (tfm_pol.apply({"params": p}, x) ** 2).sum())(
+            variables["params"]
+        )
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
     def test_noncausal_key_mask(self):
         tfm = make_transformer(causal=False, rotary_emb=False, image_fmap_size=None)
         x = jax.random.normal(jax.random.PRNGKey(0), (2, SEQ, 32))
