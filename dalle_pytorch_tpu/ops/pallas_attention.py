@@ -46,7 +46,18 @@ NEG_INF = -1e30
 
 
 def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    """Compile the kernel on real TPU hardware, interpret elsewhere.
+
+    Checks the device kind, not just the backend name: tunneled/plugin
+    backends (e.g. "axon") expose a real TPU under a different platform
+    string, and interpret mode there would silently bench the emulator.
+    """
+    if jax.default_backend() == "tpu":
+        return False
+    try:
+        return "tpu" not in jax.devices()[0].device_kind.lower()
+    except Exception:
+        return True
 
 
 def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
