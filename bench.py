@@ -105,9 +105,9 @@ def main():
     batch_dict = {"text": text, "image_tokens": tokens}
     rng = jax.random.PRNGKey(1)
 
-    # warmup / compile
+    # warmup / compile (float() forces completion; see timing note below)
     state, metrics = step(state, batch_dict, rng)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
 
     n_steps = int(os.environ.get("BENCH_STEPS", "20"))
     # BENCH_INPUT=host: feed every step through the real input machinery —
@@ -153,7 +153,9 @@ def main():
         for _ in range(n_steps):
             rng, r = jax.random.split(rng)
             state, metrics = step(state, batch_dict, r)
-    jax.block_until_ready(metrics["loss"])
+    # force completion with a value readback: block_until_ready is a no-op
+    # on some tunneled backends, which would time dispatch instead of compute
+    float(metrics["loss"])
     dt = time.perf_counter() - t0
 
     n_chips = jax.device_count()
