@@ -81,6 +81,7 @@ def main():
     remat_policy = os.environ.get("BENCH_REMAT_POLICY", "none")
     remat_policy = None if remat_policy == "none" else remat_policy
     attn_impl = os.environ.get("BENCH_ATTN", "auto")
+    fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
     image_seq = fmap * fmap
     seq = text_seq + image_seq
 
@@ -90,6 +91,7 @@ def main():
         num_text_tokens=10000, text_seq_len=text_seq,
         shift_tokens=True, rotary_emb=True, attn_impl=attn_impl,
         reversible=remat, reversible_impl="remat", remat_policy=remat_policy,
+        fused_ce=fused_ce,
         dtype=jnp.bfloat16,
     )
     text = jnp.ones((batch, text_seq), jnp.int32)
@@ -180,7 +182,8 @@ def main():
         "n_chips": n_chips,
         "config": (
             f"dim{dim}-depth{depth}-seq{seq}-gbs{batch}-accum{accum}-{attn_impl}"
-            f"-remat{int(remat)}{'-' + remat_policy if remat_policy else ''}-bf16"
+            f"-remat{int(remat)}{'-' + remat_policy if remat_policy else ''}"
+            f"{'-fusedce' if fused_ce else ''}-bf16"
         ),
     }
     if prefetcher is not None:

@@ -79,6 +79,9 @@ class DalleConfig:
     stable_softmax: bool = False
     sandwich_norm: bool = False
     num_text_tokens: int = 10000  # overridden by tokenizer vocab size
+    # vocab-chunked cross-entropy (ops/losses.py): forward objective
+    # without materializing [B, N, vocab] logits
+    fused_ce: bool = False
     # attention kernel selection: "dense" | "flash" (Pallas) | "ring"
     # (sequence-parallel over the mesh sp axis) | "auto" (dense below
     # AUTO_FLASH_MIN_SEQ, flash above; ring when mesh.sp > 1)
