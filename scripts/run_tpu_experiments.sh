@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-3 TPU experiment matrix. Runs every perf configuration back to back
+# and appends one JSON line per result to TPU_RESULTS.jsonl. Each step is
+# individually time-boxed so one wedge cannot eat the whole matrix.
+# Usage: bash scripts/run_tpu_experiments.sh [out_file]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-TPU_RESULTS.jsonl}"
+
+run() {
+    local name="$1"; shift
+    local tmo="$1"; shift
+    echo "=== $name (timeout ${tmo}s) ===" >&2
+    local line
+    line=$(timeout "$tmo" env "$@" 2>/dev/null | grep '^{' | tail -5)
+    if [ -n "$line" ]; then
+        while IFS= read -r l; do
+            echo "{\"experiment\": \"$name\", \"result\": $l}" >> "$OUT"
+        done <<< "$line"
+        echo "$line" >&2
+    else
+        echo "{\"experiment\": \"$name\", \"result\": null}" >> "$OUT"
+        echo "(no output)" >&2
+    fi
+}
+
+# 0. component probes: peak MXU rate + per-block costs
+run probe_peak        900 PROBE_K=8 python scripts/perf_probe.py peak
+run probe_components 1200 PROBE_K=8 python scripts/perf_probe.py attn ff logits
+
+# 1. bench ladder: remat policy, flash attention, fused CE
+run bench_base       1200 python bench.py
+run bench_policy     1200 BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable python bench.py
+run bench_flash      1200 BENCH_ATTN=flash python bench.py
+run bench_flash_pol  1200 BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable python bench.py
+run bench_flash_pol_ce 1200 BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py
+run bench_noremat_a2 1200 BENCH_REMAT=0 BENCH_ACCUM=2 BENCH_ATTN=flash python bench.py
+run bench_host_input 1200 BENCH_INPUT=host BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable python bench.py
+
+# 2. pallas on-chip validation: compiled parity + dense-vs-flash A/B
+run pallas_onchip    1800 PROBE_K=8 python scripts/pallas_onchip.py
+
+# 3. inference north star
+run generate_p50     1800 python bench_generate.py
+
+echo "results -> $OUT" >&2
