@@ -22,7 +22,10 @@ import sys
 import time
 
 PROBE_CODE = (
-    "import json, time, jax, jax.numpy as jnp\n"
+    "import json, os, time, jax\n"
+    "if os.environ.get('DALLE_TPU_FORCE_PLATFORM'):\n"
+    "    jax.config.update('jax_platforms', os.environ['DALLE_TPU_FORCE_PLATFORM'])\n"
+    "import jax.numpy as jnp\n"
     "t0 = time.perf_counter()\n"
     "x = jnp.ones((256, 256))\n"
     "y = float((x @ x).sum())\n"
@@ -97,8 +100,13 @@ def run_guarded(
     cpu_env_defaults: dict | None = None,
     oom_ladder: list[dict] | None = None,
     microbatch_of=None,
-) -> None:
+    profiles: "list[tuple[str, dict]] | None" = None,
+) -> "dict | None":
     """Probe, then run `script --child` and forward its JSON line.
+
+    Returns the successful result dict (already printed), or None on every
+    failure path (a structured-failure line is printed instead) — callers
+    use this to gate follow-on work on a real result.
 
     `cpu_env_defaults` are env vars applied (setdefault) when the probed
     platform is CPU, to shrink the workload to something that finishes.
@@ -115,6 +123,17 @@ def run_guarded(
     microbatch implied by an env dict; rungs that are invalid (None) or
     don't shrink the microbatch below the last attempt that actually ran
     (e.g. the caller already set a larger accumulation) are skipped.
+
+    `profiles` is an ordered list of (name, env-defaults) configurations:
+    the first profile that produces a result wins, and ANY child failure
+    (not just OOM) falls through to the next — so an aggressive fast
+    configuration can be tried first with a known-good one as the safety
+    net. Profile values are applied with setdefault, so explicit user env
+    always wins. Within each profile the OOM accum-ladder still applies.
+    The total budget is divided across the profiles still remaining, so a
+    HANGING child in an early profile cannot starve the safety net; on a
+    CPU fallback (smoke run) profiles are skipped entirely — they encode
+    accelerator trade-offs and would mislabel the record.
     """
     info = probe_device()
     if info is None:
@@ -133,8 +152,16 @@ def run_guarded(
 
     deadline = time.monotonic() + child_timeout
     rungs = [{}] + list(oom_ladder or [])
+    prof_list = list(profiles or [("", {})])
+    if info.get("platform") == "cpu" and os.environ.get(
+        "BENCH_PROFILES_ON_CPU"
+    ) != "1":
+        # profiles encode accelerator trade-offs; a CPU smoke run with
+        # them would mislabel the record (flash forced back to dense by
+        # cpu_env_defaults but still stamped "flash"). Escape hatch for
+        # harness tests: BENCH_PROFILES_ON_CPU=1.
+        prof_list = [("", {})]
     last_error = ""
-    last_mb = None
     n_run = 0
     if microbatch_of is not None and microbatch_of(base_env) is None:
         emit_failure(
@@ -145,55 +172,72 @@ def run_guarded(
         )
         return
 
-    for overrides in rungs:
-        env = dict(base_env)
-        env.update(overrides)
-        if microbatch_of is not None:
-            mb = microbatch_of(env)
-            if mb is None or (last_mb is not None and mb >= last_mb):
-                continue
-        else:
-            mb = None
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            emit_failure(
-                metric,
-                unit,
-                f"bench budget ({child_timeout:.0f}s) exhausted after "
-                f"{n_run} attempt(s): {last_error}",
-            )
-            return
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.abspath(script), "--child"],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-                timeout=remaining,
-                env=env,
-            )
-        except subprocess.TimeoutExpired:
-            emit_failure(
-                metric,
-                unit,
-                f"bench child exceeded the {child_timeout:.0f}s total budget "
-                f"on attempt {n_run + 1}",
-            )
-            return
-        n_run += 1
-        last_mb = mb
+    for prof_idx, (prof_name, prof_env) in enumerate(prof_list):
+        # fair share of the remaining budget: a hanging child in an early
+        # profile must not starve the later (safety-net) profiles
+        remaining_total = deadline - time.monotonic()
+        profiles_left = len(prof_list) - prof_idx
+        prof_deadline = time.monotonic() + max(
+            remaining_total / profiles_left, 60.0
+        )
+        prof_base = dict(base_env)
+        for k, v in prof_env.items():
+            prof_base.setdefault(k, v)
+        last_mb = None
+        for overrides in rungs:
+            env = dict(prof_base)
+            env.update(overrides)
+            if microbatch_of is not None:
+                mb = microbatch_of(env)
+                if mb is None or (last_mb is not None and mb >= last_mb):
+                    continue
+            else:
+                mb = None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                emit_failure(
+                    metric,
+                    unit,
+                    f"bench budget ({child_timeout:.0f}s) exhausted after "
+                    f"{n_run} attempt(s): {last_error}",
+                )
+                return None
+            prof_remaining = prof_deadline - time.monotonic()
+            if prof_remaining <= 0:
+                break  # this profile's slice is spent; on to the next
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(script), "--child"],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    timeout=min(remaining, prof_remaining),
+                    env=env,
+                )
+            except subprocess.TimeoutExpired:
+                n_run += 1
+                last_error = (
+                    f"child timed out after {min(remaining, prof_remaining):.0f}s "
+                    f"in profile {prof_name or 'default'!r}"
+                )
+                break  # hang: skip to the next (safer) profile
+            n_run += 1
+            last_mb = mb
 
-        result = _last_json_line(proc.stdout)
-        if proc.returncode == 0 and result is not None:
-            if n_run > 1:
-                result["oom_retries"] = n_run - 1
-            print(json.dumps(result))
-            return
+            result = _last_json_line(proc.stdout)
+            if proc.returncode == 0 and result is not None:
+                if n_run > 1:
+                    result["attempts"] = n_run
+                if prof_name:
+                    result["profile"] = prof_name
+                print(json.dumps(result))
+                return result
 
-        err_text = proc.stderr or proc.stdout or ""
-        last_error = "\n".join(err_text.splitlines()[-12:])
-        if not _looks_like_oom(err_text):
-            break
+            err_text = proc.stderr or proc.stdout or ""
+            last_error = "\n".join(err_text.splitlines()[-12:])
+            if not _looks_like_oom(err_text):
+                break  # non-OOM failure: try the next profile, not a
+                # smaller microbatch of the same one
 
     emit_failure(
         metric,
@@ -201,3 +245,43 @@ def run_guarded(
         f"bench child failed after {n_run} attempt(s), "
         f"no JSON produced: {last_error}",
     )
+    return None
+
+
+def run_extra(cmd: list, out_path: str, label: str, timeout: float) -> None:
+    """Run an auxiliary measurement, appending its JSON lines to a file.
+
+    Used for opportunistic on-hardware artifacts (generate p50, Pallas
+    parity/timing, component probes) piggybacked on a successful main
+    bench run — stdout stays reserved for the ONE main JSON line.
+    """
+    try:
+        proc = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+            timeout=timeout,
+            env=dict(os.environ),
+        )
+        stdout = proc.stdout or ""
+    except subprocess.TimeoutExpired as e:
+        # keep whatever JSON lines made it out before the cutoff
+        stdout = e.stdout or ""
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode(errors="replace")
+    lines = [
+        ln.strip() for ln in stdout.splitlines() if ln.strip().startswith("{")
+    ]
+    records = []
+    for ln in lines:
+        try:
+            records.append(json.loads(ln))
+        except json.JSONDecodeError:
+            continue
+    with open(out_path, "a") as f:
+        if records:
+            for rec in records:
+                f.write(json.dumps({"experiment": label, "result": rec}) + "\n")
+        else:
+            f.write(json.dumps({"experiment": label, "result": None}) + "\n")

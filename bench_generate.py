@@ -23,6 +23,9 @@ UNIT = "s"
 
 def main():
     import jax
+
+    if os.environ.get("DALLE_TPU_FORCE_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["DALLE_TPU_FORCE_PLATFORM"])
     import jax.numpy as jnp
 
     from dalle_pytorch_tpu.models.dalle import DALLE, generate_images_cached
@@ -91,7 +94,9 @@ if __name__ == "__main__":
             METRIC,
             UNIT,
             __file__,
-            child_timeout=1800.0,
+            # < bench.py's BENCH_EXTRA_BUDGET so a run started there can
+            # finish (and print its JSON) before the outer cutoff
+            child_timeout=1400.0,
             cpu_env_defaults={
                 "GEN_BATCH": "1",
                 "GEN_FMAP": "8",

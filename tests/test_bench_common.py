@@ -1,0 +1,120 @@
+"""bench_common harness: profile fallback, OOM ladder, extras capture.
+
+These tests guard the round-end contract: ONE JSON line on stdout no
+matter how the workload fails (round-2 postmortem)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bench_common import run_extra  # noqa: E402
+
+
+def make_script(tmp_path, body):
+    p = tmp_path / "fake_bench.py"
+    p.write_text(body)
+    return str(p)
+
+
+def run_parent(tmp_path, script_body, parent_body):
+    """Run a tiny parent that calls run_guarded on a fake child script."""
+    child = make_script(tmp_path, script_body)
+    parent = tmp_path / "parent.py"
+    parent.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {str(REPO)!r})\n"
+        f"CHILD = {child!r}\n"
+        "from bench_common import run_guarded\n" + parent_body
+    )
+    import os
+
+    env = dict(os.environ)
+    env["DALLE_TPU_FORCE_PLATFORM"] = "cpu"  # keep the device probe off
+    # any tunneled accelerator backend
+    env["BENCH_PROFILES_ON_CPU"] = "1"  # profiles are normally TPU-only
+    proc = subprocess.run(
+        [sys.executable, str(parent)], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert len(lines) == 1, f"expected ONE JSON line, got: {lines}"
+    return json.loads(lines[0])
+
+
+class TestProfiles:
+    def test_profile_fallback_on_non_oom_failure(self, tmp_path):
+        # child fails (ImportError-ish) unless FAKE_MODE=good
+        script = (
+            "import json, os, sys\n"
+            "if os.environ.get('FAKE_MODE') != 'good':\n"
+            "    sys.stderr.write('some crash, not memory related')\n"
+            "    sys.exit(1)\n"
+            "print(json.dumps({'metric': 'm', 'value': 1, 'unit': 'u',"
+            " 'ok': True, 'vs_baseline': 1.0}))\n"
+        )
+        result = run_parent(
+            tmp_path, script,
+            "run_guarded('m', 'u', CHILD, child_timeout=60,\n"
+            "    profiles=[('fast', {'FAKE_MODE': 'bad'}),"
+            " ('safe', {'FAKE_MODE': 'good'})])\n",
+        )
+        assert result["ok"] is True
+        assert result["profile"] == "safe"
+        assert result["attempts"] == 2
+
+    def test_oom_ladder_within_profile(self, tmp_path):
+        # child OOMs unless BENCH_ACCUM >= 2
+        script = (
+            "import json, os, sys\n"
+            "if int(os.environ.get('BENCH_ACCUM', '1')) < 2:\n"
+            "    sys.stderr.write('RESOURCE_EXHAUSTED: out of memory')\n"
+            "    sys.exit(1)\n"
+            "print(json.dumps({'metric': 'm', 'value': 2, 'unit': 'u',"
+            " 'ok': True, 'vs_baseline': 1.0}))\n"
+        )
+        result = run_parent(
+            tmp_path, script,
+            "def mb(env):\n"
+            "    b = int(env.get('BENCH_BATCH', '16'))\n"
+            "    a = int(env.get('BENCH_ACCUM', '1'))\n"
+            "    return b // a if a > 0 and b % a == 0 else None\n"
+            "run_guarded('m', 'u', CHILD, child_timeout=60,\n"
+            "    oom_ladder=[{'BENCH_ACCUM': '2'}, {'BENCH_ACCUM': '4'}],\n"
+            "    microbatch_of=mb)\n",
+        )
+        assert result["ok"] is True and result["value"] == 2
+        assert result["attempts"] == 2
+
+    def test_all_profiles_fail_is_one_failure_line(self, tmp_path):
+        script = "import sys; sys.stderr.write('boom'); sys.exit(1)\n"
+        result = run_parent(
+            tmp_path, script,
+            "run_guarded('m', 'u', CHILD, child_timeout=60,\n"
+            "    profiles=[('a', {}), ('b', {})])\n",
+        )
+        assert result["ok"] is False and result["value"] == 0
+
+
+class TestRunExtra:
+    def test_captures_json_lines(self, tmp_path):
+        script = make_script(
+            tmp_path,
+            "print('noise')\nprint('{\"a\": 1}')\nprint('{\"b\": 2}')\n",
+        )
+        out = tmp_path / "extra.jsonl"
+        run_extra([sys.executable, script], str(out), "exp1", 60)
+        recs = [json.loads(l) for l in out.read_text().splitlines()]
+        assert [r["result"] for r in recs] == [{"a": 1}, {"b": 2}]
+        assert all(r["experiment"] == "exp1" for r in recs)
+
+    def test_records_null_on_crash(self, tmp_path):
+        script = make_script(tmp_path, "import sys; sys.exit(3)\n")
+        out = tmp_path / "extra.jsonl"
+        run_extra([sys.executable, script], str(out), "exp2", 60)
+        recs = [json.loads(l) for l in out.read_text().splitlines()]
+        assert recs == [{"experiment": "exp2", "result": None}]
