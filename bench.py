@@ -299,5 +299,12 @@ if __name__ == "__main__":
             ):
                 left = extras_deadline - time.monotonic()
                 if left < 60:
-                    break
+                    # record the skip so "not in the file" can't be read
+                    # as "never attempted"
+                    with open(out, "a") as f:
+                        f.write(json.dumps({
+                            "experiment": label, "result": None,
+                            "skipped": "extras budget exhausted",
+                        }) + "\n")
+                    continue
                 run_extra(cmd, out, label, left)

@@ -66,6 +66,8 @@ def probe_device(timeout: float = 90.0):
 
 
 def emit_failure(metric: str, unit: str, error: str) -> None:
+    # flush: the process may live on (extras) long after this line; an
+    # unflushed pipe buffer could lose it if the driver kills us later
     print(
         json.dumps(
             {
@@ -76,7 +78,8 @@ def emit_failure(metric: str, unit: str, error: str) -> None:
                 "ok": False,
                 "error": error,
             }
-        )
+        ),
+        flush=True,
     )
 
 
@@ -173,13 +176,18 @@ def run_guarded(
         return
 
     for prof_idx, (prof_name, prof_env) in enumerate(prof_list):
-        # fair share of the remaining budget: a hanging child in an early
-        # profile must not starve the later (safety-net) profiles
+        # budget sharing: a hanging child in an early profile must not
+        # starve the safety-net profiles, but the FIRST (preferred) profile
+        # gets half the budget rather than 1/len — a slow-but-successful
+        # run there beats a fast fallback
         remaining_total = deadline - time.monotonic()
         profiles_left = len(prof_list) - prof_idx
-        prof_deadline = time.monotonic() + max(
-            remaining_total / profiles_left, 60.0
+        share = (
+            remaining_total
+            if profiles_left == 1
+            else remaining_total / 2.0
         )
+        prof_deadline = time.monotonic() + max(share, 60.0)
         prof_base = dict(base_env)
         for k, v in prof_env.items():
             prof_base.setdefault(k, v)
@@ -230,7 +238,9 @@ def run_guarded(
                     result["attempts"] = n_run
                 if prof_name:
                     result["profile"] = prof_name
-                print(json.dumps(result))
+                # flush: extras may keep this process alive long after;
+                # see emit_failure
+                print(json.dumps(result), flush=True)
                 return result
 
             err_text = proc.stderr or proc.stdout or ""
@@ -254,22 +264,36 @@ def run_extra(cmd: list, out_path: str, label: str, timeout: float) -> None:
     Used for opportunistic on-hardware artifacts (generate p50, Pallas
     parity/timing, component probes) piggybacked on a successful main
     bench run — stdout stays reserved for the ONE main JSON line.
+
+    The extra runs in its own process group and the WHOLE group is killed
+    on timeout: these scripts spawn their own JAX children, and an
+    orphaned device child would hold the accelerator and wedge every
+    later extra.
     """
+    import signal
+
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=dict(os.environ),
+        start_new_session=True,
+    )
     try:
-        proc = subprocess.run(
-            cmd,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
-            text=True,
-            timeout=timeout,
-            env=dict(os.environ),
-        )
-        stdout = proc.stdout or ""
-    except subprocess.TimeoutExpired as e:
+        stdout, _ = proc.communicate(timeout=timeout)
+        stdout = stdout or ""
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
         # keep whatever JSON lines made it out before the cutoff
-        stdout = e.stdout or ""
-        if isinstance(stdout, bytes):
-            stdout = stdout.decode(errors="replace")
+        try:
+            stdout, _ = proc.communicate(timeout=10)
+            stdout = stdout or ""
+        except Exception:
+            stdout = ""
     lines = [
         ln.strip() for ln in stdout.splitlines() if ln.strip().startswith("{")
     ]

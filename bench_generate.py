@@ -94,9 +94,10 @@ if __name__ == "__main__":
             METRIC,
             UNIT,
             __file__,
-            # < bench.py's BENCH_EXTRA_BUDGET so a run started there can
-            # finish (and print its JSON) before the outer cutoff
-            child_timeout=1400.0,
+            # leaves headroom inside bench.py's BENCH_EXTRA_BUDGET (1500s)
+            # for interpreter startup + the 90s device probe, so a run
+            # started there can finish (and print its JSON) in time
+            child_timeout=1300.0,
             cpu_env_defaults={
                 "GEN_BATCH": "1",
                 "GEN_FMAP": "8",

@@ -110,58 +110,70 @@ def main():
     temp = cfg.vae.temperature
     global_step = 0
     shard = (jax.process_index(), jax.process_count())
+    from dalle_pytorch_tpu.data.prefetch import Prefetcher
+
     for epoch in range(cfg.epochs):
-        for batch in dataset.batches(cfg.batch_size, shuffle_seed=epoch, shard=shard):
-            images = jax.device_put(jnp.asarray(batch["images"]), img_sh)
-            rng, r = jax.random.split(rng)
-            state, metrics = step_fn(state, images, r, jnp.float32(temp))
-            global_step += 1
+        # background batch assembly + device transfer ahead of the step
+        # (same input/compute overlap as train_dalle.py)
+        batch_iter = Prefetcher(
+            dataset.batches(cfg.batch_size, shuffle_seed=epoch, shard=shard),
+            transform=lambda b: jax.device_put(jnp.asarray(b["images"]), img_sh),
+            depth=cfg.prefetch_depth,
+        )
+        try:
+            for images in batch_iter:
+                rng, r = jax.random.split(rng)
+                state, metrics = step_fn(state, images, r, jnp.float32(temp))
+                global_step += 1
 
-            log = {}
-            if global_step % 100 == 0:
-                # recon grids: soft (gumbel) + hard (argmax->decode)
-                k = min(4, images.shape[0])
-                soft = vae.apply(
-                    {"params": state.params}, images[:k], temp=temp,
-                    rngs={"gumbel": r},
-                )
-                codes = vae.apply(
-                    {"params": state.params}, images[:k],
-                    method=type(vae).get_codebook_indices,
-                )
-                hard = vae.apply({"params": state.params}, codes, method=type(vae).decode)
-                # codebook usage histogram (`train_vae.py:256-260`)
-                usage = np.bincount(
-                    np.asarray(codes).ravel(), minlength=cfg.vae.num_tokens
-                )
-                grid = np.concatenate(
-                    [np.asarray(images[:k]), np.asarray(soft) * 0.5 + 0.5,
-                     np.asarray(hard) * 0.5 + 0.5], axis=0
-                )
-                logger.log_images(grid, "orig | soft | hard", "recons", global_step)
-                # temperature anneal (`train_vae.py:278`)
-                temp = max(
-                    temp * math.exp(-cfg.vae.anneal_rate * global_step),
-                    cfg.vae.temp_min,
-                )
-                if sched is not None:
-                    state = set_learning_rate(
-                        state, sched.step(0.0, get_learning_rate(state))
+                log = {}
+                if global_step % 100 == 0:
+                    # recon grids: soft (gumbel) + hard (argmax->decode)
+                    k = min(4, images.shape[0])
+                    soft = vae.apply(
+                        {"params": state.params}, images[:k], temp=temp,
+                        rngs={"gumbel": r},
                     )
-                log.update(
-                    temperature=temp,
-                    lr=get_learning_rate(state),
-                    codebook_usage_frac=float((usage > 0).mean()),
-                )
+                    codes = vae.apply(
+                        {"params": state.params}, images[:k],
+                        method=type(vae).get_codebook_indices,
+                    )
+                    hard = vae.apply({"params": state.params}, codes, method=type(vae).decode)
+                    # codebook usage histogram (`train_vae.py:256-260`)
+                    usage = np.bincount(
+                        np.asarray(codes).ravel(), minlength=cfg.vae.num_tokens
+                    )
+                    grid = np.concatenate(
+                        [np.asarray(images[:k]), np.asarray(soft) * 0.5 + 0.5,
+                         np.asarray(hard) * 0.5 + 0.5], axis=0
+                    )
+                    logger.log_images(grid, "orig | soft | hard", "recons", global_step)
+                    # temperature anneal (`train_vae.py:278`)
+                    temp = max(
+                        temp * math.exp(-cfg.vae.anneal_rate * global_step),
+                        cfg.vae.temp_min,
+                    )
+                    if sched is not None:
+                        state = set_learning_rate(
+                            state, sched.step(0.0, get_learning_rate(state))
+                        )
+                    log.update(
+                        temperature=temp,
+                        lr=get_learning_rate(state),
+                        codebook_usage_frac=float((usage > 0).mean()),
+                    )
 
-            rate = meter.update(global_step, cfg.batch_size)
-            if rate is not None:
-                log["sample_per_sec"] = rate
-            if global_step % 10 == 0:
-                log["loss"] = float(metrics["loss"])
-                print(epoch, global_step, f"loss - {log['loss']:.5f}")
-            if log:
-                logger.log(log, step=global_step)
+                rate = meter.update(global_step, cfg.batch_size)
+                if rate is not None:
+                    log["sample_per_sec"] = rate
+                if global_step % 10 == 0:
+                    log["loss"] = float(metrics["loss"])
+                    print(epoch, global_step, f"loss - {log['loss']:.5f}")
+                if log:
+                    logger.log(log, step=global_step)
+
+        finally:
+            batch_iter.close()
 
         if is_root():
             save_vae_checkpoint(args.output, vae, jax.device_get(state.params), epoch)
