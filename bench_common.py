@@ -133,10 +133,12 @@ def run_guarded(
     configuration can be tried first with a known-good one as the safety
     net. Profile values are applied with setdefault, so explicit user env
     always wins. Within each profile the OOM accum-ladder still applies.
-    The total budget is divided across the profiles still remaining, so a
-    HANGING child in an early profile cannot starve the safety net; on a
-    CPU fallback (smoke run) profiles are skipped entirely — they encode
-    accelerator trade-offs and would mislabel the record.
+    Budget policy: each non-final profile gets HALF the remaining budget
+    (the preferred configuration deserves the larger share; a hang there
+    still leaves the other half for the safety net); the final profile
+    gets everything left. On a CPU fallback (smoke run) profiles are
+    skipped entirely — they encode accelerator trade-offs and would
+    mislabel the record.
     """
     info = probe_device()
     if info is None:

@@ -36,6 +36,7 @@ Semantics preserved (with reference lines):
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional, Sequence
 
 import numpy as np
@@ -416,6 +417,40 @@ def _primed_image_tokens(
     return img_tokens, primed
 
 
+@functools.lru_cache(maxsize=16)
+def _jitted_sampler(fn_builder, model, static_key):
+    """One compiled sampler per (entry point, model, sampling params).
+
+    Without this, every `generate_images*` call dispatches its prefill and
+    setup ops eagerly — one backend round trip per op, which dominates
+    wall time on remote/tunneled devices (BASELINE.md measurement notes).
+    """
+    return jax.jit(fn_builder(model, static_key))
+
+
+_warned_eager_sampler = False
+
+
+def _jit_sample(fn_builder, model, static_key, *args):
+    try:
+        jitted = _jitted_sampler(fn_builder, model, static_key)
+    except TypeError:  # unhashable model field (list attn_types, custom mesh)
+        global _warned_eager_sampler
+        if not _warned_eager_sampler:
+            _warned_eager_sampler = True
+            import warnings
+
+            warnings.warn(
+                "DALLE model is unhashable (list-valued field or custom "
+                "sp_mesh?) — sampling falls back to EAGER dispatch, which "
+                "is drastically slower on remote devices. Use tuples for "
+                "attn_types/shared_*_ids to get the jit-cached sampler.",
+                stacklevel=3,
+            )
+        return fn_builder(model, static_key)(*args)
+    return jitted(*args)
+
+
 def generate_images_cached(
     model: DALLE,
     variables,
@@ -435,7 +470,45 @@ def generate_images_cached(
     steps against the fixed-shape cache (KV + token-shift rings).
     Classifier-free guidance (cond_scale != 1) stacks a null-text stream
     along the batch axis — one model call serves both — and blends logits
-    per step (`dalle_pytorch.py:575-585`)."""
+    per step (`dalle_pytorch.py:575-585`). The whole pipeline (prefill +
+    decode scan) runs as ONE jitted program, cached per model/params."""
+    static_key = (filter_thres, temperature, cond_scale, num_init_img_tokens)
+    if init_image_tokens is None:
+        return _jit_sample(
+            _cached_sampler_builder, model, static_key, variables, rng, text
+        )
+    return _jit_sample(
+        _cached_sampler_builder, model, static_key,
+        variables, rng, text, init_image_tokens,
+    )
+
+
+def _cached_sampler_builder(model, key):
+    filter_thres, temperature, cond_scale, num_init = key
+
+    def fn(variables, rng, text, init_image_tokens=None):
+        return _generate_images_cached_impl(
+            model, variables, rng, text,
+            filter_thres=filter_thres, temperature=temperature,
+            cond_scale=cond_scale,
+            init_image_tokens=init_image_tokens,
+            num_init_img_tokens=num_init,
+        )
+
+    return fn
+
+
+def _generate_images_cached_impl(
+    model: DALLE,
+    variables,
+    rng: jax.Array,
+    text: jnp.ndarray,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    cond_scale: float = 1.0,
+    init_image_tokens: Optional[jnp.ndarray] = None,
+    num_init_img_tokens: Optional[int] = None,
+):
     b = text.shape[0]
     image_seq_len = model.image_seq_len
     use_null = cond_scale != 1.0
@@ -502,6 +575,44 @@ def forward_with_cond_scale(
 
 
 def generate_images(
+    model: DALLE,
+    variables,
+    rng: jax.Array,
+    text: jnp.ndarray,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+    cond_scale: float = 1.0,
+    init_image_tokens: Optional[jnp.ndarray] = None,
+    num_init_img_tokens: Optional[int] = None,
+):
+    """Jit-cached wrapper over the full-reforward sampling oracle."""
+    static_key = (filter_thres, temperature, cond_scale, num_init_img_tokens)
+    if init_image_tokens is None:
+        return _jit_sample(
+            _full_sampler_builder, model, static_key, variables, rng, text
+        )
+    return _jit_sample(
+        _full_sampler_builder, model, static_key,
+        variables, rng, text, init_image_tokens,
+    )
+
+
+def _full_sampler_builder(model, key):
+    filter_thres, temperature, cond_scale, num_init = key
+
+    def fn(variables, rng, text, init_image_tokens=None):
+        return _generate_images_impl(
+            model, variables, rng, text,
+            filter_thres=filter_thres, temperature=temperature,
+            cond_scale=cond_scale,
+            init_image_tokens=init_image_tokens,
+            num_init_img_tokens=num_init,
+        )
+
+    return fn
+
+
+def _generate_images_impl(
     model: DALLE,
     variables,
     rng: jax.Array,
