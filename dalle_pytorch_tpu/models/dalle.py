@@ -672,6 +672,34 @@ def generate_texts(
     filter_thres: float = 0.5,
     temperature: float = 1.0,
 ):
+    """Jit-cached wrapper over autoregressive text completion."""
+    static_key = (filter_thres, temperature, prefix_len)
+    return _jit_sample(
+        _text_sampler_builder, model, static_key, variables, rng, text_prefix
+    )
+
+
+def _text_sampler_builder(model, key):
+    filter_thres, temperature, prefix_len = key
+
+    def fn(variables, rng, text_prefix):
+        return _generate_texts_impl(
+            model, variables, rng, text_prefix, prefix_len,
+            filter_thres=filter_thres, temperature=temperature,
+        )
+
+    return fn
+
+
+def _generate_texts_impl(
+    model: DALLE,
+    variables,
+    rng: jax.Array,
+    text_prefix: jnp.ndarray,
+    prefix_len: int,
+    filter_thres: float = 0.5,
+    temperature: float = 1.0,
+):
     """Autoregressive text completion (`dalle_pytorch.py:470-515`).
 
     text_prefix: [B, text_seq_len] with ids after position `prefix_len`

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Polls the tunneled TPU; the moment a probe matmul succeeds, runs the
+# round-3 experiment matrix once and exits. Detach with:
+#   nohup setsid bash scripts/tpu_watchdog.sh > watchdog.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+PROBE='import jax, jax.numpy as jnp; x = jnp.ones((8,8)) @ jnp.ones((8,8)); print("PROBE_OK", float(x.sum()))'
+
+echo "[watchdog] started $(date -u +%H:%M:%S)"
+while true; do
+    if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
+        echo "[watchdog] tunnel recovered at $(date -u +%H:%M:%S); running matrix"
+        bash scripts/run_tpu_experiments.sh TPU_RESULTS.jsonl
+        echo "[watchdog] matrix done at $(date -u +%H:%M:%S)"
+        exit 0
+    fi
+    echo "[watchdog] $(date -u +%H:%M:%S) tunnel still down"
+    sleep 240
+done
