@@ -49,20 +49,40 @@ def _last_json_line(text: str):
 
 
 def probe_device(timeout: float = 90.0):
-    """Tiny matmul in a subprocess. Returns device info dict or None."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", PROBE_CODE],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-            text=True,
-            timeout=timeout,
-        )
-    except subprocess.TimeoutExpired:
-        return None
-    if proc.returncode != 0:
-        return None
-    return _last_json_line(proc.stdout)
+    """Tiny matmul in a subprocess. Returns device info dict or None.
+
+    If the default (possibly tunneled-accelerator) backend hangs or dies —
+    the wedged-tunnel failure mode — retries once with the CPU platform
+    forced: a clearly-tagged CPU smoke record beats a zeroed round. An
+    explicit user DALLE_TPU_FORCE_PLATFORM is respected and never
+    overridden (one attempt, their platform).
+    """
+    attempts = (
+        (False,) if os.environ.get("DALLE_TPU_FORCE_PLATFORM") else (False, True)
+    )
+    for force_cpu in attempts:
+        env = dict(os.environ)
+        if force_cpu:
+            env["DALLE_TPU_FORCE_PLATFORM"] = "cpu"
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", PROBE_CODE],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                timeout=timeout,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            continue
+        if proc.returncode != 0:
+            continue
+        info = _last_json_line(proc.stdout)
+        if info is not None:
+            if force_cpu:
+                info["forced_cpu"] = True
+            return info
+    return None
 
 
 def emit_failure(metric: str, unit: str, error: str) -> None:
@@ -145,12 +165,17 @@ def run_guarded(
         emit_failure(
             metric,
             unit,
-            "device probe failed: accelerator backend unavailable or wedged "
-            "(timed small matmul did not complete in 90s)",
+            "device probe failed (90s cap per attempt; a forced-CPU retry "
+            "also runs unless DALLE_TPU_FORCE_PLATFORM was set explicitly) "
+            "— if even the CPU attempt failed, JAX itself is unusable here "
+            "(broken install / import error), not just the accelerator",
         )
         return
 
     base_env = dict(os.environ)
+    if info.get("forced_cpu"):
+        # the accelerator backend is wedged; children must skip it too
+        base_env["DALLE_TPU_FORCE_PLATFORM"] = "cpu"
     if info.get("platform") == "cpu":
         for k, v in (cpu_env_defaults or {}).items():
             base_env.setdefault(k, v)

@@ -672,17 +672,22 @@ def generate_texts(
     filter_thres: float = 0.5,
     temperature: float = 1.0,
 ):
-    """Jit-cached wrapper over autoregressive text completion."""
-    static_key = (filter_thres, temperature, prefix_len)
+    """Jit-cached wrapper over autoregressive text completion.
+
+    `prefix_len` is passed as a traced argument (it only feeds an `i <
+    prefix_len` comparison), so varying prompt lengths reuse one compile.
+    """
+    static_key = (filter_thres, temperature)
     return _jit_sample(
-        _text_sampler_builder, model, static_key, variables, rng, text_prefix
+        _text_sampler_builder, model, static_key,
+        variables, rng, text_prefix, jnp.int32(prefix_len),
     )
 
 
 def _text_sampler_builder(model, key):
-    filter_thres, temperature, prefix_len = key
+    filter_thres, temperature = key
 
-    def fn(variables, rng, text_prefix):
+    def fn(variables, rng, text_prefix, prefix_len):
         return _generate_texts_impl(
             model, variables, rng, text_prefix, prefix_len,
             filter_thres=filter_thres, temperature=temperature,

@@ -7,7 +7,8 @@ cd "$(dirname "$0")/.."
 PROBE='import jax, jax.numpy as jnp; x = jnp.ones((8,8)) @ jnp.ones((8,8)); print("PROBE_OK", float(x.sum()))'
 
 echo "[watchdog] started $(date -u +%H:%M:%S)"
-while true; do
+DEADLINE=$(( $(date +%s) + ${WATCHDOG_MAX_S:-18000} ))  # stop polling after 5h
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
         echo "[watchdog] tunnel recovered at $(date -u +%H:%M:%S); running matrix"
         bash scripts/run_tpu_experiments.sh TPU_RESULTS.jsonl
@@ -17,3 +18,4 @@ while true; do
     echo "[watchdog] $(date -u +%H:%M:%S) tunnel still down"
     sleep 240
 done
+echo "[watchdog] giving up at $(date -u +%H:%M:%S) (deadline reached)"
