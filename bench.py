@@ -19,39 +19,17 @@ METRIC = "dalle_train_image_tokens_per_sec_per_chip"
 UNIT = "img-tok/s/chip"
 
 
-# published bf16 peak FLOP/s per chip
-PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5": 459e12,  # v5p
-    "v6": 918e12,
-    "cpu": 5e11,  # nominal, so CPU runs still report something
-}
+# FLOPs/peak accounting lives in dalle_pytorch_tpu.utils.flops; imported
+# lazily so the guard parent process stays light (no jax/flax import
+# before forking the child).
 
 
 def peak_flops_per_chip() -> float:
     import jax
 
-    kind = jax.devices()[0].device_kind.lower()
-    for key, val in PEAK_FLOPS.items():
-        if key in kind:
-            return val
-    return 197e12
+    from dalle_pytorch_tpu.utils.flops import peak_flops_per_chip as _peak
 
-
-def transformer_train_flops(dim, depth, heads, dim_head, seq, ff_mult=4) -> float:
-    """Analytic fwd+bwd matmul FLOPs per sample for one step."""
-    inner = heads * dim_head
-    per_layer = (
-        2 * seq * dim * 3 * inner          # qkv proj
-        + 2 * seq * seq * inner * 2        # qk^T and attn@v
-        + 2 * seq * inner * dim            # out proj
-        + 2 * seq * dim * dim * ff_mult * 2  # ff up (GEGLU: 2x width)
-        + 2 * seq * dim * ff_mult * dim    # ff down
-    )
-    fwd = depth * per_layer
-    return 3 * fwd  # fwd + 2x bwd
+    return _peak(jax.devices()[0].device_kind)
 
 
 def main():
@@ -65,6 +43,7 @@ def main():
 
     from dalle_pytorch_tpu.models.dalle import DALLE
     from dalle_pytorch_tpu.training import TrainState, make_optimizer, make_dalle_train_step
+    from dalle_pytorch_tpu.utils.flops import transformer_train_flops
 
     # BASELINE.json ladder config: DALLE dim=1024 depth=12 with OpenAI-dVAE
     # geometry (f/8: 32x32 = 1024 image tokens, seq 1280). Env overrides for

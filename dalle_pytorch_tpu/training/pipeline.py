@@ -230,6 +230,16 @@ def dalle_from_config(
                 "model.stable_softmax; its streaming accumulator is already "
                 "max-subtracted"
             )
+        sp = sp_mesh.shape["sp"]
+        # transformer sequence = bos-padded text truncated back to
+        # text_seq_len, plus the image grid (models/dalle.py __call__)
+        total_seq = m.text_seq_len + image_fmap_size**2
+        if total_seq % sp:
+            raise ValueError(
+                f"sequence length {total_seq} (text_seq_len {m.text_seq_len} "
+                f"+ {image_fmap_size}^2 image tokens) must be divisible by "
+                f"mesh.sp={sp} for ring attention; adjust text_seq_len"
+            )
     else:
         if attn_impl == "ring":
             raise ValueError(

@@ -1,0 +1,59 @@
+"""Analytic FLOPs model for throughput/MFU accounting.
+
+Counts matmul FLOPs only (the MXU-relevant work) for the DALLE
+transformer; elementwise/softmax/embedding work is excluded by
+convention, matching how MFU is normally quoted. Used by `bench.py` and
+the trainer's live MFU log (the reference logs only `sample_per_sec`,
+`/root/reference/train_dalle.py:578-581`).
+"""
+
+from __future__ import annotations
+
+# published bf16 peak FLOP/s per chip, keyed by substrings of
+# jax.Device.device_kind (lowercased)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5": 459e12,  # v5p
+    "v6": 918e12,
+    "cpu": 5e11,  # nominal, so CPU smoke runs still report something
+}
+
+
+def peak_flops_per_chip(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def transformer_train_flops(
+    dim: int, depth: int, heads: int, dim_head: int, seq: int, ff_mult: int = 4
+) -> float:
+    """Matmul FLOPs per sample for one fwd+bwd training step."""
+    inner = heads * dim_head
+    per_layer = (
+        2 * seq * dim * 3 * inner            # qkv proj
+        + 2 * seq * seq * inner * 2          # qk^T and attn@v
+        + 2 * seq * inner * dim              # out proj
+        + 2 * seq * dim * dim * ff_mult * 2  # ff up (GEGLU: 2x width)
+        + 2 * seq * dim * ff_mult * dim      # ff down
+    )
+    fwd = depth * per_layer
+    return 3 * fwd  # fwd + 2x bwd
+
+
+def dalle_train_flops_per_sample(model) -> float:
+    """FLOPs/sample for a DALLE model instance (forward objective)."""
+    return transformer_train_flops(
+        model.dim, model.depth, model.heads, model.dim_head, model.total_seq_len
+    )
+
+
+def mfu(samples_per_sec: float, flops_per_sample: float, device_kind: str,
+        n_chips: int = 1) -> float:
+    return samples_per_sec * flops_per_sample / (
+        peak_flops_per_chip(device_kind) * n_chips
+    )

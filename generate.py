@@ -94,6 +94,7 @@ def main():
 
     from PIL import Image
 
+    dvae_decode = None
     clip = clip_params = None
     if args.clip_path:
         from dalle_pytorch_tpu.training.pipeline import load_clip_checkpoint
@@ -132,9 +133,13 @@ def main():
                 cond_scale=args.cond_scale,
             )
             if isinstance(vae, DiscreteVAE):
-                imgs = vae.apply(
-                    {"params": vae_params}, toks, method=DiscreteVAE.decode
-                )
+                if dvae_decode is None:
+                    # jit once: eager decode dispatches per-op (slow on
+                    # remote backends); shapes are fixed across chunks
+                    dvae_decode = jax.jit(
+                        lambda p, t: vae.apply({"params": p}, t, method=DiscreteVAE.decode)
+                    )
+                imgs = dvae_decode(vae_params, toks)
                 images.append(np.asarray(imgs) * 0.5 + 0.5)  # un-normalize
             else:  # pretrained wrappers decode to [0,1] already
                 images.append(np.asarray(vae.decode(toks)))

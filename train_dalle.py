@@ -216,6 +216,12 @@ def main():
         project=cfg.wandb_name, config={"cli": "train_dalle"},
         enabled=is_root(), debug=cfg.debug, out_dir=str(run_dir / "logs"),
     )
+    from dalle_pytorch_tpu.utils.flops import (
+        dalle_train_flops_per_sample, mfu as flops_mfu,
+    )
+
+    flops_per_sample = dalle_train_flops_per_sample(model)
+    dvae_decode = None  # lazily-jitted sample decode
     meter = ThroughputMeter()
     profiler = ProfilerHook(cfg.flops_profiler)
     plateau = ReduceLROnPlateau() if cfg.lr_decay else None
@@ -356,9 +362,12 @@ def main():
                         gr, jnp.asarray(dev_batch["text"][:1]), filter_thres=0.9,
                     )
                     if isinstance(vae, DiscreteVAE):
-                        image = np.asarray(vae.apply(
-                            {"params": vae_params}, toks, method=DiscreteVAE.decode
-                        )) * 0.5 + 0.5  # dVAE decodes to [-1, 1]
+                        if dvae_decode is None:
+                            dvae_decode = jax.jit(lambda p, t: vae.apply(
+                                {"params": p}, t, method=DiscreteVAE.decode))
+                        image = np.asarray(
+                            dvae_decode(vae_params, toks)
+                        ) * 0.5 + 0.5  # dVAE decodes to [-1, 1]
                     else:  # pretrained wrappers decode straight to [0, 1]
                         image = np.asarray(vae.decode(toks))
                     caption = (captions or [None])[0] or tokenizer.decode(
@@ -372,6 +381,14 @@ def main():
                     # input-boundedness: share of wall time blocked on the host
                     # pipeline (~0 = fully overlapped)
                     log["input_wait_frac"] = round(batch_iter.wait_fraction, 4)
+                    # live MFU vs this chip's bf16 peak (reference logs
+                    # only sample_per_sec)
+                    # rate is PER-PROCESS samples/s (each host iterates its
+                    # own data shard), so normalize by the local chip count
+                    log["mfu"] = round(
+                        flops_mfu(rate, flops_per_sample,
+                                  jax.devices()[0].device_kind,
+                                  jax.local_device_count()), 4)
                     print(epoch, global_step, f"sample_per_sec - {rate:.2f}")
                 if log:
                     logger.log(log, step=global_step)
