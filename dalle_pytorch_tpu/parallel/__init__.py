@@ -7,6 +7,7 @@ from dalle_pytorch_tpu.parallel.mesh import (
     host_barrier,
     batch_spec,
     batch_sharding,
+    put_host_batch,
 )
 from dalle_pytorch_tpu.parallel.partition import (
     param_partition_spec,

@@ -130,3 +130,17 @@ def batch_spec(extra_dims: int = 1) -> P:
 
 def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
     return NamedSharding(mesh, batch_spec(extra_dims))
+
+
+def put_host_batch(x, sharding: NamedSharding):
+    """Device-put a HOST-LOCAL batch shard under a global batch sharding.
+
+    Single-process: plain device_put. Multi-host: each process holds only
+    its own data shard (`host_shard_order`), and `jax.device_put` requires
+    the same global value everywhere — the correct assembly is
+    `make_array_from_process_local_data`, which treats `x` as this
+    process's addressable rows of the [global_batch, ...] array.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))

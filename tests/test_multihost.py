@@ -1,0 +1,124 @@
+"""REAL multi-process distributed training test (CPU, 2 processes).
+
+Round-3 addition: everything multi-host used to be validated only inside
+one process (virtual-device meshes). This launches TWO actual processes
+through `launch.py`, rendezvouses them with `jax.distributed` (Gloo), and
+runs the sharded DALLE train step across both — catching the class of bug
+that only appears with process_count() > 1 (e.g. the device_put-of-local-
+shards bug fixed by `put_host_batch`, parallel/mesh.py).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dalle_pytorch_tpu.parallel import initialize_distributed
+initialize_distributed()
+import numpy as np
+import jax.numpy as jnp
+from dalle_pytorch_tpu.data.loader import host_shard_order
+from dalle_pytorch_tpu.parallel import (
+    make_mesh, batch_sharding, state_shardings, put_host_batch,
+)
+from dalle_pytorch_tpu.models.dalle import DALLE
+from dalle_pytorch_tpu.training import (
+    TrainState, make_optimizer, make_dalle_train_step,
+)
+
+rank, nproc = jax.process_index(), jax.process_count()
+assert nproc == 2, f"expected 2 processes, got {nproc}"
+assert jax.device_count() == 2, jax.device_count()
+
+# disjoint host data shards
+order = host_shard_order(np.arange(8), (rank, nproc))
+assert len(order) == 4 and set(order) <= set(range(8))
+
+mesh = make_mesh(dp=-1)  # dp=2 across the two processes
+model = DALLE(dim=32, depth=1, heads=2, dim_head=16, num_image_tokens=32,
+              image_fmap_size=4, num_text_tokens=64, text_seq_len=8)
+t0 = jnp.zeros((1, 8), jnp.int32); i0 = jnp.zeros((1, 16), jnp.int32)
+params = model.init(jax.random.PRNGKey(0), t0, i0)["params"]
+state = TrainState.create(apply_fn=model.apply, params=params,
+                          tx=make_optimizer(1e-3))
+state_sh = state_shardings(state, mesh)
+txt_sh = batch_sharding(mesh, extra_dims=1)
+state = jax.device_put(state, state_sh)
+step = jax.jit(
+    make_dalle_train_step(model),
+    in_shardings=(state_sh, {"text": txt_sh, "image_tokens": txt_sh}, None),
+    out_shardings=(state_sh, None),
+    donate_argnums=0,
+)
+# each process contributes ITS OWN local rows; put_host_batch assembles
+# the global [4, ...] batch
+local_text = np.full((2, 8), rank + 1, np.int32)
+local_tok = np.full((2, 16), rank, np.int32)
+batch = {"text": put_host_batch(local_text, txt_sh),
+         "image_tokens": put_host_batch(local_tok, txt_sh)}
+assert batch["text"].shape == (4, 8), batch["text"].shape
+for _ in range(2):
+    state, metrics = step(state, batch, jax.random.PRNGKey(1))
+loss = float(metrics["loss"])
+assert np.isfinite(loss)
+print(f"MULTIHOST_OK rank={rank} loss={loss:.6f}", flush=True)
+"""
+
+
+@pytest.mark.slow
+class TestTwoProcessTraining:
+    def test_sharded_step_across_two_processes(self, tmp_path):
+        import socket
+
+        worker = tmp_path / "worker.py"
+        worker.write_text(WORKER)
+        # free rendezvous port: a hardcoded one collides with a leaked
+        # worker from a previous failed run
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        procs = []
+        try:
+            for rank in range(2):
+                env = dict(os.environ)
+                env["PYTHONPATH"] = str(REPO)
+                env.pop("DALLE_TPU_DIST", None)
+                # one device per process (conftest's 8-virtual-device
+                # XLA_FLAGS would otherwise give a 16-device global mesh)
+                env.pop("XLA_FLAGS", None)
+                procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable, str(REPO / "launch.py"),
+                            "--coordinator", f"127.0.0.1:{port}",
+                            "--num-hosts", "2", "--host-id", str(rank),
+                            "--", str(worker),
+                        ],
+                        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                        text=True, env=env,
+                    )
+                )
+            outs = []
+            for p in procs:
+                out, err = p.communicate(timeout=240)
+                assert p.returncode == 0, f"rank failed:\n{err[-2000:]}"
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=10)
+        losses = set()
+        for out in outs:
+            line = [l for l in out.splitlines() if "MULTIHOST_OK" in l]
+            assert line, out
+            losses.add(line[0].split("loss=")[1])
+        # gradient psum makes every process see the identical loss
+        assert len(losses) == 1, f"losses diverged across hosts: {losses}"

@@ -61,6 +61,7 @@ def main():
     from dalle_pytorch_tpu.models.dvae import DiscreteVAE
     from dalle_pytorch_tpu.parallel import (
         make_mesh, batch_sharding, state_shardings, partition_params, is_root,
+        put_host_batch,
     )
     from dalle_pytorch_tpu.parallel import initialize_distributed
 
@@ -273,23 +274,27 @@ def main():
             (device_batch, captions) — captions ride separately because the
             device batch's pytree must match the step's in_shardings."""
             caps = batch.get("captions")
+            # host-local head row for root-only sample logging: the global
+            # dev batch spans non-addressable devices on multi-host, so it
+            # cannot be fetched there
+            text_head = np.asarray(batch["text"][:1])
             if in_step_encode:
                 dev = {
-                    "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
-                    "images": jax.device_put(
-                        jnp.asarray(batch["images"]), batch_shardings["images"]
+                    "text": put_host_batch(batch["text"], txt_sh),
+                    "images": put_host_batch(
+                        batch["images"], batch_shardings["images"]
                     ),
                 }
             else:
                 if "image_tokens" in batch:  # precomputed (TokenDataset)
-                    tokens = jnp.asarray(batch["image_tokens"])
+                    tokens = batch["image_tokens"]
                 else:  # pretrained torch-backed VAE: host-side encode
                     tokens = vae.get_codebook_indices(jnp.asarray(batch["images"]))
                 dev = {
-                    "text": jax.device_put(jnp.asarray(batch["text"]), txt_sh),
-                    "image_tokens": jax.device_put(tokens, txt_sh),
+                    "text": put_host_batch(batch["text"], txt_sh),
+                    "image_tokens": put_host_batch(tokens, txt_sh),
                 }
-            return dev, caps
+            return dev, caps, text_head
 
         batch_iter = Prefetcher(
             dataset.batches(
@@ -308,7 +313,7 @@ def main():
             if orbax_resume_meta.get("last_loss") is not None:
                 last_loss = float(orbax_resume_meta["last_loss"])
         try:
-            for dev_batch, captions in batch_iter:
+            for dev_batch, captions, text_head in batch_iter:
                 profiler.before_step(global_step)
                 # fold_in(global_step), not sequential split: the key stream
                 # is a pure function of the step index, so a mid-epoch
@@ -359,7 +364,7 @@ def main():
                     gr = jax.random.fold_in(jax.random.fold_in(rng, global_step), 1)
                     toks = generate_images(
                         model, {"params": state.params},
-                        gr, jnp.asarray(dev_batch["text"][:1]), filter_thres=0.9,
+                        gr, jnp.asarray(text_head), filter_thres=0.9,
                     )
                     if isinstance(vae, DiscreteVAE):
                         if dvae_decode is None:
@@ -371,7 +376,7 @@ def main():
                     else:  # pretrained wrappers decode straight to [0, 1]
                         image = np.asarray(vae.decode(toks))
                     caption = (captions or [None])[0] or tokenizer.decode(
-                        np.asarray(dev_batch["text"][0])
+                        text_head[0]
                     )
                     logger.log_images(image, caption, "image", global_step)
 

@@ -48,7 +48,9 @@ def main():
         jax.config.update("jax_platforms", _os.environ["DALLE_TPU_FORCE_PLATFORM"])
     import jax.numpy as jnp
 
-    from dalle_pytorch_tpu.parallel import make_mesh, batch_sharding, state_shardings, is_root
+    from dalle_pytorch_tpu.parallel import (
+        make_mesh, batch_sharding, state_shardings, is_root, put_host_batch,
+    )
     from dalle_pytorch_tpu.parallel import initialize_distributed
 
     # multi-host rendezvous (launch.py env vars / TPU pod auto); no-op
@@ -115,27 +117,34 @@ def main():
     for epoch in range(cfg.epochs):
         # background batch assembly + device transfer ahead of the step
         # (same input/compute overlap as train_dalle.py)
+        def assemble(b):
+            # (device_batch, host-local head) — recon-grid logging must not
+            # fetch the global array (non-addressable on multi-host)
+            return put_host_batch(b["images"], img_sh), np.asarray(b["images"][:4])
+
         batch_iter = Prefetcher(
             dataset.batches(cfg.batch_size, shuffle_seed=epoch, shard=shard),
-            transform=lambda b: jax.device_put(jnp.asarray(b["images"]), img_sh),
+            transform=assemble,
             depth=cfg.prefetch_depth,
         )
         try:
-            for images in batch_iter:
+            for images, images_head in batch_iter:
                 rng, r = jax.random.split(rng)
                 state, metrics = step_fn(state, images, r, jnp.float32(temp))
                 global_step += 1
 
                 log = {}
                 if global_step % 100 == 0:
-                    # recon grids: soft (gumbel) + hard (argmax->decode)
-                    k = min(4, images.shape[0])
+                    # recon grids: soft (gumbel) + hard (argmax->decode),
+                    # computed from the host-local head rows
+                    k = images_head.shape[0]
+                    head = jnp.asarray(images_head)
                     soft = vae.apply(
-                        {"params": state.params}, images[:k], temp=temp,
+                        {"params": state.params}, head, temp=temp,
                         rngs={"gumbel": r},
                     )
                     codes = vae.apply(
-                        {"params": state.params}, images[:k],
+                        {"params": state.params}, head,
                         method=type(vae).get_codebook_indices,
                     )
                     hard = vae.apply({"params": state.params}, codes, method=type(vae).decode)
@@ -144,7 +153,7 @@ def main():
                         np.asarray(codes).ravel(), minlength=cfg.vae.num_tokens
                     )
                     grid = np.concatenate(
-                        [np.asarray(images[:k]), np.asarray(soft) * 0.5 + 0.5,
+                        [images_head, np.asarray(soft) * 0.5 + 0.5,
                          np.asarray(hard) * 0.5 + 0.5], axis=0
                     )
                     logger.log_images(grid, "orig | soft | hard", "recons", global_step)
