@@ -8,6 +8,7 @@ from dalle_pytorch_tpu.parallel.mesh import (
     batch_spec,
     batch_sharding,
     put_host_batch,
+    gather_to_host,
 )
 from dalle_pytorch_tpu.parallel.partition import (
     param_partition_spec,

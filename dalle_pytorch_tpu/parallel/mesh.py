@@ -132,6 +132,29 @@ def batch_sharding(mesh: Mesh, extra_dims: int = 1) -> NamedSharding:
     return NamedSharding(mesh, batch_spec(extra_dims))
 
 
+def gather_to_host(tree):
+    """Fetch a (possibly cross-host-sharded) pytree to host numpy arrays.
+
+    Single-process: device_get. Multi-host: leaves that span
+    non-addressable devices (fsdp/tp across hosts) are allgathered first —
+    a COLLECTIVE, so every process must call this (root-gate the
+    subsequent save, not the gather). Returns the full global value on
+    every host.
+    """
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    def one(x):
+        if getattr(x, "is_fully_replicated", False):
+            return jax.device_get(x)  # local replica is the global value
+        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return jax.device_get(x)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 def put_host_batch(x, sharding: NamedSharding):
     """Device-put a HOST-LOCAL batch shard under a global batch sharding.
 

@@ -68,6 +68,16 @@ for _ in range(2):
     state, metrics = step(state, batch, jax.random.PRNGKey(1))
 loss = float(metrics["loss"])
 assert np.isfinite(loss)
+
+# cross-host FSDP sharding + collective checkpoint gather: parameters are
+# sharded ACROSS the two processes, so exporting must allgather first
+from dalle_pytorch_tpu.parallel import gather_to_host
+fsdp_mesh = make_mesh(dp=1, fsdp=2)
+params_f = jax.device_put(state.params, state_shardings(state, fsdp_mesh).params)
+gathered = gather_to_host(params_f)
+for a, b in zip(jax.tree_util.tree_leaves(gathered),
+                jax.tree_util.tree_leaves(gather_to_host(state.params))):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
 print(f"MULTIHOST_OK rank={rank} loss={loss:.6f}", flush=True)
 """
 

@@ -61,7 +61,7 @@ def main():
     from dalle_pytorch_tpu.models.dvae import DiscreteVAE
     from dalle_pytorch_tpu.parallel import (
         make_mesh, batch_sharding, state_shardings, partition_params, is_root,
-        put_host_batch,
+        put_host_batch, gather_to_host,
     )
     from dalle_pytorch_tpu.parallel import initialize_distributed
 
@@ -233,13 +233,18 @@ def main():
     from dalle_pytorch_tpu.training.pipeline import dvae_hparams
 
     def export(path: Path, epoch: int):
+        # gather_to_host is a COLLECTIVE when params/opt are sharded
+        # across hosts (fsdp/tp) — every process runs it; only root writes
+        params_h = gather_to_host(state.params)
+        vae_h = None if not in_step_encode else gather_to_host(vae_params)
+        opt_h = gather_to_host(state.opt_state)
         if is_root():
             save_dalle_checkpoint(
-                str(path), cfg, jax.device_get(state.params),
-                None if not in_step_encode else jax.device_get(vae_params),
+                str(path), cfg, params_h,
+                vae_h,
                 epoch, type(vae).__name__,
                 vae_hparams=dvae_hparams(vae) if in_step_encode else None,
-                opt_state=jax.device_get(state.opt_state),
+                opt_state=opt_h,
                 train_meta={
                     "global_step": global_step,
                     "plateau": plateau.state_dict() if plateau else None,
@@ -342,8 +347,12 @@ def main():
                     print(epoch, global_step, f"loss - {step_loss:.5f}")
 
                 if global_step % cfg.save_every_n_steps == 0:
+                    # pass the sharded state directly: Orbax handles
+                    # cross-host-sharded arrays natively (and copies to
+                    # host before its async write), where device_get would
+                    # raise on non-addressable fsdp/tp shards
                     ckpt.save(
-                        global_step, jax.device_get(state),
+                        global_step, state,
                         metadata={
                             "epoch": epoch, "step": global_step,
                             "epoch_batch": epoch_batch,
@@ -355,8 +364,10 @@ def main():
                         },
                     )
 
-                if cfg.log_images_freq and global_step % cfg.log_images_freq == 0 \
-                        and is_root():
+                # ALL processes run the sampling computation (it is an
+                # SPMD program over the sharded params); only the logger
+                # (enabled on root) writes the image
+                if cfg.log_images_freq and global_step % cfg.log_images_freq == 0:
                     # in-loop sample generation in EVERY configuration —
                     # trainable dVAE, precomputed tokens, VQGAN/OpenAI — like
                     # the reference (`train_dalle.py:564-576`)

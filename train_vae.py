@@ -50,6 +50,7 @@ def main():
 
     from dalle_pytorch_tpu.parallel import (
         make_mesh, batch_sharding, state_shardings, is_root, put_host_batch,
+        gather_to_host,
     )
     from dalle_pytorch_tpu.parallel import initialize_distributed
 
@@ -111,6 +112,7 @@ def main():
 
     temp = cfg.vae.temperature
     global_step = 0
+    last_params_h = None
     shard = (jax.process_index(), jax.process_count())
     from dalle_pytorch_tpu.data.prefetch import Prefetcher
 
@@ -184,14 +186,17 @@ def main():
         finally:
             batch_iter.close()
 
+        last_params_h = gather_to_host(state.params)  # collective; all hosts
         if is_root():
-            save_vae_checkpoint(args.output, vae, jax.device_get(state.params), epoch)
+            save_vae_checkpoint(args.output, vae, last_params_h, epoch)
             print(f"epoch {epoch} done; checkpoint -> {args.output}")
             # per-epoch model artifact (`train_vae.py:305-310`)
             logger.log_model_artifact(args.output, "trained-vae")
 
+    if last_params_h is None:  # epochs == 0: the loop never gathered
+        last_params_h = gather_to_host(state.params)
     if is_root():
-        save_vae_checkpoint(args.output, vae, jax.device_get(state.params), cfg.epochs)
+        save_vae_checkpoint(args.output, vae, last_params_h, cfg.epochs)
     logger.finish()
 
 
