@@ -78,6 +78,21 @@ gathered = gather_to_host(params_f)
 for a, b in zip(jax.tree_util.tree_leaves(gathered),
                 jax.tree_util.tree_leaves(gather_to_host(state.params))):
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+
+# Orbax save/restore of the SHARDED state across both processes (pod
+# preemption-resume): every process participates in save and restore
+import os as _os
+from dalle_pytorch_tpu.training.checkpoint import CheckpointManager
+ckpt_dir = _os.environ["MULTIHOST_CKPT_DIR"]
+mgr = CheckpointManager(ckpt_dir, keep_n=1)
+mgr.save(7, state, metadata={"probe": rank == rank})
+mgr.wait()
+restored, meta, step_no = mgr.restore(state)
+assert step_no == 7 and restored is not None
+for a, b in zip(jax.tree_util.tree_leaves(gather_to_host(restored.params)),
+                jax.tree_util.tree_leaves(gather_to_host(state.params))):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0)
+mgr.close()
 print(f"MULTIHOST_OK rank={rank} loss={loss:.6f}", flush=True)
 """
 
@@ -99,6 +114,7 @@ class TestTwoProcessTraining:
             for rank in range(2):
                 env = dict(os.environ)
                 env["PYTHONPATH"] = str(REPO)
+                env["MULTIHOST_CKPT_DIR"] = str(tmp_path / "ckpt")
                 env.pop("DALLE_TPU_DIST", None)
                 # one device per process (conftest's 8-virtual-device
                 # XLA_FLAGS would otherwise give a 16-device global mesh)
