@@ -12,6 +12,7 @@ chip count).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -33,8 +34,6 @@ def peak_flops_per_chip() -> float:
 
 
 def main():
-    import os
-
     import jax
 
     if os.environ.get("DALLE_TPU_FORCE_PLATFORM"):
@@ -251,29 +250,27 @@ if __name__ == "__main__":
         # Pallas parity/timing, and component probes (VERDICT r3 items
         # that need real hardware) to a file the round snapshot commits.
         # Disable with BENCH_NO_EXTRA=1. stdout stays one JSON line.
-        import os as _os
-
         on_tpu = bool(
             result
             and result.get("ok")
             and not result.get("fallback")
             and "tpu" in str(result.get("device", "")).lower()
         )
-        if on_tpu and _os.environ.get("BENCH_NO_EXTRA") != "1":
-            here = _os.path.dirname(_os.path.abspath(__file__))
-            out = _os.path.join(here, "EXTRA_RESULTS.jsonl")
+        if on_tpu and os.environ.get("BENCH_NO_EXTRA") != "1":
+            here = os.path.dirname(os.path.abspath(__file__))
+            out = os.path.join(here, "EXTRA_RESULTS.jsonl")
             py = sys.executable
             # one combined wall budget for all extras so total bench.py
             # runtime stays bounded (main 1800s + probe 90s + this)
             extras_deadline = time.monotonic() + float(
-                _os.environ.get("BENCH_EXTRA_BUDGET", "1500")
+                os.environ.get("BENCH_EXTRA_BUDGET", "1500")
             )
             for label, cmd in (
-                ("generate_p50", [py, _os.path.join(here, "bench_generate.py")]),
+                ("generate_p50", [py, os.path.join(here, "bench_generate.py")]),
                 ("pallas_onchip",
-                 [py, _os.path.join(here, "scripts", "pallas_onchip.py")]),
+                 [py, os.path.join(here, "scripts", "pallas_onchip.py")]),
                 ("perf_probe",
-                 [py, _os.path.join(here, "scripts", "perf_probe.py"),
+                 [py, os.path.join(here, "scripts", "perf_probe.py"),
                   "peak", "attn", "ff", "logits"]),
             ):
                 left = extras_deadline - time.monotonic()

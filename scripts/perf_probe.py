@@ -188,14 +188,9 @@ def main():
         )
 
     def flagship_flops(b):
-        per_layer = (
-            2 * seq * dim * 3 * inner
-            + 2 * seq * seq * inner * 2
-            + 2 * seq * inner * dim
-            + 2 * seq * dim * dim * 4 * 2
-            + 2 * seq * dim * 4 * dim
-        )
-        return 3 * depth * per_layer * b
+        from dalle_pytorch_tpu.utils.flops import transformer_train_flops
+
+        return transformer_train_flops(dim, depth, heads, dim_head, seq) * b
 
     if want("step") or want("step_noremat") or want("fwd"):
         from dalle_pytorch_tpu.models.dalle import DALLE
