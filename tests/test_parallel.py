@@ -189,3 +189,48 @@ class TestRingInModel:
         toks = jnp.zeros((1, 16), jnp.int32)
         with pytest.raises(AssertionError, match="sp_mesh"):
             model.init(jax.random.PRNGKey(0), text, toks)
+
+
+@pytest.mark.slow
+class TestLongContextRing:
+    """Long-context claim with substance: ring attention at seq 4096
+    (4x the flagship's 1280) sharded over all 8 virtual devices, parity
+    vs the dense oracle AND through a DALLE gradient step."""
+
+    def test_seq4096_parity(self):
+        mesh = make_mesh(dp=1, sp=8)
+        b, h, n, d = 1, 2, 4096, 32
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q, k, v = (jax.random.normal(kk, (b, h, n, d)) * 0.5 for kk in ks)
+        out_ring = ring_attention_sharded(mesh, q, k, v, causal=True)
+        causal = jnp.tril(jnp.ones((n, n), bool))[None, None]
+        out_dense = dense_attention(q, k, v, mask=causal)
+        np.testing.assert_allclose(
+            np.asarray(out_ring), np.asarray(out_dense), rtol=2e-3, atol=2e-4
+        )
+
+    def test_long_seq_train_step_grads_finite(self):
+        from dalle_pytorch_tpu.models.dalle import DALLE
+        from dalle_pytorch_tpu.training import (
+            TrainState, make_optimizer, make_dalle_train_step,
+        )
+
+        mesh = make_mesh(dp=1, sp=8)
+        # text 1024 + 32x32 image grid = seq 2048 over 8 sp shards
+        model = DALLE(
+            dim=64, depth=2, heads=4, dim_head=16, num_image_tokens=64,
+            image_fmap_size=32, num_text_tokens=64, text_seq_len=1024,
+            shift_tokens=True, rotary_emb=True,
+            attn_impl="ring", sp_mesh=mesh,
+        )
+        text = jnp.ones((1, 1024), jnp.int32)
+        tokens = jnp.zeros((1, 1024), jnp.int32)
+        params = jax.jit(model.init)(jax.random.PRNGKey(0), text, tokens)["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params, tx=make_optimizer(1e-3)
+        )
+        step = jax.jit(make_dalle_train_step(model))
+        state, metrics = step(
+            state, {"text": text, "image_tokens": tokens}, jax.random.PRNGKey(1)
+        )
+        assert np.isfinite(float(metrics["loss"]))
