@@ -303,10 +303,24 @@ class TestAttnImplCli:
             "--vae_path", str(vae_path),
             "--epochs", "1", "--batch_size", "8",
             "--set", "mesh.dp=4", "--set", "mesh.sp=2",
+            # explicit ring (not auto): the checkpoint then carries
+            # attn_impl="ring", exercising generate.py's downgrade
+            "--set", "model.attn_impl=ring",
             "--set", "model.dim=64", "--set", "model.depth=1",
             "--set", "model.heads=2", "--set", "model.dim_head=16",
             "--set", "model.text_seq_len=16", "--set", "bf16=false",
             "--set", "log_images_freq=0", "--set", "debug=true",
             cwd=tmp_path,
         )
-        assert (tmp_path / "checkpoints" / "dalle.npz").exists()
+        ckpt = tmp_path / "checkpoints" / "dalle.npz"
+        assert ckpt.exists()
+
+        # generation from the ring-trained checkpoint: decode must
+        # downgrade ring->auto (KV-cached decode never runs ring)
+        run_cli(
+            "generate.py", "--dalle_path", str(ckpt),
+            "--text", "small red circle", "--num_images", "2",
+            "--batch_size", "2",
+            "--outputs_dir", str(tmp_path / "ring_out"), cwd=tmp_path,
+        )
+        assert list((tmp_path / "ring_out").rglob("grid.png"))
