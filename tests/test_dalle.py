@@ -381,3 +381,40 @@ class TestFusedCE:
         gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
         want = logz - gold
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
+class TestCombinedPerfFeatures:
+    """The bench's fastest profile stacks flash attention + selective remat
+    + fused CE; their composition must agree with the plain model."""
+
+    def test_flash_policy_fusedce_matches_baseline(self):
+        kw = dict(
+            dim=32, depth=2, heads=2, dim_head=16, num_image_tokens=48,
+            image_fmap_size=4, num_text_tokens=60, text_seq_len=12,
+            shift_tokens=True, rotary_emb=True,
+        )
+        base = DALLE(**kw)
+        fast = DALLE(
+            attn_impl="flash", reversible=True, reversible_impl="remat",
+            remat_policy="dots_with_no_batch_dims_saveable", fused_ce=True,
+            **kw,
+        )
+        rng = jax.random.PRNGKey(0)
+        text = jax.random.randint(rng, (2, 12), 1, 60)
+        image = jax.random.randint(rng, (2, 16), 0, 48)
+        params = base.init(rng, text, image)["params"]
+
+        def loss(model, p):
+            l, _ = model.apply({"params": p}, text, image, return_loss=True)
+            return l
+
+        l_base = float(loss(base, params))
+        l_fast = float(loss(fast, params))
+        np.testing.assert_allclose(l_base, l_fast, rtol=5e-3)
+
+        g_base = jax.grad(lambda p: loss(base, p))(params)
+        g_fast = jax.grad(lambda p: loss(fast, p))(params)
+        for a, b in zip(jax.tree.leaves(g_base), jax.tree.leaves(g_fast)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-3,
+            )
