@@ -37,10 +37,13 @@ from dalle_pytorch_tpu.ops.attention_core import dense_attention
 from dalle_pytorch_tpu.ops.pallas_attention import flash_attention
 from dalle_pytorch_tpu.ops.rotary import apply_rotary
 
-# sequence length at or above which `attn_impl="auto"` switches from the
-# fused dense einsum (fastest at DALL-E lengths, measured on v5e) to the
-# Pallas flash kernel (O(N) memory; 2x faster by N=4096, and dense OOMs
-# 16G HBM at N=8192)
+# Sequence length at or above which `attn_impl="auto"` switches from the
+# dense einsum to the Pallas flash kernel (O(N) memory vs dense's O(N^2)
+# score tensors). 2048 is a conservative UNMEASURED default: the round-3
+# HBM analysis (BASELINE.md) suggests flash wins already at the flagship's
+# 1280, but until the on-chip A/B (`scripts/pallas_onchip.py`) lands the
+# auto path stays dense there and flash is selected explicitly
+# (model.attn_impl=flash / the bench's fastest profile).
 AUTO_FLASH_MIN_SEQ = 2048
 
 
