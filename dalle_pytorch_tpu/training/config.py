@@ -112,6 +112,8 @@ class TrainConfig:
     exp: Optional[str] = None  # preset key overriding mode
     wandb_name: str = "dalle_train_transformer"
     wandb_entity: Optional[str] = None
+    # accepted for reference-CLI parity (`config/config.yaml`); the
+    # trainer, like the reference's, generates one sample per log step
     wandb_num_images: int = 4
     log_images_freq: int = 1000
 

@@ -29,6 +29,7 @@ class MetricsLogger:
         debug: bool = False,
         run_name: Optional[str] = None,
         out_dir: str = "logs",
+        entity: Optional[str] = None,
     ):
         self.enabled = enabled
         self.out_dir = Path(out_dir)
@@ -42,6 +43,7 @@ class MetricsLogger:
             self.run = wandb.init(
                 project=project,
                 name=run_name,
+                entity=entity,  # --wandb_entity (`train_dalle.py:119-124`)
                 config=config or {},
                 mode="disabled" if debug else "online",
             )

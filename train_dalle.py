@@ -216,6 +216,7 @@ def main():
     logger = MetricsLogger(
         project=cfg.wandb_name, config={"cli": "train_dalle"},
         enabled=is_root(), debug=cfg.debug, out_dir=str(run_dir / "logs"),
+        entity=cfg.wandb_entity,
     )
     from dalle_pytorch_tpu.utils.flops import (
         dalle_train_flops_per_sample, mfu as flops_mfu,
