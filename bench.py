@@ -63,6 +63,10 @@ def main():
     remat_policy = None if remat_policy == "none" else remat_policy
     attn_impl = os.environ.get("BENCH_ATTN", "auto")
     fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
+    # "scan" compiles ONE layer body instead of `depth` copies — ~12x
+    # smaller program; the tunneled backend has died mid-compile on the
+    # unrolled flagship repeatedly, so small compiles are also robustness
+    executor = os.environ.get("BENCH_EXECUTOR", "unrolled")
     image_seq = fmap * fmap
     seq = text_seq + image_seq
 
@@ -72,7 +76,7 @@ def main():
         num_text_tokens=10000, text_seq_len=text_seq,
         shift_tokens=True, rotary_emb=True, attn_impl=attn_impl,
         reversible=remat, reversible_impl="remat", remat_policy=remat_policy,
-        fused_ce=fused_ce,
+        fused_ce=fused_ce, executor=executor,
         dtype=jnp.bfloat16,
     )
     text = jnp.ones((batch, text_seq), jnp.int32)
@@ -164,7 +168,8 @@ def main():
         "config": (
             f"dim{dim}-depth{depth}-seq{seq}-gbs{batch}-accum{accum}-{attn_impl}"
             f"-remat{int(remat)}{'-' + remat_policy if remat_policy else ''}"
-            f"{'-fusedce' if fused_ce else ''}-bf16"
+            f"{'-fusedce' if fused_ce else ''}"
+            f"{'-scan' if executor == 'scan' else ''}-bf16"
         ),
     }
     if prefetcher is not None:
@@ -223,6 +228,19 @@ if __name__ == "__main__":
             # traffic). Any failure falls through to the next profile;
             # the last is the round-3 known-good 7.2%-MFU config.
             profiles=[
+                (
+                    # nn.scan executor first: ~12x smaller program. The
+                    # tunneled backend's relay has died mid-compile on the
+                    # unrolled flagship twice; the small compile is both
+                    # faster and the best shot at surviving to a number.
+                    "scan+flash+dots_policy+fused_ce",
+                    {
+                        "BENCH_EXECUTOR": "scan",
+                        "BENCH_ATTN": "flash",
+                        "BENCH_REMAT_POLICY": "dots_with_no_batch_dims_saveable",
+                        "BENCH_FUSED_CE": "1",
+                    },
+                ),
                 (
                     "flash+dots_policy+fused_ce",
                     {

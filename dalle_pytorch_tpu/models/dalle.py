@@ -109,6 +109,9 @@ class DALLE(nn.Module):
     img_loss_coeff_inv: float = 1.0
     attn_impl: str = "auto"  # "dense" | "flash" | "ring" | "auto"
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
+    # layer executor: "unrolled" | "scan" (one compiled layer body,
+    # ~depth× smaller program; see models/transformer.py docstring)
+    executor: str = "unrolled"
     # vocab-chunked CE for the forward objective: avoids materializing
     # [B, N, total_tokens] logits (ops/losses.py)
     fused_ce: bool = False
@@ -162,6 +165,7 @@ class DALLE(nn.Module):
             remat_policy=self.remat_policy,
             attn_impl=self.attn_impl,
             sp_mesh=self.sp_mesh,
+            executor=self.executor,
             dtype=self.dtype,
         )
 

@@ -31,8 +31,17 @@ TPU-native re-design of the reference transformer
         revnet path requires deterministic execution (dropout rate 0),
         which JAX guarantees under explicit PRNG keys.
 
-The executor unrolls layers in Python (static depth) so XLA sees one big
-fusable graph; weight-shared stacks may later scan.
+Layer executors (orthogonal to the reversible memory modes):
+  * "unrolled" (default): layers unrolled in Python (static depth) — one
+    big fusable graph, supports every feature (type cycling, sharing,
+    cached decode, revnet);
+  * "scan": homogeneous stacks run as `nn.scan` over depth-stacked
+    parameters — the HLO contains ONE layer body instead of `depth`
+    copies, so programs compile ~depth× faster (load-bearing here: the
+    tunneled TPU backend has repeatedly died mid-compile on the unrolled
+    flagship program) at identical runtime math. Restricted to uniform
+    full attention with no cross-layer sharing; cached decode converts
+    the checkpoint to the unrolled layout via `scan_params_to_unrolled`.
 """
 
 from __future__ import annotations
@@ -135,6 +144,111 @@ def _build_static_mask(
     raise ValueError(f'attention type "{attn_type}" is not valid')
 
 
+class _ScanBlock(nn.Module):
+    """One (attn, ff) residual pair in scannable form.
+
+    Math-identical to `Transformer._layer` for the uncached, uniform
+    full-attention case; LayerScale vectors arrive as scanned-over inputs
+    (they are per-layer constants at init, so they live as one stacked
+    parameter on the owning Transformer instead of inside the body).
+    """
+
+    dim: int
+    seq_len: int
+    causal: bool
+    heads: int
+    dim_head: int
+    ff_mult: float
+    attn_dropout: float
+    ff_dropout: float
+    stable: bool
+    sandwich_norm: bool
+    shift_tokens: bool
+    text_len: int
+    image_fmap_size: Optional[int]
+    attn_impl: str
+    sp_mesh: Any
+    deterministic: bool
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x, attn_scale, ff_scale, key_mask, rotary):
+        def shift(h):
+            if not self.shift_tokens:
+                return h
+            return shift_tokens_dalle(h, self.text_len, self.image_fmap_size)
+
+        h = nn.LayerNorm(dtype=self.dtype, name="norm_attn")(x)
+        h, _ = Attention(
+            dim=self.dim,
+            seq_len=self.seq_len,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            causal=self.causal,
+            dropout=self.attn_dropout,
+            stable=self.stable,
+            static_mask=None,
+            attn_impl=self.attn_impl,
+            sp_mesh=self.sp_mesh,
+            dtype=self.dtype,
+            name="attn",
+        )(shift(h), key_mask=key_mask, rotary=rotary,
+          deterministic=self.deterministic)
+        if self.sandwich_norm:
+            h = nn.LayerNorm(dtype=self.dtype, name="norm_attn_out")(h)
+        x = x + h * attn_scale.astype(h.dtype)
+
+        h = nn.LayerNorm(dtype=self.dtype, name="norm_ff")(x)
+        h = FeedForward(
+            dim=self.dim, mult=self.ff_mult, dropout=self.ff_dropout,
+            dtype=self.dtype, name="ff",
+        )(shift(h), deterministic=self.deterministic)
+        if self.sandwich_norm:
+            h = nn.LayerNorm(dtype=self.dtype, name="norm_ff_out")(h)
+        x = x + h * ff_scale.astype(h.dtype)
+        return x, None
+
+
+class _ScanStack(nn.Module):
+    """Depth-stacked `_ScanBlock` driven by `nn.scan`.
+
+    `reverse` (the reference fork's `reverse_model`) flips the iteration —
+    both directions share the same "layers" parameter collection, so a
+    checkpoint is direction-agnostic exactly like the unrolled executor.
+    """
+
+    depth: int
+    block_kwargs: Any  # dict of _ScanBlock constructor args (static)
+    remat: bool
+    remat_policy: Optional[str]
+
+    @nn.compact
+    def __call__(self, x, attn_scales, ff_scales, key_mask, rotary,
+                 reverse: bool = False, deterministic: bool = True):
+        body = _ScanBlock
+        if self.remat:
+            policy = (
+                getattr(jax.checkpoint_policies, self.remat_policy)
+                if self.remat_policy
+                else None
+            )
+            # prevent_cse=False is safe (and recommended) under scan
+            body = nn.remat(body, policy=policy, prevent_cse=False)
+        scanned = nn.scan(
+            body,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+            in_axes=(0, 0, nn.broadcast, nn.broadcast),
+            length=self.depth,
+            reverse=reverse,
+        )
+        stack = scanned(
+            deterministic=deterministic, name="layers", **self.block_kwargs
+        )
+        x, _ = stack(x, attn_scales, ff_scales, key_mask, rotary)
+        return x
+
+
 class Transformer(nn.Module):
     """Causal (or bidirectional) transformer stack with DALL-E features."""
 
@@ -165,9 +279,33 @@ class Transformer(nn.Module):
     remat_policy: Optional[str] = None
     attn_impl: str = "auto"  # "dense" | "flash" | "ring" | "auto"
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
+    # "unrolled" | "scan" — see module docstring. "scan" compiles one layer
+    # body instead of `depth` copies; requires uniform full attention, no
+    # shared ids, no revnet, uncached calls only.
+    executor: str = "unrolled"
     dtype: Any = jnp.float32
 
+    def _scan_supported(self) -> Optional[str]:
+        """None if the scan executor can run this config, else the reason."""
+        if self.attn_types and any(t != "full" for t in self.attn_types):
+            return f"non-uniform attn_types {tuple(self.attn_types)}"
+        if self.shared_attn_ids or self.shared_ff_ids:
+            return "cross-layer weight sharing"
+        if self.reversible and self.reversible_impl != "remat":
+            return "revnet reversible executor"
+        return None
+
     def setup(self):
+        if self.executor == "scan":
+            why = self._scan_supported()
+            if why is not None:
+                raise ValueError(
+                    f'executor="scan" does not support {why}; use the '
+                    'default unrolled executor'
+                )
+            self._setup_scan()
+            return
+        assert self.executor == "unrolled", f"unknown executor {self.executor!r}"
         depth = self.depth
         attn_types = tuple(self.attn_types) if self.attn_types else ("full",)
         type_per_layer = list(islice(cycle(attn_types), depth))
@@ -245,19 +383,66 @@ class Transformer(nn.Module):
             for i in range(depth)
         ]
 
-        if self.rotary_emb:
-            assert self.image_fmap_size is not None
-            text_len = self.seq_len - self.image_fmap_size**2 + 1
-            self.rotary_table = build_dalle_rotary(
-                text_len, self.image_fmap_size, self.dim_head
-            )
-        else:
-            self.rotary_table = None
+        self.rotary_table = self._build_rotary_table()
+        self.text_len = self._derived_text_len()
 
-        self.text_len = (
+    def _derived_text_len(self) -> int:
+        return (
             self.seq_len - self.image_fmap_size**2 + 1
             if self.image_fmap_size is not None
             else self.seq_len
+        )
+
+    def _build_rotary_table(self):
+        if not self.rotary_emb:
+            return None
+        assert self.image_fmap_size is not None
+        return build_dalle_rotary(
+            self.seq_len - self.image_fmap_size**2 + 1,
+            self.image_fmap_size,
+            self.dim_head,
+        )
+
+    def _setup_scan(self):
+        """Scan-executor setup: one stacked parameter collection."""
+        depth, dim = self.depth, self.dim
+        self.rotary_table = self._build_rotary_table()
+        self.text_len = self._derived_text_len()
+
+        def stacked_scale_init(key, shape):
+            del key  # deterministic depth-dependent init (layerscale_init)
+            return jnp.stack(
+                [jnp.full(shape[1:], layerscale_init(i + 1)) for i in range(shape[0])]
+            )
+
+        self.attn_scales_stacked = self.param(
+            "attn_scale_stack", stacked_scale_init, (depth, 1, 1, dim)
+        )
+        self.ff_scales_stacked = self.param(
+            "ff_scale_stack", stacked_scale_init, (depth, 1, 1, dim)
+        )
+        self.scan_stack = _ScanStack(
+            depth=depth,
+            remat=self.reversible,
+            remat_policy=self.remat_policy,
+            block_kwargs=dict(
+                dim=dim,
+                seq_len=self.seq_len,
+                causal=self.causal,
+                heads=self.heads,
+                dim_head=self.dim_head,
+                ff_mult=self.ff_mult,
+                attn_dropout=self.attn_dropout,
+                ff_dropout=self.ff_dropout,
+                stable=self.stable,
+                sandwich_norm=self.sandwich_norm,
+                shift_tokens=self.shift_tokens,
+                text_len=self.text_len,
+                image_fmap_size=self.image_fmap_size,
+                attn_impl=self.attn_impl,
+                sp_mesh=self.sp_mesh,
+                dtype=self.dtype,
+            ),
         )
 
     def _shift(self, h: jnp.ndarray, ring, pos):
@@ -417,6 +602,22 @@ class Transformer(nn.Module):
         cache: Optional[dict] = None,
         deterministic: bool = True,
     ):
+        if self.executor == "scan":
+            if cache is not None:
+                raise ValueError(
+                    'executor="scan" has no cached-decode path; convert the '
+                    "checkpoint with scan_params_to_unrolled() and decode "
+                    "with the default executor"
+                )
+            return self.scan_stack(
+                x,
+                self.attn_scales_stacked,
+                self.ff_scales_stacked,
+                key_mask,
+                self.rotary_table,
+                reverse=reverse_model,
+                deterministic=deterministic,
+            )
         order = range(self.depth - 1, -1, -1) if reverse_model else range(self.depth)
         if self.reversible and self.reversible_impl != "remat":
             if cache is not None:
@@ -484,6 +685,55 @@ class Transformer(nn.Module):
             shift_tokens=self.shift_tokens,
             dtype=dtype,
         )
+
+
+def scan_params_to_unrolled(tparams: dict, depth: int) -> dict:
+    """Convert a scan-executor Transformer param subtree to the unrolled
+    layout (e.g. to run the cached decode path on a scan-trained model).
+
+    `tparams` is the subtree under ".../transformer" of a scan-executor
+    model; returns the equivalent unrolled-executor subtree.
+    """
+    layers = tparams["scan_stack"]["layers"]
+
+    def slice_i(tree, i):
+        return jax.tree_util.tree_map(lambda a: a[i], tree)
+
+    out = {}
+    for i in range(depth):
+        out[f"attn_{i}"] = slice_i(layers["attn"], i)
+        out[f"ff_{i}"] = slice_i(layers["ff"], i)
+        out[f"attn_norms_{i}"] = slice_i(layers["norm_attn"], i)
+        out[f"ff_norms_{i}"] = slice_i(layers["norm_ff"], i)
+        if "norm_attn_out" in layers:
+            out[f"attn_norms_out_{i}"] = slice_i(layers["norm_attn_out"], i)
+            out[f"ff_norms_out_{i}"] = slice_i(layers["norm_ff_out"], i)
+        out[f"attn_scale_{i}"] = tparams["attn_scale_stack"][i]
+        out[f"ff_scale_{i}"] = tparams["ff_scale_stack"][i]
+    return out
+
+
+def unrolled_params_to_scan(tparams: dict, depth: int) -> dict:
+    """Inverse of `scan_params_to_unrolled` (uniform-stack configs only)."""
+
+    def stack(fmt):
+        trees = [tparams[fmt.format(i)] for i in range(depth)]
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+    layers = {
+        "attn": stack("attn_{}"),
+        "ff": stack("ff_{}"),
+        "norm_attn": stack("attn_norms_{}"),
+        "norm_ff": stack("ff_norms_{}"),
+    }
+    if "attn_norms_out_0" in tparams:
+        layers["norm_attn_out"] = stack("attn_norms_out_{}")
+        layers["norm_ff_out"] = stack("ff_norms_out_{}")
+    return {
+        "scan_stack": {"layers": layers},
+        "attn_scale_stack": stack("attn_scale_{}"),
+        "ff_scale_stack": stack("ff_scale_{}"),
+    }
 
 
 def make_decode_cache(

@@ -23,9 +23,13 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-NEG = jnp.float32(-1e30)
+NEG = np.float32(-1e30)  # np, NOT jnp: a module-level jax Array would be
+# hoisted into every fused-CE executable as a runtime constant argument,
+# and the jit C++ fastpath drops hoisted const args after 2 calls on
+# jax 0.9 ("Execution supplied N buffers but compiled program expected M")
 
 
 def chunked_masked_ce(
@@ -50,6 +54,9 @@ def chunked_masked_ce(
     """
     B, N, D = h.shape
     V = kernel.shape[1]
+    # don't pad tiny vocabularies up to a full `chunk` (a 90-entry test
+    # vocab would otherwise compute 2048 logit columns); lane-align to 128
+    chunk = min(chunk, max(128, -(-V // 128) * 128))
     n_chunks = -(-V // chunk)
     pad = n_chunks * chunk - V
     if pad:
@@ -97,11 +104,21 @@ def chunked_masked_ce(
         g = jnp.where(in_chunk, gold_c, g)
         return (m_new, s, g), None
 
-    (m, s, g), _ = lax.scan(
-        body,
-        (m0, s0, g0),
-        (jnp.arange(n_chunks), kernel_chunks, bias_chunks),
-    )
+    if n_chunks == 1:
+        # single chunk: call the body directly. A length-1 lax.scan here
+        # miscompiles under grad on jax 0.9 ("Execution supplied N buffers
+        # but compiled program expected M" after a few cached-executable
+        # calls); the scan is pointless at length 1 anyway.
+        (m, s, g), _ = body(
+            (m0, s0, g0),
+            (jnp.zeros((), jnp.int32), kernel_chunks[0], bias_chunks[0]),
+        )
+    else:
+        (m, s, g), _ = lax.scan(
+            body,
+            (m0, s0, g0),
+            (jnp.arange(n_chunks), kernel_chunks, bias_chunks),
+        )
     logz = m + jnp.log(s)
     return logz - g
 

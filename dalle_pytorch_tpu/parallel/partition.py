@@ -34,6 +34,13 @@ _RULES: tuple[tuple[str, int, P], ...] = (
     (r"to_out/kernel$", 2, P("tp", "fsdp")),
     (r"ff_\d+/Dense_0/kernel$", 2, P("fsdp", "tp")),
     (r"ff_\d+/Dense_1/kernel$", 2, P("tp", "fsdp")),
+    # scan executor: same kernels with a leading stacked-depth axis
+    # (transformer/scan_stack/layers/...); depth stays unsharded so one
+    # scan step touches exactly one layer's shards
+    (r"to_qkv/kernel$", 3, P(None, "fsdp", "tp")),
+    (r"to_out/kernel$", 3, P(None, "tp", "fsdp")),
+    (r"layers/ff/Dense_0/kernel$", 3, P(None, "fsdp", "tp")),
+    (r"layers/ff/Dense_1/kernel$", 3, P(None, "tp", "fsdp")),
     (r"logits_dense/kernel$", 2, P("fsdp", "tp")),
     (r"embedding$", 2, P("tp", "fsdp")),
     (r"(text_pos_emb|visual_pos_emb)/embedding$", 2, P(None, "fsdp")),

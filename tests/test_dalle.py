@@ -383,6 +383,47 @@ class TestFusedCE:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+class TestFusedCEMultiStep:
+    """Regression: jax 0.9's jit C++ fastpath drops hoisted constant
+    arguments from call 3 onward ("Execution supplied N buffers but
+    compiled program expected M"). A module-level `jnp.float32` constant
+    in ops/losses.py triggered it for every fused-CE train step — parity
+    tests (1-2 calls) never saw it; any real training run crashed at
+    step 3. Pin: 5 donated jitted steps must survive."""
+
+    def test_five_donated_steps(self):
+        from dalle_pytorch_tpu.training import (
+            TrainState, make_optimizer, make_dalle_train_step,
+        )
+
+        model = DALLE(
+            dim=32, depth=2, heads=2, dim_head=16, num_image_tokens=48,
+            image_fmap_size=4, num_text_tokens=60, text_seq_len=12,
+            shift_tokens=True, rotary_emb=True,
+            reversible=True, reversible_impl="remat",
+            remat_policy="dots_with_no_batch_dims_saveable", fused_ce=True,
+        )
+        text = jnp.ones((2, 12), jnp.int32)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        params = jax.jit(model.init)(
+            jax.random.PRNGKey(0), text, tokens
+        )["params"]
+        state = TrainState.create(
+            apply_fn=model.apply, params=params,
+            tx=make_optimizer(3e-4, clip_grad_norm=0.5),
+        )
+        step = jax.jit(make_dalle_train_step(model), donate_argnums=0)
+        batch = {"text": text, "image_tokens": tokens}
+        rng = jax.random.PRNGKey(1)
+        losses = []
+        for _ in range(5):
+            rng, r = jax.random.split(rng)
+            state, m = step(state, batch, r)
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+
+
 class TestCombinedPerfFeatures:
     """The bench's fastest profile stacks flash attention + selective remat
     + fused CE; their composition must agree with the plain model."""
