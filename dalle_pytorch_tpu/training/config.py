@@ -86,6 +86,10 @@ class DalleConfig:
     # (sequence-parallel over the mesh sp axis) | "auto" (dense below
     # AUTO_FLASH_MIN_SEQ, flash above; ring when mesh.sp > 1)
     attn_impl: str = "auto"
+    # layer executor: "unrolled" | "scan" (nn.scan over depth-stacked
+    # params — ~depth× smaller program/compile; uniform full attention,
+    # no shared ids; checkpoints auto-convert for cached decode)
+    executor: str = "unrolled"
 
     def attn_types_tuple(self) -> Tuple[str, ...]:
         return tuple(s.strip() for s in self.attn_types.split(",") if s.strip())

@@ -278,6 +278,7 @@ def dalle_from_config(
         img_loss_coeff_inv=cfg.img_loss_coeff_inv,
         attn_impl=attn_impl,
         sp_mesh=sp_mesh,
+        executor=getattr(m, "executor", "unrolled"),
         fused_ce=getattr(m, "fused_ce", False),
         dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
     )

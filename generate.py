@@ -85,6 +85,17 @@ def main():
         # the mesh sp axis); KV-cached decode never runs it, so a
         # ring-trained checkpoint generates with the dense/auto kernel
         cfg.model.attn_impl = "auto"
+    if getattr(cfg.model, "executor", "unrolled") == "scan":
+        # the scan executor is a training-time compile optimization; its
+        # depth-stacked checkpoint converts losslessly to the unrolled
+        # layout, which owns the KV-cached decode path
+        from dalle_pytorch_tpu.models.transformer import scan_params_to_unrolled
+
+        dalle_params = dict(dalle_params)
+        dalle_params["transformer"] = scan_params_to_unrolled(
+            dalle_params["transformer"], cfg.model.depth
+        )
+        cfg.model.executor = "unrolled"
     model = dalle_from_config(
         cfg, num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
         vocab_size=max(tokenizer.vocab_size, 1),

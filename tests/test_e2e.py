@@ -293,6 +293,33 @@ class TestAttnImplCli:
         )
         assert (tmp_path / "checkpoints" / "dalle.npz").exists()
 
+    def test_train_with_scan_executor_and_generate(self, tmp_path):
+        """2 steps with --set model.executor=scan (depth-stacked nn.scan
+        params), then generate.py from that checkpoint: the cached
+        decoder must auto-convert the stacked params to the unrolled
+        layout."""
+        vae_path = _tiny_vae_ckpt(tmp_path)
+        run_cli(
+            "train_dalle.py", "--image_text_folder", "rainbow:16",
+            "--vae_path", str(vae_path),
+            "--epochs", "1", "--batch_size", "8",
+            "--set", "model.executor=scan",
+            "--set", "model.dim=64", "--set", "model.depth=2",
+            "--set", "model.heads=2", "--set", "model.dim_head=16",
+            "--set", "model.text_seq_len=16", "--set", "bf16=false",
+            "--set", "log_images_freq=0", "--set", "debug=true",
+            cwd=tmp_path,
+        )
+        ckpt = tmp_path / "checkpoints" / "dalle.npz"
+        assert ckpt.exists()
+        run_cli(
+            "generate.py", "--dalle_path", str(ckpt),
+            "--text", "small blue square", "--num_images", "2",
+            "--batch_size", "2",
+            "--outputs_dir", str(tmp_path / "scan_out"), cwd=tmp_path,
+        )
+        assert list((tmp_path / "scan_out").rglob("grid.png"))
+
     def test_train_with_sequence_parallel_ring(self, tmp_path):
         """2 steps of train_dalle.py with mesh.sp=2 on the 8-virtual-device
         CPU mesh: ring attention inside the real trainer loop (seq 32
