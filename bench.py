@@ -289,7 +289,7 @@ if __name__ == "__main__":
                  [py, os.path.join(here, "scripts", "pallas_onchip.py")]),
                 ("perf_probe",
                  [py, os.path.join(here, "scripts", "perf_probe.py"),
-                  "peak", "attn", "ff", "logits"]),
+                  "peak", "hbm", "attn", "ff", "logits"]),
             ):
                 left = extras_deadline - time.monotonic()
                 if left < 60:

@@ -215,6 +215,17 @@ def dalle_from_config(
             f"{sorted(REMAT_POLICIES)}"
         )
     attn_impl = m.attn_impl
+    executor = getattr(m, "executor", "unrolled")
+    if executor not in ("unrolled", "scan"):
+        raise ValueError(
+            f"unknown model.executor {executor!r}; valid: unrolled, scan"
+        )
+    if executor == "scan" and sp_mesh is not None and sp_mesh.shape.get("sp", 1) > 1:
+        raise ValueError(
+            'model.executor="scan" has not been validated with ring '
+            "attention (mesh.sp>1); use the unrolled executor for "
+            "sequence-parallel training"
+        )
     if sp_mesh is not None and sp_mesh.shape.get("sp", 1) > 1:
         if attn_impl in ("auto", "ring"):
             attn_impl = "ring"
@@ -278,7 +289,7 @@ def dalle_from_config(
         img_loss_coeff_inv=cfg.img_loss_coeff_inv,
         attn_impl=attn_impl,
         sp_mesh=sp_mesh,
-        executor=getattr(m, "executor", "unrolled"),
+        executor=executor,
         fused_ce=getattr(m, "fused_ce", False),
         dtype=jnp.bfloat16 if cfg.bf16 else jnp.float32,
     )

@@ -12,6 +12,8 @@ op, with one RTT amortized over the whole loop.
 
 Probes:
   peak    — chained bf16 8192^3 matmuls: achievable MXU FLOP/s ceiling
+  hbm     — chained elementwise pass over a 1 GiB array: achievable HBM
+            read+write bandwidth (the roofline's other axis)
   attn    — one dense attention layer fwd+bwd at flagship geometry
   ff      — one GEGLU FF block fwd+bwd at flagship geometry
   logits  — logits head (18448 vocab) + CE fwd+bwd
@@ -104,6 +106,35 @@ def main():
             return loop, (a, b)
 
         run_probe("peak_matmul_bf16_8192", build, 2 * n**3, emit)
+
+    if want("hbm"):
+        # streaming read+write of a 1 GiB bf16 buffer; XLA can't fuse the
+        # iterations away because each depends on the previous value.
+        # Reported as GB/s = 2 * size / t (one read + one write per pass).
+        elems = int(os.environ.get("PROBE_HBM_ELEMS", str(512 * 1024 * 1024)))
+
+        def build():
+            x = jnp.ones((elems,), jnp.bfloat16)
+
+            @jax.jit
+            def loop(x):
+                def body(_, x):
+                    return x * jnp.bfloat16(1.0000001) + jnp.bfloat16(1e-9)
+
+                return lax.fori_loop(0, K, body, x)
+
+            return loop, (x,)
+
+        import functools
+
+        def emit_bw(rec):
+            secs = rec["ms_per_iter"] / 1e3
+            rec = dict(rec)
+            rec["gbytes_per_sec"] = round(2 * elems * 2 / secs / 1e9, 1)
+            rec["buffer_gib"] = round(elems * 2 / 2**30, 2)
+            emit(rec)
+
+        run_probe("hbm_stream_bw", build, None, emit_bw)
 
     def grad_loop_probe(name, module, x_shape, flops):
         """K chained fwd+bwd of `module` inside one jit: x <- x - 1e-3*dx."""

@@ -47,7 +47,7 @@ run generate_p50     1500 python bench_generate.py
 run pallas_onchip    1500 PROBE_K=8 python scripts/pallas_onchip.py
 
 # 4. per-component costs (attn/ff/logits AI table)
-run probe_components 1200 PROBE_K=8 python scripts/perf_probe.py attn ff logits
+run probe_components 1200 PROBE_K=8 python scripts/perf_probe.py hbm attn ff logits
 
 # 5. secondary bench A/Bs. `--child` pins the exact configuration: the
 # guard's profile ladder applies env with setdefault, so a pinned env
@@ -58,5 +58,8 @@ run bench_unrolled_flash 1200 BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_b
 run bench_base       1200 python bench.py --child
 run bench_noremat_a2 1200 BENCH_REMAT=0 BENCH_ACCUM=2 BENCH_ATTN=flash python bench.py --child
 run bench_host_input 1200 BENCH_INPUT=host BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable python bench.py --child
+# larger global batch: flash frees the score tensors, so 32 may fit and
+# lift arithmetic intensity on the FF/logits blocks
+run bench_scan_b32   1200 BENCH_BATCH=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 
 echo "results -> $OUT" >&2

@@ -273,6 +273,16 @@ class TestAttnImplWiring:
         with pytest.raises(ValueError, match="stable_softmax"):
             dalle_from_config(cfg, 32, 4, 100, sp_mesh=mesh2)
 
+        # scan executor: resolves through, but not with sequence parallelism
+        cfg = load_config(overrides=["model.executor=scan"])
+        m = dalle_from_config(cfg, 32, 4, 100, sp_mesh=mesh1)
+        assert m.executor == "scan"
+        with pytest.raises(ValueError, match="scan"):
+            dalle_from_config(cfg, 32, 4, 100, sp_mesh=mesh2)
+        cfg = load_config(overrides=["model.executor=bogus"])
+        with pytest.raises(ValueError, match="executor"):
+            dalle_from_config(cfg, 32, 4, 100)
+
 
 @pytest.mark.slow
 class TestAttnImplCli:
