@@ -119,6 +119,32 @@ def main():
         _ = float(jnp.asarray(out).ravel()[0])
         return (time.perf_counter() - t0) / K
 
+    # ---- 2b. flash block-size sweep at the flagship seq (tuning data;
+    # PROBE_BLOCKS="bq:bk,..." to override) ----
+    blocks = [
+        tuple(int(x) for x in spec.split(":"))
+        for spec in os.environ.get(
+            "PROBE_BLOCKS", "128:128,256:128,128:256,256:256,512:128"
+        ).split(",")
+    ]
+    for bq, bk in blocks:
+        row = {"probe": "block_sweep", "seq": SEQS[0], "bq": bq, "bk": bk}
+        try:
+            row["flash_ms"] = round(
+                timed_grad(
+                    lambda q, k, v: flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_k=bk
+                    ),
+                    SEQS[0],
+                )
+                * 1e3,
+                2,
+            )
+        except Exception as e:
+            row["flash_ms"] = None
+            row["error"] = type(e).__name__
+        print(json.dumps(row), flush=True)
+
     for seq in SEQS:
         causal = jnp.tril(jnp.ones((seq, seq), bool))[None, None]
         row = {"probe": "ab", "seq": seq, "batch": BATCH}
