@@ -30,9 +30,15 @@ def peak_flops_per_chip(device_kind: str) -> float:
 
 
 def transformer_train_flops(
-    dim: int, depth: int, heads: int, dim_head: int, seq: int, ff_mult: int = 4
+    dim: int, depth: int, heads: int, dim_head: int, seq: int, ff_mult: int = 4,
+    vocab: int = 0,
 ) -> float:
-    """Matmul FLOPs per sample for one fwd+bwd training step."""
+    """Matmul FLOPs per sample for one fwd+bwd training step.
+
+    `vocab` adds the logits-head projection (standard MFU accounting
+    includes the LM head; ~6% of the flagship's matmul FLOPs). Remat
+    recompute is deliberately NOT counted — MFU quotes useful FLOPs.
+    """
     inner = heads * dim_head
     per_layer = (
         2 * seq * dim * 3 * inner            # qkv proj
@@ -41,14 +47,15 @@ def transformer_train_flops(
         + 2 * seq * dim * dim * ff_mult * 2  # ff up (GEGLU: 2x width)
         + 2 * seq * dim * ff_mult * dim      # ff down
     )
-    fwd = depth * per_layer
+    fwd = depth * per_layer + 2 * seq * dim * vocab
     return 3 * fwd  # fwd + 2x bwd
 
 
 def dalle_train_flops_per_sample(model) -> float:
     """FLOPs/sample for a DALLE model instance (forward objective)."""
     return transformer_train_flops(
-        model.dim, model.depth, model.heads, model.dim_head, model.total_seq_len
+        model.dim, model.depth, model.heads, model.dim_head,
+        model.total_seq_len, vocab=model.total_tokens,
     )
 
 

@@ -221,7 +221,9 @@ def main():
     def flagship_flops(b):
         from dalle_pytorch_tpu.utils.flops import transformer_train_flops
 
-        return transformer_train_flops(dim, depth, heads, dim_head, seq) * b
+        return transformer_train_flops(
+            dim, depth, heads, dim_head, seq, vocab=10000 + text_seq + 8192
+        ) * b
 
     if want("step") or want("step_noremat") or want("fwd"):
         from dalle_pytorch_tpu.models.dalle import DALLE

@@ -28,6 +28,10 @@ class TestFlops:
         m = DALLE(dim=64, depth=2, heads=4, dim_head=16, num_image_tokens=32,
                   image_fmap_size=4, num_text_tokens=60, text_seq_len=12)
         assert dalle_train_flops_per_sample(m) == transformer_train_flops(
+            64, 2, 4, 16, m.total_seq_len, vocab=m.total_tokens
+        )
+        # the logits head is counted (standard MFU includes the LM head)
+        assert dalle_train_flops_per_sample(m) > transformer_train_flops(
             64, 2, 4, 16, m.total_seq_len
         )
 
