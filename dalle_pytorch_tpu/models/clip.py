@@ -35,6 +35,9 @@ class CLIP(nn.Module):
     visual_image_size: int = 256
     visual_patch_size: int = 32
     channels: int = 3
+    # layer executor for both encoders: "unrolled" | "scan" (one compiled
+    # layer body; see models/transformer.py)
+    executor: str = "unrolled"
     dtype: Any = jnp.float32
 
     def setup(self):
@@ -50,6 +53,7 @@ class CLIP(nn.Module):
             causal=False,
             heads=self.text_heads,
             rotary_emb=False,
+            executor=self.executor,
             dtype=self.dtype,
         )
         self.to_text_latent = nn.Dense(self.dim_latent, use_bias=False, dtype=self.dtype)
@@ -63,6 +67,7 @@ class CLIP(nn.Module):
             causal=False,
             heads=self.visual_heads,
             rotary_emb=False,
+            executor=self.executor,
             dtype=self.dtype,
         )
         self.to_visual_latent = nn.Dense(self.dim_latent, use_bias=False, dtype=self.dtype)

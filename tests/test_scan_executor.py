@@ -129,6 +129,35 @@ class TestScanParity:
             scn.init(jax.random.PRNGKey(1), x_input())
 
 
+class TestScanCLIP:
+    """CLIP's two non-causal encoders under the scan executor (incl. the
+    text encoder's dynamic key-padding mask through nn.broadcast)."""
+
+    def test_loss_parity(self):
+        from dalle_pytorch_tpu.models.clip import CLIP
+
+        kw = dict(
+            dim_text=32, dim_image=32, dim_latent=16, num_text_tokens=50,
+            text_enc_depth=2, text_seq_len=8, text_heads=2,
+            visual_enc_depth=2, visual_heads=2, visual_image_size=16,
+            visual_patch_size=8,
+        )
+        cu, cs = CLIP(executor="unrolled", **kw), CLIP(executor="scan", **kw)
+        text = jnp.array([[3, 5, 2, 0, 0, 0, 0, 0], [7, 1, 4, 9, 0, 0, 0, 0]])
+        mask = text > 0
+        imgs = jax.random.uniform(jax.random.PRNGKey(0), (2, 16, 16, 3))
+        vs = cs.init(jax.random.PRNGKey(1), text, imgs, text_mask=mask,
+                     return_loss=True)
+        loss_s = cs.apply(vs, text, imgs, text_mask=mask, return_loss=True)
+
+        pu = dict(vs["params"])
+        for name, depth in (("text_transformer", 2), ("visual_transformer", 2)):
+            pu[name] = scan_params_to_unrolled(vs["params"][name], depth)
+        loss_u = cu.apply({"params": pu}, text, imgs, text_mask=mask,
+                          return_loss=True)
+        np.testing.assert_allclose(float(loss_s), float(loss_u), rtol=1e-5)
+
+
 class TestScanDALLE:
     """End-to-end through the DALLE wrapper: scan-trained params must
     produce the same loss as unrolled, and the converted checkpoint must
