@@ -394,6 +394,8 @@ def clip_hparams(clip) -> dict:
         "visual_image_size": clip.visual_image_size,
         "visual_patch_size": clip.visual_patch_size,
         "channels": clip.channels,
+        # param-layout-affecting: a scan-trained CLIP must reload as scan
+        "executor": clip.executor,
     }
 
 

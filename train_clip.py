@@ -28,6 +28,11 @@ def parse_args():
     p.add_argument("--depth", type=int, default=4)
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--bpe_path", type=str, default=None)
+    p.add_argument(
+        "--executor", choices=("unrolled", "scan"), default="unrolled",
+        help="layer executor for both encoders; scan compiles one layer "
+        "body instead of depth copies (models/transformer.py)",
+    )
     p.add_argument("--debug", action="store_true")
     return p.parse_args()
 
@@ -80,6 +85,7 @@ def main():
         visual_heads=args.heads,
         visual_image_size=args.image_size,
         visual_patch_size=args.patch_size,
+        executor=args.executor,
     )
     text0 = jnp.ones((2, args.text_seq_len), jnp.int32)
     img0 = jnp.zeros((2, args.image_size, args.image_size, 3))
