@@ -391,7 +391,8 @@ class TestFusedCEMultiStep:
     tests (1-2 calls) never saw it; any real training run crashed at
     step 3. Pin: 5 donated jitted steps must survive."""
 
-    def test_five_donated_steps(self):
+    @pytest.mark.parametrize("executor", ["unrolled", "scan"])
+    def test_five_donated_steps(self, executor):
         from dalle_pytorch_tpu.training import (
             TrainState, make_optimizer, make_dalle_train_step,
         )
@@ -402,6 +403,7 @@ class TestFusedCEMultiStep:
             shift_tokens=True, rotary_emb=True,
             reversible=True, reversible_impl="remat",
             remat_policy="dots_with_no_batch_dims_saveable", fused_ce=True,
+            executor=executor,
         )
         text = jnp.ones((2, 12), jnp.int32)
         tokens = jnp.zeros((2, 16), jnp.int32)
