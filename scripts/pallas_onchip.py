@@ -145,6 +145,31 @@ def main():
             row["error"] = type(e).__name__
         print(json.dumps(row), flush=True)
 
+    # ---- 2c. jax library TPU flash kernel (pallas.ops.tpu.flash_attention)
+    # as a second baseline: if it beats the in-repo kernel on-chip, adopt
+    # it behind attn_impl. Skipped silently off-TPU (it is TPU-only).
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as lib_flash,
+        )
+
+        for seq in SEQS:
+            row = {"probe": "lib_flash", "seq": seq, "batch": BATCH}
+            try:
+                row["lib_flash_ms"] = round(
+                    timed_grad(
+                        lambda q, k, v: lib_flash(q, k, v, causal=True), seq
+                    )
+                    * 1e3,
+                    2,
+                )
+            except Exception as e:
+                row["lib_flash_ms"] = None
+                row["error"] = type(e).__name__
+            print(json.dumps(row), flush=True)
+    except ImportError:
+        pass
+
     for seq in SEQS:
         causal = jnp.tril(jnp.ones((seq, seq), bool))[None, None]
         row = {"probe": "ab", "seq": seq, "batch": BATCH}
