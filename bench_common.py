@@ -160,6 +160,14 @@ def run_guarded(
     skipped entirely — they encode accelerator trade-offs and would
     mislabel the record.
     """
+    # persistent compile cache for every probe/child: a tunnel drop or OOM
+    # retry then re-uses the already-built executable instead of paying
+    # (and risking) the same giant remote compile again. Harmless if the
+    # backend ignores it.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
     info = probe_device()
     if info is None:
         emit_failure(
