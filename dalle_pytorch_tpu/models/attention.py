@@ -34,7 +34,10 @@ import jax.lax as lax
 import flax.linen as nn
 
 from dalle_pytorch_tpu.ops.attention_core import dense_attention
-from dalle_pytorch_tpu.ops.pallas_attention import flash_attention
+from dalle_pytorch_tpu.ops.pallas_attention import (
+    flash_attention,
+    lib_flash_attention,
+)
 from dalle_pytorch_tpu.ops.rotary import apply_rotary
 
 # Sequence length at or above which `attn_impl="auto"` switches from the
@@ -64,12 +67,22 @@ class Attention(nn.Module):
     dropout: float = 0.0
     stable: bool = False
     static_mask: Optional[np.ndarray] = None  # [S, S] bool, True = attend
-    attn_impl: str = "auto"  # "dense" | "flash" (Pallas) | "ring" | "auto"
+    # "dense" | "flash" (in-repo Pallas) | "lib_flash" (jax library TPU
+    # kernel; plain causal/full only) | "ring" | "auto"
+    attn_impl: str = "auto"
     sp_mesh: Any = None  # Mesh with an "sp" axis, required for attn_impl="ring"
     dtype: Any = jnp.float32
 
     def _use_flash(self, n: int, key_mask) -> bool:
         """Flash path: static masks only (dynamic key-padding stays dense)."""
+        if self.attn_impl == "lib_flash":
+            if key_mask is not None or self.static_mask is not None:
+                raise ValueError(
+                    'attn_impl="lib_flash" supports plain causal/full '
+                    "attention only (no key-padding or static masks); use "
+                    '"flash" or "dense"'
+                )
+            return True
         if self.attn_impl == "flash":
             if key_mask is not None:
                 raise ValueError(
@@ -165,11 +178,14 @@ class Attention(nn.Module):
                     self.sp_mesh, q, k, v, causal=self.causal
                 )
             elif self._use_flash(n, key_mask):
-                out = flash_attention(
-                    q, k, v,
-                    mask=self._full_mask(n, n) if self.static_mask is not None else None,
-                    causal=self.causal,
-                )
+                if self.attn_impl == "lib_flash":
+                    out = lib_flash_attention(q, k, v, causal=self.causal)
+                else:
+                    out = flash_attention(
+                        q, k, v,
+                        mask=self._full_mask(n, n) if self.static_mask is not None else None,
+                        causal=self.causal,
+                    )
             else:
                 mask = self._full_mask(n, n)
                 mask = None if mask is None else jnp.asarray(mask)[None, None]

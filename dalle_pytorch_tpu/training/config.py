@@ -82,9 +82,10 @@ class DalleConfig:
     # vocab-chunked cross-entropy (ops/losses.py): forward objective
     # without materializing [B, N, vocab] logits
     fused_ce: bool = False
-    # attention kernel selection: "dense" | "flash" (Pallas) | "ring"
-    # (sequence-parallel over the mesh sp axis) | "auto" (dense below
-    # AUTO_FLASH_MIN_SEQ, flash above; ring when mesh.sp > 1)
+    # attention kernel selection: "dense" | "flash" (in-repo Pallas) |
+    # "lib_flash" (jax library TPU kernel, plain causal/full only) |
+    # "ring" (sequence-parallel over the mesh sp axis) | "auto" (dense
+    # below AUTO_FLASH_MIN_SEQ, flash above; ring when mesh.sp > 1)
     attn_impl: str = "auto"
     # layer executor: "unrolled" | "scan" (nn.scan over depth-stacked
     # params — ~depth× smaller program/compile; uniform full attention,
