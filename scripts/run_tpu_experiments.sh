@@ -61,5 +61,7 @@ run bench_host_input 1200 BENCH_INPUT=host BENCH_ATTN=flash BENCH_REMAT_POLICY=d
 # larger global batch: flash frees the score tensors, so 32 may fit and
 # lift arithmetic intensity on the FF/logits blocks
 run bench_scan_b32   1200 BENCH_BATCH=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+# jax library TPU flash kernel in the full train step (vs in-repo flash)
+run bench_scan_libflash 1200 BENCH_EXECUTOR=scan BENCH_ATTN=lib_flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 
 echo "results -> $OUT" >&2
