@@ -170,6 +170,38 @@ def main():
     except ImportError:
         pass
 
+    # ---- 2d. jax splash-attention kernel (the MaxText production kernel)
+    # — fwd+bwd timing on real hardware only: its backward miscompiles in
+    # CPU interpret mode (jax 0.9 interpret-machinery bug), so there is no
+    # off-chip smoke for it; a model-level attn_impl would follow only if
+    # this row beats flash/lib_flash on-chip.
+    if not interpret:
+        try:
+            from jax.experimental.pallas.ops.tpu.splash_attention import (
+                splash_attention_kernel as sk,
+                splash_attention_mask as sm,
+            )
+
+            for seq in SEQS:
+                row = {"probe": "splash", "seq": seq, "batch": BATCH}
+                kernel = sk.make_splash_mha(
+                    sm.MultiHeadMask([sm.CausalMask((seq, seq))] * HEADS),
+                    head_shards=1,
+                    q_seq_shards=1,
+                )
+                scale = DIM_HEAD**-0.5
+                fn = jax.vmap(lambda q, k, v: kernel(q * scale, k, v))
+                try:
+                    row["splash_ms"] = round(
+                        timed_grad(lambda q, k, v: fn(q, k, v), seq) * 1e3, 2
+                    )
+                except Exception as e:
+                    row["splash_ms"] = None
+                    row["error"] = type(e).__name__
+                print(json.dumps(row), flush=True)
+        except ImportError:
+            pass
+
     for seq in SEQS:
         causal = jnp.tril(jnp.ones((seq, seq), bool))[None, None]
         row = {"probe": "ab", "seq": seq, "batch": BATCH}
