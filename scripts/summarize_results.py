@@ -1,0 +1,158 @@
+"""Summarize TPU experiment artifacts into a markdown table.
+
+Reads TPU_RESULTS.jsonl (watchdog matrix) and/or EXTRA_RESULTS.jsonl
+(bench.py opportunistic extras) and prints:
+
+  * a bench table (profile/config -> img-tok/s/chip, MFU, samples/s),
+  * the generate north star (p50, tokens/s),
+  * the dense-vs-flash/lib_flash/splash A/B with a data-driven
+    recommendation for AUTO_FLASH_MIN_SEQ (models/attention.py),
+  * peak/HBM probe numbers for the roofline.
+
+Usage: python scripts/summarize_results.py [files...]
+Default inputs: TPU_RESULTS.jsonl EXTRA_RESULTS.jsonl (repo root).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def load(paths):
+    recs = []
+    for p in paths:
+        p = Path(p)
+        if not p.exists():
+            continue
+        for line in p.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return recs
+
+
+def flat_results(recs):
+    """Matrix rows are {experiment, result}; extras are the same; bench
+    child lines may also appear bare. Yield (experiment, result-dict)."""
+    for r in recs:
+        if "experiment" in r:
+            res = r.get("result")
+            if isinstance(res, dict):
+                yield r["experiment"], res
+        elif "metric" in r or "probe" in r:
+            yield r.get("metric") or r.get("probe"), r
+
+
+def main():
+    paths = sys.argv[1:] or [ROOT / "TPU_RESULTS.jsonl", ROOT / "EXTRA_RESULTS.jsonl"]
+    rows = list(flat_results(load(paths)))
+    if not rows:
+        print("no results found in", [str(p) for p in paths])
+        return
+
+    bench, probes, ab, gen = [], [], [], []
+    for name, r in rows:
+        if r.get("metric", "").startswith("dalle_train"):
+            bench.append((name, r))
+        elif r.get("metric", "").startswith("generate"):
+            gen.append((name, r))
+        elif r.get("probe") in ("ab", "block_sweep", "lib_flash", "splash"):
+            ab.append(r)
+        elif r.get("probe"):
+            probes.append(r)
+
+    if bench:
+        print("## Training bench\n")
+        print("| run | config | img-tok/s/chip | MFU | samples/s | ok |")
+        print("|---|---|---|---|---|---|")
+        for name, r in bench:
+            print(
+                f"| {name} | {r.get('profile') or r.get('config', '')} | "
+                f"{r.get('value')} | {r.get('mfu')} | "
+                f"{r.get('samples_per_sec')} | "
+                f"{r.get('ok')}{' (CPU)' if r.get('fallback') else ''} |"
+            )
+        best = max(
+            (r for _, r in bench if r.get("ok") and not r.get("fallback")),
+            key=lambda r: r.get("value") or 0,
+            default=None,
+        )
+        if best:
+            print(
+                f"\nBest: {best['value']} img-tok/s/chip "
+                f"(MFU {best.get('mfu')}) @ {best.get('config')}"
+            )
+
+    if gen:
+        print("\n## Generate north star\n")
+        for name, r in gen:
+            tag = " (CPU)" if r.get("fallback") else ""
+            print(
+                f"- {name}: p50 {r.get('value')}s / batch {r.get('batch')}"
+                f" = {r.get('tokens_per_sec')} tok/s{tag}  [{r.get('config')}]"
+            )
+
+    if ab:
+        print("\n## Attention kernel A/B (fwd+bwd ms)\n")
+        print("| seq | dense | flash | lib_flash | splash | bq:bk sweep |")
+        print("|---|---|---|---|---|---|")
+        by_seq = {}
+        for r in ab:
+            s = by_seq.setdefault(r.get("seq"), {})
+            if r.get("probe") == "ab":
+                s["dense"] = r.get("dense_ms")
+                s["flash"] = r.get("flash_ms")
+            elif r.get("probe") == "lib_flash":
+                s["lib_flash"] = r.get("lib_flash_ms")
+            elif r.get("probe") == "splash":
+                s["splash"] = r.get("splash_ms")
+            elif r.get("probe") == "block_sweep" and r.get("flash_ms"):
+                s.setdefault("sweep", []).append(
+                    (r["flash_ms"], f"{r['bq']}:{r['bk']}")
+                )
+        for seq in sorted(k for k in by_seq if k):
+            s = by_seq[seq]
+            sweep = ""
+            if s.get("sweep"):
+                ms, label = min(s["sweep"])
+                sweep = f"best {label} @ {ms}ms"
+            print(
+                f"| {seq} | {s.get('dense')} | {s.get('flash')} | "
+                f"{s.get('lib_flash')} | {s.get('splash')} | {sweep} |"
+            )
+        # AUTO_FLASH_MIN_SEQ recommendation: smallest seq where any flash
+        # variant beats dense
+        candidates = sorted(
+            seq for seq, s in by_seq.items()
+            if seq and s.get("dense") and any(
+                s.get(k) and s[k] < s["dense"]
+                for k in ("flash", "lib_flash", "splash")
+            )
+        )
+        if candidates:
+            print(
+                f"\nRecommendation: AUTO_FLASH_MIN_SEQ = {candidates[0]} "
+                "(smallest measured seq where a flash variant beats dense; "
+                "models/attention.py)"
+            )
+
+    if probes:
+        print("\n## Probes\n")
+        for r in probes:
+            extra = {
+                k: v for k, v in r.items()
+                if k not in ("probe", "device", "k") and v is not None
+            }
+            print(f"- {r['probe']}: {json.dumps(extra)}")
+
+
+if __name__ == "__main__":
+    main()
