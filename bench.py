@@ -150,7 +150,7 @@ def main():
     is_fallback = platform == "cpu"
     steps_per_sec = n_steps / dt
     img_tok_per_sec_chip = steps_per_sec * batch * image_seq / n_chips
-    vocab = 10000 + text_seq + 8192  # model.total_tokens for this geometry
+    vocab = model.total_tokens  # logits width; keeps the FLOPs numerator in sync
     flops_per_step = transformer_train_flops(
         dim, depth, heads, dim_head, seq, vocab=vocab
     ) * batch

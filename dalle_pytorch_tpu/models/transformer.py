@@ -293,9 +293,19 @@ class Transformer(nn.Module):
             return "cross-layer weight sharing"
         if self.reversible and self.reversible_impl != "remat":
             return "revnet reversible executor"
+        if self.attn_impl == "ring" or self.sp_mesh is not None:
+            # shard_map inside nn.scan is unvalidated; keep the guard with
+            # the executor rather than only in training/pipeline.py.
+            return "ring attention / sp mesh"
         return None
 
     def setup(self):
+        if self.shift_tokens and self.image_fmap_size is None:
+            # executor-independent invariant (shift_tokens_dalle needs the
+            # image geometry); checked here so both executors fail at bind
+            # time with the same clear message instead of a mid-trace
+            # assert/TypeError deep in the layer body
+            raise ValueError("shift_tokens=True requires image_fmap_size")
         if self.executor == "scan":
             why = self._scan_supported()
             if why is not None:

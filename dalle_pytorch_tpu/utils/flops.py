@@ -51,9 +51,31 @@ def transformer_train_flops(
     return 3 * fwd  # fwd + 2x bwd
 
 
-def dalle_train_flops_per_sample(model) -> float:
-    """FLOPs/sample for a DALLE model instance (forward objective)."""
-    return transformer_train_flops(
+# objective mode (training/steps.py MODES) -> number of full fwd+bwd
+# transformer passes per sample. forward_forward / forward_reverse_partial
+# run the model twice (forward objective + inverse objective, steps.py
+# `loss_fn`), so their useful work is 2x a single-objective step.
+OBJECTIVE_PASSES = {
+    "forward_only": 1,
+    "reverse_only": 1,
+    "forward_forward": 2,
+    "forward_reverse_partial": 2,
+}
+
+
+def dalle_train_flops_per_sample(model, mode: str = "forward_only") -> float:
+    """FLOPs/sample for a DALLE model instance under an objective mode.
+
+    Counts `OBJECTIVE_PASSES[mode]` full fwd+bwd passes; in-step dVAE
+    encoding (when images rather than tokens are fed) is excluded — it is
+    frozen forward-only conv work, small next to the transformer.
+    Gradient accumulation does not change FLOPs/sample: `_accumulate`
+    scan-splits the same global batch into microbatches, so per-sample
+    work is identical and `sample_per_sec * flops_per_sample` stays the
+    correct MFU numerator.
+    """
+    passes = OBJECTIVE_PASSES[mode]
+    return passes * transformer_train_flops(
         model.dim, model.depth, model.heads, model.dim_head,
         model.total_seq_len, vocab=model.total_tokens,
     )

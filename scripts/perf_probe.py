@@ -118,14 +118,17 @@ def main():
 
             @jax.jit
             def loop(x):
-                def body(_, x):
-                    return x * jnp.bfloat16(1.0000001) + jnp.bfloat16(1e-9)
+                def body(i, x):
+                    # bf16-representable, sign-alternating perturbation: the
+                    # value genuinely changes every iteration, so no legal
+                    # simplifier pass can elide the dependence chain.
+                    delta = jnp.where(i % 2 == 0, jnp.bfloat16(0.25),
+                                      jnp.bfloat16(-0.25))
+                    return x + delta
 
                 return lax.fori_loop(0, K, body, x)
 
             return loop, (x,)
-
-        import functools
 
         def emit_bw(rec):
             secs = rec["ms_per_iter"] / 1e3

@@ -35,6 +35,26 @@ class TestFlops:
             64, 2, 4, 16, m.total_seq_len
         )
 
+    def test_mode_aware_passes(self):
+        # dual-objective modes run the transformer twice per sample
+        # (training/steps.py loss_fn), so the MFU numerator doubles
+        from dalle_pytorch_tpu.models.dalle import DALLE
+        from dalle_pytorch_tpu.training.steps import MODES
+        from dalle_pytorch_tpu.utils.flops import OBJECTIVE_PASSES
+
+        assert set(OBJECTIVE_PASSES) == set(MODES)
+        m = DALLE(dim=64, depth=2, heads=4, dim_head=16, num_image_tokens=32,
+                  image_fmap_size=4, num_text_tokens=60, text_seq_len=12)
+        base = dalle_train_flops_per_sample(m, mode="forward_only")
+        assert dalle_train_flops_per_sample(m, mode="reverse_only") == base
+        assert dalle_train_flops_per_sample(m, mode="forward_forward") == 2 * base
+        assert (
+            dalle_train_flops_per_sample(m, mode="forward_reverse_partial")
+            == 2 * base
+        )
+        with pytest.raises(KeyError):
+            dalle_train_flops_per_sample(m, mode="nonsense")
+
     def test_mfu(self):
         # 1 sample/s at exactly peak-flops-per-sample == MFU 1.0
         assert mfu(1.0, 197e12, "TPU v5e") == pytest.approx(1.0)

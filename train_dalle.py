@@ -222,7 +222,9 @@ def main():
         dalle_train_flops_per_sample, mfu as flops_mfu,
     )
 
-    flops_per_sample = dalle_train_flops_per_sample(model)
+    # mode-aware: forward_forward / forward_reverse_partial run two full
+    # fwd+bwd passes per sample, so the MFU numerator counts both
+    flops_per_sample = dalle_train_flops_per_sample(model, mode=cfg.mode)
     dvae_decode = None  # lazily-jitted sample decode
     meter = ThroughputMeter()
     profiler = ProfilerHook(cfg.flops_profiler)
