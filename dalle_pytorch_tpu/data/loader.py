@@ -30,6 +30,22 @@ import numpy as np
 
 IMAGE_EXTS = {".jpg", ".jpeg", ".png", ".bmp", ".webp"}
 
+# ImageNet synset directory names, e.g. n01440764
+import re as _re
+
+_WNID_RE = _re.compile(r"n\d{8}")
+_IMAGENET_MAP: Optional[Dict[str, str]] = None
+
+
+def _imagenet_class_map() -> Dict[str, str]:
+    """Shipped {wnid: class name} map (data/imagenet_classes.json), loaded
+    lazily so non-ImageNet folder datasets never pay for the parse."""
+    global _IMAGENET_MAP
+    if _IMAGENET_MAP is None:
+        path = Path(__file__).parent / "imagenet_classes.json"
+        _IMAGENET_MAP = json.loads(path.read_text()) if path.exists() else {}
+    return _IMAGENET_MAP
+
 
 def host_shard_order(order: np.ndarray, shard: Tuple[int, int]) -> np.ndarray:
     """Equal-length interleaved host split.
@@ -134,6 +150,13 @@ class ImageFolderDataset(_Dataset):
         key = path.parent.name
         if key in self.class_map:
             return str(self.class_map[key])
+        if _WNID_RE.fullmatch(key):
+            # ImageNet-style wnid directory names caption out of the box
+            # via the shipped class map (the reference vendors the same
+            # mapping as `dalle_pytorch/imagenet.json`, `loader.py:43-54`)
+            name = _imagenet_class_map().get(key)
+            if name:
+                return name
         return key.replace("_", " ").replace("-", " ").strip()
 
     def get(self, i: int) -> Tuple[str, np.ndarray]:

@@ -39,18 +39,23 @@ except ImportError:  # pragma: no cover
 
 @lru_cache()
 def _byte_unicode_table() -> dict:
-    """Reversible byte -> printable-unicode mapping (GPT-2/CLIP scheme)."""
+    """Reversible byte -> printable-unicode mapping (GPT-2/CLIP scheme).
+
+    Insertion order matters beyond the mapping itself: the CLIP vocabulary
+    lists the printable bytes first (in codepoint order) and the remapped
+    non-printables after, and single-symbol token ids are positions in that
+    list — so this dict iterates in CLIP vocab order, not byte order
+    (verified byte-exact by tests/test_tokenizer_goldens.py).
+    """
     printable = (
         list(range(ord("!"), ord("~") + 1))
         + list(range(ord("\xa1"), ord("\xac") + 1))
         + list(range(ord("\xae"), ord("\xff") + 1))
     )
-    mapping = {}
+    mapping = {b: chr(b) for b in printable}
     extra = 0
     for b in range(256):
-        if b in printable:
-            mapping[b] = chr(b)
-        else:
+        if b not in mapping:
             mapping[b] = chr(256 + extra)
             extra += 1
     return mapping
@@ -203,6 +208,11 @@ class SimpleTokenizer(_TokenizerBase):
     def encode(self, text: str) -> List[int]:
         ids: List[int] = []
         for word in re.findall(self.pattern, _clean_text(text).lower()):
+            if word in ("<|startoftext|>", "<|endoftext|>"):
+                # control tokens pass through whole (the pattern matches
+                # them as single words; they must not be byte-BPE'd)
+                ids.append(self.token_to_id[word])
+                continue
             mapped = "".join(self.byte_to_unicode[b] for b in word.encode("utf-8"))
             ids.extend(self.token_to_id[p] for p in self._bpe(mapped))
         return ids
@@ -353,23 +363,38 @@ def get_tokenizer(
         return HugTokenizer(bpe_path)
     if bpe_path:
         return SimpleTokenizer(bpe_path)
-    # No flags: use the shipped 8k-token native BPE vocabulary (the
-    # analogue of the reference's vendored CLIP vocab, `tokenizer.py:64-68`)
-    # — trained by scripts/train_default_vocab.py and committed to the repo.
-    default_model = Path(__file__).parent / "default_bpe_8k.model"
-    if default_model.exists():
+    # No flags: use the shipped native BPE vocabulary (the analogue of the
+    # reference's vendored CLIP vocab, `tokenizer.py:64-68`) — trained by
+    # scripts/train_default_vocab.py and committed to the repo. Discovery is
+    # by glob so any regenerated default_bpe_<N>k.model is picked up;
+    # largest vocabulary wins (the CLIP-scale 32k model over the lighter 8k
+    # fallback kept for fast tests).
+    def _vocab_k(p: Path) -> int:
+        try:
+            return int(p.stem[len("default_bpe_"):].rstrip("k"))
+        except ValueError:
+            return 0
+
+    existing = sorted(
+        Path(__file__).parent.glob("default_bpe_*.model"),
+        key=_vocab_k, reverse=True,
+    )
+    for default_model in existing:
         try:
             return NativeBPETokenizer(default_model)
-        except Exception as e:  # e.g. no C++ toolchain to build the backend
+        except Exception as e:  # e.g. no C++ toolchain, corrupt model file
             warnings.warn(
-                f"default BPE vocabulary found but unusable ({e}); falling "
-                "back to the 257-symbol ByteTokenizer",
+                f"default BPE vocabulary {default_model.name} unusable ({e}); "
+                "trying the next candidate" if default_model != existing[-1]
+                else f"default BPE vocabulary {default_model.name} unusable "
+                f"({e}); falling back to the 257-symbol ByteTokenizer",
                 stacklevel=2,
             )
-    else:
+    if not existing:
         warnings.warn(
             "no default BPE vocabulary "
-            f"({default_model} missing — run scripts/train_default_vocab.py); "
+            f"(no {Path(__file__).parent}/default_bpe_*.model — run "
+            "scripts/train_default_vocab.py); "
             "falling back to the 257-symbol ByteTokenizer, which trains "
             "byte-level models only",
             stacklevel=2,

@@ -9,7 +9,12 @@ public-domain/permissive English prose (Python stdlib docstrings, installed
 package METADATA/README text, Debian copyright files) — committed as
 `dalle_pytorch_tpu/data/default_bpe_8k.model` (~100 KB).
 
-Rerun to regenerate:  python scripts/train_default_vocab.py
+Rerun to regenerate:  python scripts/train_default_vocab.py [vocab_size]
+
+`vocab_size` defaults to 8192 -> `default_bpe_8k.model`; pass 32768 to
+regenerate the CLIP-scale `default_bpe_32k.model` (preferred by
+`get_tokenizer()` when present), which also widens the corpus with
+docstring prose from installed site-packages (numpy/scipy/jax etc.).
 """
 
 from __future__ import annotations
@@ -57,6 +62,34 @@ def stdlib_docstrings(limit_files: int = 400) -> list[str]:
     return out
 
 
+def site_packages_docstrings(cap_bytes: int = 30_000_000) -> list[str]:
+    """Docstring prose from installed packages (numpy/scipy/jax etc.).
+
+    Only used for the 32k vocabulary: the 8k corpus alone is too small to
+    support 32k distinct merges without a long tail of junk tokens.
+    """
+    out, total = [], 0
+    roots = sorted(glob.glob(os.path.join(sys.prefix, "lib/*/site-packages/*/")))
+    for root in roots:
+        for f in sorted(Path(root).rglob("*.py")):
+            try:
+                tree = ast.parse(f.read_text(errors="ignore"))
+            except (SyntaxError, OSError, ValueError):
+                continue
+            for node in ast.walk(tree):
+                if isinstance(
+                    node,
+                    (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+                ):
+                    doc = ast.get_docstring(node)
+                    if doc and len(doc) > 60:
+                        out.append(doc)
+                        total += len(doc)
+            if total > cap_bytes:
+                return out
+    return out
+
+
 def package_metadata(cap_bytes: int = 4_000_000) -> list[str]:
     """Long-description prose from installed package METADATA files."""
     out, total = [], 0
@@ -96,6 +129,10 @@ def debian_copyright(cap_files: int = 60) -> list[str]:
 
 
 def main():
+    vocab_size = int(sys.argv[1]) if len(sys.argv) > 1 else VOCAB_SIZE
+    out = (
+        REPO / "dalle_pytorch_tpu" / "data" / f"default_bpe_{vocab_size // 1024}k.model"
+    )
     parts = []
     caps = rainbow_captions()
     # repeat the captions so the target domain outweighs incidental prose
@@ -106,18 +143,22 @@ def main():
     parts.extend(meta)
     deb = debian_copyright()
     parts.extend(deb)
+    sp: list[str] = []
+    if vocab_size > 16384:
+        sp = site_packages_docstrings()
+        parts.extend(sp)
     corpus = "\n".join(parts)
     print(
         f"corpus: {len(caps)} captions x20, {len(docs)} docstrings, "
-        f"{len(meta)} package bodies, {len(deb)} copyright files "
-        f"-> {len(corpus) / 1e6:.1f} MB"
+        f"{len(meta)} package bodies, {len(deb)} copyright files, "
+        f"{len(sp)} site-package docstrings -> {len(corpus) / 1e6:.1f} MB"
     )
 
     from dalle_pytorch_tpu.data.native_bpe import NativeBPE
 
-    bpe = NativeBPE.train(corpus, vocab_size=VOCAB_SIZE)
-    bpe.save(OUT)
-    print(f"trained vocab_size={bpe.vocab_size} -> {OUT} ({OUT.stat().st_size} bytes)")
+    bpe = NativeBPE.train(corpus, vocab_size=vocab_size)
+    bpe.save(out)
+    print(f"trained vocab_size={bpe.vocab_size} -> {out} ({out.stat().st_size} bytes)")
 
     # smoke: round-trip a caption and some prose
     for text in [caps[0], "a quick brown fox jumps over the lazy dog"]:

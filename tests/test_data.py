@@ -69,13 +69,14 @@ class TestSimpleTokenizer:
         assert tok.vocab_size == 512 + 5 + 2
 
     def test_get_tokenizer_dispatch(self, bpe_file):
-        # no flags -> the shipped 8k default vocab (round-3: no more silent
-        # ByteTokenizer degradation)
+        # no flags -> the shipped CLIP-scale 32k default vocab (8k is the
+        # fallback when the 32k model is absent; no silent ByteTokenizer
+        # degradation either way)
         from dalle_pytorch_tpu.data.tokenizer import NativeBPETokenizer
 
         default = get_tokenizer()
         assert isinstance(default, NativeBPETokenizer)
-        assert default.vocab_size == 8192
+        assert default.vocab_size == 32768
         ids = default.tokenize("small red circle", context_length=8)
         assert default.decode(ids[0]) == "small red circle"
         assert isinstance(get_tokenizer(bpe_path=str(bpe_file)), SimpleTokenizer)
@@ -149,6 +150,29 @@ class TestFolderDataset:
         ds = ImageFolderDataset(str(image_folder), class_name_json=str(mapping))
         caps = {ds.get(i)[0] for i in range(len(ds))}
         assert "crimson objects" in caps
+
+    def test_imagenet_wnid_dirs_caption_out_of_the_box(self, tmp_path):
+        # wnid-named class dirs resolve through the shipped
+        # data/imagenet_classes.json with no --class_name_json flag
+        # (reference vendors the same mapping, `loader.py:43-54`)
+        from PIL import Image
+
+        for wnid in ("n01440764", "n01443537"):
+            d = tmp_path / wnid
+            d.mkdir(parents=True)
+            Image.new("RGB", (8, 8), (0, 128, 0)).save(d / "x.png")
+        ds = ImageFolderDataset(str(tmp_path))
+        caps = {ds.get(i)[0] for i in range(len(ds))}
+        assert caps == {"tench", "goldfish"}
+
+    def test_unknown_wnid_falls_back_to_dir_name(self, tmp_path):
+        from PIL import Image
+
+        d = tmp_path / "n99999999"
+        d.mkdir(parents=True)
+        Image.new("RGB", (8, 8), (0, 0, 0)).save(d / "x.png")
+        ds = ImageFolderDataset(str(tmp_path))
+        assert ds.get(0)[0] == "n99999999"
 
     def test_pipeline_batches(self, image_folder):
         ds = TextImageDataset(

@@ -216,3 +216,70 @@ class TestOpenAIConverter:
         np.testing.assert_array_equal(
             np.asarray(jnp.argmax(logits, -1).reshape(1, -1)), np.asarray(ref.reshape(1, -1))
         )
+
+# ------------------------------------------------- released geometry (f/8)
+
+
+@pytest.mark.slow
+class TestReleasedGeometry:
+    """Structural golden at the published dall_e geometry.
+
+    The toy tests above prove the conversion math at vocab 32; this pins
+    the importer to the released model shape — n_hid 256, 4 groups x 2
+    blocks (so post_gain 1/64), vocab 8192, decoder n_init 128, 2x3
+    output channels — so a naming/structural mismatch against the real
+    encoder.pkl/decoder.pkl state-dict layout fails here rather than at
+    load time (`/root/reference/dalle_pytorch/vae.py:111-157`). The real
+    *weights* cannot be fetched in this egress-less environment
+    (documented limitation, BASELINE.md); spatial extent is reduced to
+    32px — state-dict structure is resolution-independent.
+    """
+
+    @pytest.fixture(scope="class")
+    def released(self, tmp_path_factory):
+        torch.manual_seed(0)
+        cache = tmp_path_factory.mktemp("openai_vae_full")
+        enc = TEncoder(n_hid=256, vocab=8192, groups=4, blk=2)
+        dec = TDecoder(n_hid=256, n_init=128, vocab=8192, groups=4, blk=2)
+        torch.save(enc.state_dict(), cache / "encoder.pkl")
+        torch.save(dec.state_dict(), cache / "decoder.pkl")
+        from dalle_pytorch_tpu.models.vae_io import OpenAIDiscreteVAE as V
+
+        return V(cache_dir=cache), enc, dec
+
+    def test_inferred_geometry(self, released):
+        v, enc, _ = released
+        assert v.num_tokens == 8192
+        assert v.num_layers == 3  # f/8: three maxpools between four groups
+        # released channel progression: input conv 256, groups 256/512/1024/2048
+        sd = enc.state_dict()
+        assert sd["blocks.input.w"].shape == (256, 3, 7, 7)
+        assert sd["blocks.group_4.block_1.res_path.conv_4.w"].shape[0] == 2048
+
+    def test_released_state_dict_parity(self, released):
+        v, enc, dec = released
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(1, 32, 32, 3).astype(np.float32)
+        with torch.no_grad():
+            x = torch.from_numpy(
+                np.asarray(v.map_pixels(imgs)).transpose(0, 3, 1, 2)
+            )
+            golden = torch.argmax(enc(x), dim=1).flatten(1).numpy()
+        ours = np.asarray(v.get_codebook_indices(jnp.asarray(imgs)))
+        assert ours.shape == golden.shape == (1, 16)  # 32px / f8 = 4x4
+        agree = (ours == golden).mean()
+        assert agree > 0.9, f"only {agree:.0%} of indices agree with torch"
+
+        seq = rng.randint(0, 8192, (1, 16)).astype(np.int32)
+        with torch.no_grad():
+            import torch.nn.functional as TF
+
+            z = TF.one_hot(torch.from_numpy(seq).long(), num_classes=8192)
+            z = z.view(1, 4, 4, 8192).permute(0, 3, 1, 2).float()
+            out = torch.sigmoid(dec(z)[:, :3])
+            golden_img = np.asarray(
+                v.unmap_pixels(jnp.asarray(out.permute(0, 2, 3, 1).numpy()))
+            )
+        ours_img = np.asarray(v.decode(jnp.asarray(seq)))
+        assert ours_img.shape == (1, 32, 32, 3)
+        np.testing.assert_allclose(ours_img, golden_img, rtol=1e-3, atol=1e-3)

@@ -1,0 +1,92 @@
+"""Byte-exact CLIP-BPE parity for SimpleTokenizer.
+
+The fixture `tests/fixtures/clip_bpe_goldens.json` holds token ids
+produced by the published OpenAI-CLIP BPE algorithm (as vendored by the
+reference, `/root/reference/dalle_pytorch/tokenizer.py:55-152`) over the
+standard `bpe_simple_vocab_16e6.txt` merges file, with ftfy text-fixing
+as identity (every fixture string is already clean text — ftfy is absent
+in this environment for both implementations, so the comparison is
+apples-to-apples).
+
+These goldens caught two real divergences when first introduced: the
+vocabulary must list printable byte symbols before the remapped
+non-printables (ids are positions in that list), and the control tokens
+<|startoftext|>/<|endoftext|> must bypass byte-BPE entirely.
+
+Regenerating the fixture requires a CLIP-format merges file; the golden
+ids themselves are environment-independent facts about the published
+vocabulary, so the fixture is committed.
+"""
+
+import json
+from pathlib import Path
+
+import pytest
+
+FIXTURE = Path(__file__).parent / "fixtures" / "clip_bpe_goldens.json"
+# the standard 262k-line CLIP merges file; vendored by the reference but
+# not by this repo (3 MB, and this environment has no egress to fetch it)
+VOCAB_CANDIDATES = [
+    Path("/root/reference/dalle_pytorch/data/bpe_simple_vocab_16e6.txt"),
+    Path.home() / ".cache" / "dalle" / "bpe_simple_vocab_16e6.txt",
+]
+
+vocab_path = next((p for p in VOCAB_CANDIDATES if p.exists()), None)
+
+pytestmark = pytest.mark.skipif(
+    vocab_path is None,
+    reason="no CLIP bpe_simple_vocab_16e6.txt available on this machine",
+)
+
+
+@pytest.fixture(scope="module")
+def simple_tokenizer():
+    from dalle_pytorch_tpu.data.tokenizer import SimpleTokenizer
+
+    return SimpleTokenizer(vocab_path)
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    return json.loads(FIXTURE.read_text(encoding="utf8"))
+
+
+class TestClipBpeGoldens:
+    def test_vocab_size(self, simple_tokenizer, goldens):
+        assert simple_tokenizer.vocab_size == goldens["vocab_size"] == 49408
+
+    def test_control_token_ids(self, simple_tokenizer):
+        # fixed positions at the end of the 49,408-token vocabulary
+        assert simple_tokenizer.sot == 49406
+        assert simple_tokenizer.eot == 49407
+
+    def test_encode_byte_exact(self, simple_tokenizer, goldens):
+        for case in goldens["cases"]:
+            got = simple_tokenizer.encode(case["text"])
+            assert got == case["ids"], (
+                f"tokenization of {case['text']!r} diverged from the "
+                f"published CLIP BPE: want {case['ids']}, got {got}"
+            )
+
+    def test_decode_round_trip(self, simple_tokenizer, goldens):
+        # decode(encode(x)) recovers the cleaned, lowercased text for
+        # word-and-space cases; punctuation does NOT round-trip exactly
+        # because every end-of-word marker becomes a space (reference
+        # decode behaves identically, `tokenizer.py:105-110`)
+        checked = 0
+        for case in goldens["cases"]:
+            text = case["text"]
+            if not text or not text.replace(" ", "").isalnum() or not text.isascii():
+                continue
+            cleaned = " ".join(text.split()).strip().lower()
+            assert simple_tokenizer.decode(case["ids"]) == cleaned
+            checked += 1
+        assert checked >= 3  # the fixture keeps several such cases
+
+    def test_tokenize_packs_and_truncates(self, simple_tokenizer):
+        arr = simple_tokenizer.tokenize(["a cat", "a dog"], context_length=8)
+        assert arr.shape == (2, 8) and arr.dtype.name == "int32"
+        with pytest.raises(RuntimeError, match="too long"):
+            simple_tokenizer.tokenize(
+                "a very long caption about a cat", context_length=2
+            )
