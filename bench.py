@@ -62,6 +62,11 @@ def main():
     remat_policy = os.environ.get("BENCH_REMAT_POLICY", "none")
     remat_policy = None if remat_policy == "none" else remat_policy
     attn_impl = os.environ.get("BENCH_ATTN", "auto")
+    # comma list, e.g. "full,axial_row,axial_col,conv_like" — cycled over
+    # layers like the reference's attn_types; masked types run dense with
+    # per-layer pattern masks (scan executor scans them over depth)
+    attn_types = os.environ.get("BENCH_ATTN_TYPES")
+    attn_types = tuple(attn_types.split(",")) if attn_types else None
     fused_ce = os.environ.get("BENCH_FUSED_CE", "0") == "1"
     # "scan" compiles ONE layer body instead of `depth` copies — ~12x
     # smaller program; the tunneled backend has died mid-compile on the
@@ -75,6 +80,7 @@ def main():
         num_image_tokens=8192, image_fmap_size=fmap,
         num_text_tokens=10000, text_seq_len=text_seq,
         shift_tokens=True, rotary_emb=True, attn_impl=attn_impl,
+        attn_types=attn_types,
         reversible=remat, reversible_impl="remat", remat_policy=remat_policy,
         fused_ce=fused_ce, executor=executor,
         dtype=jnp.bfloat16,
@@ -170,6 +176,7 @@ def main():
         "n_chips": n_chips,
         "config": (
             f"dim{dim}-depth{depth}-seq{seq}-gbs{batch}-accum{accum}-{attn_impl}"
+            f"{'-types=' + ','.join(attn_types) if attn_types else ''}"
             f"-remat{int(remat)}{'-' + remat_policy if remat_policy else ''}"
             f"{'-fusedce' if fused_ce else ''}"
             f"{'-scan' if executor == 'scan' else ''}-bf16"

@@ -383,11 +383,14 @@ def get_tokenizer(
         try:
             return NativeBPETokenizer(default_model)
         except Exception as e:  # e.g. no C++ toolchain, corrupt model file
+            next_step = (
+                "trying the next candidate"
+                if default_model != existing[-1]
+                else "falling back to the 257-symbol ByteTokenizer"
+            )
             warnings.warn(
-                f"default BPE vocabulary {default_model.name} unusable ({e}); "
-                "trying the next candidate" if default_model != existing[-1]
-                else f"default BPE vocabulary {default_model.name} unusable "
-                f"({e}); falling back to the 257-symbol ByteTokenizer",
+                f"default BPE vocabulary {default_model.name} unusable "
+                f"({e}); {next_step}",
                 stacklevel=2,
             )
     if not existing:

@@ -112,7 +112,23 @@ class Attention(nn.Module):
         rotary: Optional[jnp.ndarray] = None,
         cache: Optional[dict] = None,
         deterministic: bool = True,
+        mask_array: Optional[jnp.ndarray] = None,
     ):
+        """`mask_array`: a TRACED [S, S] bool pattern mask (True = attend),
+        the per-layer scanned-input analogue of the host-side `static_mask`
+        attribute — used by the scan executor, where each layer's pattern
+        arrives as data rather than a compile-time constant. Dense,
+        uncached path only (a traced mask cannot drive flash's host-side
+        block-occupancy skipping)."""
+        if mask_array is not None:
+            assert cache is None, "mask_array is for the uncached path only"
+            assert self.static_mask is None, (
+                "pass either the static_mask attribute or mask_array, not both"
+            )
+            assert self.attn_impl not in ("flash", "lib_flash", "ring"), (
+                f'attn_impl="{self.attn_impl}" cannot apply a traced pattern '
+                "mask; scan executor uses dense for masked layers"
+            )
         b, n, _ = x.shape
         h, dh = self.heads, self.dim_head
         inner = h * dh
@@ -177,7 +193,7 @@ class Attention(nn.Module):
                 out = ring_attention_sharded(
                     self.sp_mesh, q, k, v, causal=self.causal
                 )
-            elif self._use_flash(n, key_mask):
+            elif mask_array is None and self._use_flash(n, key_mask):
                 if self.attn_impl == "lib_flash":
                     out = lib_flash_attention(q, k, v, causal=self.causal)
                 else:
@@ -189,6 +205,9 @@ class Attention(nn.Module):
             else:
                 mask = self._full_mask(n, n)
                 mask = None if mask is None else jnp.asarray(mask)[None, None]
+                if mask_array is not None:
+                    tm = mask_array[:n, :n][None, None]
+                    mask = tm if mask is None else (mask & tm)
                 if key_mask is not None:
                     km = key_mask[:, None, None, :]
                     mask = km if mask is None else (mask & km)

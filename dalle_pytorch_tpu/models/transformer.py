@@ -39,9 +39,10 @@ Layer executors (orthogonal to the reversible memory modes):
     parameters — the HLO contains ONE layer body instead of `depth`
     copies, so programs compile ~depth× faster (load-bearing here: the
     tunneled TPU backend has repeatedly died mid-compile on the unrolled
-    flagship program) at identical runtime math. Restricted to uniform
-    full attention with no cross-layer sharing; cached decode converts
-    the checkpoint to the unrolled layout via `scan_params_to_unrolled`.
+    flagship program) at identical runtime math. Attn-type cycling runs
+    as dense attention with per-layer pattern masks scanned over depth;
+    no cross-layer sharing; cached decode converts the checkpoint to the
+    unrolled layout via `scan_params_to_unrolled`.
 """
 
 from __future__ import annotations
@@ -172,7 +173,14 @@ class _ScanBlock(nn.Module):
     dtype: Any
 
     @nn.compact
-    def __call__(self, x, attn_scale, ff_scale, key_mask, rotary):
+    def __call__(self, x, attn_scale, ff_scale, pattern_idx, pattern_table,
+                 key_mask, rotary):
+        # pattern_idx is the scanned per-layer index into the broadcast
+        # table of unique [S, S] pattern masks; None = uniform full attention
+        pattern_mask = (
+            None if pattern_table is None else pattern_table[pattern_idx]
+        )
+
         def shift(h):
             if not self.shift_tokens:
                 return h
@@ -193,7 +201,7 @@ class _ScanBlock(nn.Module):
             dtype=self.dtype,
             name="attn",
         )(shift(h), key_mask=key_mask, rotary=rotary,
-          deterministic=self.deterministic)
+          deterministic=self.deterministic, mask_array=pattern_mask)
         if self.sandwich_norm:
             h = nn.LayerNorm(dtype=self.dtype, name="norm_attn_out")(h)
         x = x + h * attn_scale.astype(h.dtype)
@@ -223,8 +231,9 @@ class _ScanStack(nn.Module):
     remat_policy: Optional[str]
 
     @nn.compact
-    def __call__(self, x, attn_scales, ff_scales, key_mask, rotary,
-                 reverse: bool = False, deterministic: bool = True):
+    def __call__(self, x, attn_scales, ff_scales, pattern_idx, pattern_table,
+                 key_mask, rotary, reverse: bool = False,
+                 deterministic: bool = True):
         body = _ScanBlock
         if self.remat:
             policy = (
@@ -234,18 +243,25 @@ class _ScanStack(nn.Module):
             )
             # prevent_cse=False is safe (and recommended) under scan
             body = nn.remat(body, policy=policy, prevent_cse=False)
+        # attn-type cycling: each layer picks its pattern mask from the
+        # broadcast table of UNIQUE masks via a scanned [depth] index;
+        # None (uniform full attention) broadcasts through
+        idx_axis = nn.broadcast if pattern_idx is None else 0
         scanned = nn.scan(
             body,
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
-            in_axes=(0, 0, nn.broadcast, nn.broadcast),
+            in_axes=(0, 0, idx_axis, nn.broadcast, nn.broadcast, nn.broadcast),
             length=self.depth,
             reverse=reverse,
         )
         stack = scanned(
             deterministic=deterministic, name="layers", **self.block_kwargs
         )
-        x, _ = stack(x, attn_scales, ff_scales, key_mask, rotary)
+        x, _ = stack(
+            x, attn_scales, ff_scales, pattern_idx, pattern_table, key_mask,
+            rotary,
+        )
         return x
 
 
@@ -280,15 +296,23 @@ class Transformer(nn.Module):
     attn_impl: str = "auto"  # "dense" | "flash" | "ring" | "auto"
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
     # "unrolled" | "scan" — see module docstring. "scan" compiles one layer
-    # body instead of `depth` copies; requires uniform full attention, no
-    # shared ids, no revnet, uncached calls only.
+    # body instead of `depth` copies; masked attn types run as dense with
+    # depth-stacked scanned pattern masks. No shared ids, no revnet,
+    # uncached calls only.
     executor: str = "unrolled"
     dtype: Any = jnp.float32
 
     def _scan_supported(self) -> Optional[str]:
         """None if the scan executor can run this config, else the reason."""
         if self.attn_types and any(t != "full" for t in self.attn_types):
-            return f"non-uniform attn_types {tuple(self.attn_types)}"
+            # masked attn types run as dense + per-layer pattern masks
+            # scanned over depth; flash/lib_flash need host-side masks for
+            # block skipping, so they cannot take the scanned (traced) ones
+            if self.attn_impl in ("flash", "lib_flash"):
+                return (
+                    f'attn_impl="{self.attn_impl}" with masked attn_types '
+                    "(scanned pattern masks are traced; use dense/auto)"
+                )
         if self.shared_attn_ids or self.shared_ff_ids:
             return "cross-layer weight sharing"
         if self.reversible and self.reversible_impl != "remat":
@@ -418,6 +442,34 @@ class Transformer(nn.Module):
         depth, dim = self.depth, self.dim
         self.rotary_table = self._build_rotary_table()
         self.text_len = self._derived_text_len()
+
+        # attn-type cycling: per-layer pattern masks served from a table of
+        # UNIQUE masks plus a scanned per-layer index — cycling repeats the
+        # same few [S, S] patterns (sparse is per-layer-seeded, so it stays
+        # per-layer), and a depth-stacked copy of each would cost
+        # depth/n_types more device memory for no information. Builders may
+        # return [S+1, S+1] or block-padded sizes; crop uniformly to [S, S].
+        attn_types = tuple(self.attn_types) if self.attn_types else ("full",)
+        type_per_layer = list(islice(cycle(attn_types), depth))
+        if any(t != "full" for t in type_per_layer):
+            S = self.seq_len
+            table, index_of, idx = [], {}, []
+            for ind, t in enumerate(type_per_layer):
+                m = _build_static_mask(t, S, self.image_fmap_size, ind)
+                if m is None:
+                    m = np.ones((S, S), dtype=bool)
+                else:
+                    m = np.asarray(m)[:S, :S]
+                key = m.tobytes()
+                if key not in index_of:
+                    index_of[key] = len(table)
+                    table.append(m)
+                idx.append(index_of[key])
+            self.scan_pattern_table = jnp.asarray(np.stack(table))
+            self.scan_pattern_idx = jnp.asarray(np.array(idx, np.int32))
+        else:
+            self.scan_pattern_table = None
+            self.scan_pattern_idx = None
 
         def stacked_scale_init(key, shape):
             del key  # deterministic depth-dependent init (layerscale_init)
@@ -623,6 +675,8 @@ class Transformer(nn.Module):
                 x,
                 self.attn_scales_stacked,
                 self.ff_scales_stacked,
+                self.scan_pattern_idx,
+                self.scan_pattern_table,
                 key_mask,
                 self.rotary_table,
                 reverse=reverse_model,

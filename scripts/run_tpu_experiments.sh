@@ -63,5 +63,9 @@ run bench_host_input 1200 BENCH_INPUT=host BENCH_ATTN=flash BENCH_REMAT_POLICY=d
 run bench_scan_b32   1200 BENCH_BATCH=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 # jax library TPU flash kernel in the full train step (vs in-repo flash)
 run bench_scan_libflash 1200 BENCH_EXECUTOR=scan BENCH_ATTN=lib_flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+# sparse attn-type cycle (the reference's axial/conv pattern) under the
+# scan executor: dense + depth-stacked pattern masks — is masked-dense
+# cheaper than full dense at seq 1280 on chip?
+run bench_scan_axial 1200 BENCH_EXECUTOR=scan BENCH_ATTN=dense BENCH_ATTN_TYPES=full,axial_row,axial_col,conv_like BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 
 echo "results -> $OUT" >&2
