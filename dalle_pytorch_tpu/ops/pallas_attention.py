@@ -105,67 +105,67 @@ def _fwd_kernel(
     causal: bool,
     has_mask: bool,
     n_real_k: int,
+    nk_blocks: int,
 ):
+    """Grid (b, h, qi, ki): the q block stays put over the inner ki steps
+    while [block_k, d] k/v tiles stream through (auto double-buffered), so
+    VMEM holds one tile of each operand regardless of sequence length. The
+    online-softmax state (m, l, acc) carries across ki in fp32 VMEM
+    scratch and the normalized output flushes on the last step."""
     if has_mask:
-        layout_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref = refs
+        (layout_ref, q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
+         m_ref, l_ref, acc_ref) = refs
     else:
         layout_ref = mask_ref = None
-        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
 
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [bq, d]
-    bq, d = q.shape
-    n_k_pad = k_ref.shape[2]
-    nk_blocks = n_k_pad // block_k
+    ki = pl.program_id(3)
+    bq = q_ref.shape[2]
 
-    def attend(ki, m, l, acc):
-        start = ki * block_k
-        kb = k_ref[0, 0, pl.ds(start, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(start, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    if causal and not has_mask:
+        # block-triangle cut: k blocks strictly above the diagonal never run
+        live = ki * block_k <= (qi + 1) * bq - 1
+    elif has_mask:
+        live = layout_ref[qi, ki] != 0
+    else:
+        live = True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [bq, d]
+        kb = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        vb = v_ref[0, 0].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        col = start + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        col = ki * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
         if causal and not has_mask:
             row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             s = jnp.where(row >= col, s, NEG_INF)
         if has_mask:
-            mb = mask_ref[:, pl.ds(start, block_k)]
-            s = jnp.where(mb, s, NEG_INF)
+            s = jnp.where(mask_ref[...], s, NEG_INF)
         if n_real_k % block_k != 0:  # mask key padding
             s = jnp.where(col < n_real_k, s, NEG_INF)
+        m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.dot(
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
             p.astype(vb.dtype), vb, preferred_element_type=jnp.float32
         )
-        return m_new, l, acc
 
-    def body(ki, carry):
-        m, l, acc = carry
-        if has_mask:
-            return lax.cond(
-                layout_ref[qi, ki] != 0,
-                lambda c: attend(ki, *c),
-                lambda c: c,
-                (m, l, acc),
-            )
-        return attend(ki, m, l, acc)
-
-    if causal and not has_mask:
-        # block-triangle cut: k blocks strictly above the diagonal never run
-        hi = lax.min(((qi + 1) * bq + block_k - 1) // block_k, nk_blocks)
-    else:
-        hi = nk_blocks
-
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = lax.fori_loop(0, hi, body, (m0, l0, acc0))
-
-    safe_l = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(safe_l)  # [bq, 1]
+    @pl.when(ki == nk_blocks - 1)
+    def _flush():
+        safe_l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[...] + jnp.log(safe_l)  # [bq, 1]
 
 
 def _flash_forward(
@@ -175,6 +175,7 @@ def _flash_forward(
     b, h, n_q, d = q.shape
     n_k = k.shape[2]
     nq_blocks = n_q // block_q
+    nk_blocks = n_k // block_k
     has_mask = mask_pad is not None
 
     kernel = functools.partial(
@@ -184,33 +185,41 @@ def _flash_forward(
         causal=causal,
         has_mask=has_mask,
         n_real_k=n_real_k,
+        nk_blocks=nk_blocks,
     )
-    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0))
-    kspec = pl.BlockSpec((1, 1, n_k, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
     in_specs = [qspec, kspec, kspec]
     operands = [q, k, v]
     if has_mask:
         in_specs = [
             pl.BlockSpec(memory_space=pltpu.SMEM),  # layout, whole array
             *in_specs,
-            pl.BlockSpec((block_q, n_k), lambda b_, h_, i: (i, 0)),
+            pl.BlockSpec((block_q, block_k), lambda b_, h_, i, j: (i, j)),
         ]
         operands = [layout, q, k, v, mask_pad]
 
     o, lse = pl.pallas_call(
         kernel,
-        grid=(b, h, nq_blocks),
+        grid=(b, h, nq_blocks, nk_blocks),
         in_specs=in_specs,
         out_specs=[
             qspec,
-            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, n_q, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, n_q, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            dimension_semantics=(
+                "parallel", "parallel", "parallel", "arbitrary",
+            ),
         ),
         interpret=interpret,
     )(*operands)
@@ -221,120 +230,131 @@ def _flash_forward(
 
 
 def _dq_kernel(
-    *refs, sm_scale, block_k, causal, has_mask, n_real_k,
+    *refs, sm_scale, block_k, causal, has_mask, n_real_k, nk_blocks,
 ):
+    """Grid (b, h, qi, ki): the q block stays put over the inner ki steps
+    while [block_k, d] k/v tiles stream through — VMEM holds one tile of
+    each operand regardless of sequence length (the previous revision gave
+    every program instance the ENTIRE K/V, which scales VMEM with n_k).
+    dq accumulates in an fp32 VMEM scratch across ki and flushes on the
+    last step."""
     if has_mask:
-        layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, dq_ref = refs
+        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         mask_ref, dq_ref, acc_ref) = refs
     else:
         layout_ref = mask_ref = None
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref = refs
 
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]  # [bq, 1]
-    delta = delta_ref[0, 0]
-    bq, d = q.shape
-    nk_blocks = k_ref.shape[2] // block_k
+    ki = pl.program_id(3)
 
-    def attend(ki, dq):
-        start = ki * block_k
-        kb = k_ref[0, 0, pl.ds(start, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(start, block_k), :].astype(jnp.float32)
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    bq = q_ref.shape[2]
+    if causal and not has_mask:
+        # k blocks strictly above the block triangle contribute nothing
+        live = ki * block_k <= (qi + 1) * bq - 1
+    elif has_mask:
+        live = layout_ref[qi, ki] != 0
+    else:
+        live = True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # [bq, 1]
+        delta = delta_ref[0, 0]
+        kb = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        vb = v_ref[0, 0].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * sm_scale
-        col = start + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        col = ki * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
         if causal and not has_mask:
             row = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
             s = jnp.where(row >= col, s, NEG_INF)
         if has_mask:
-            mb = mask_ref[:, pl.ds(start, block_k)]
-            s = jnp.where(mb, s, NEG_INF)
+            s = jnp.where(mask_ref[...], s, NEG_INF)
         if n_real_k % block_k != 0:
             s = jnp.where(col < n_real_k, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        return dq + jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+        acc_ref[...] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
 
-    def body(ki, dq):
-        if has_mask:
-            return lax.cond(
-                layout_ref[qi, ki] != 0, lambda a: attend(ki, a), lambda a: a, dq
-            )
-        return attend(ki, dq)
-
-    if causal and not has_mask:
-        hi = lax.min(((qi + 1) * bq + block_k - 1) // block_k, nk_blocks)
-    else:
-        hi = nk_blocks
-
-    dq = lax.fori_loop(0, hi, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(ki == nk_blocks - 1)
+    def _flush():
+        dq_ref[0, 0] = acc_ref[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(
-    *refs, sm_scale, block_q, causal, has_mask, n_real_q, n_real_k, block_k,
+    *refs, sm_scale, block_q, causal, has_mask, n_real_q, n_real_k,
+    block_k, nq_blocks,
 ):
+    """Grid (b, h, ki, qi): the k/v blocks stay put over the inner qi steps
+    while [block_q, d] q/do tiles stream through (bounded VMEM — see
+    `_dq_kernel`). dk/dv accumulate in fp32 VMEM scratch across qi and
+    flush on the last step."""
     if has_mask:
-        layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref, dk_ref, dv_ref = refs
+        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         mask_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
     else:
         layout_ref = mask_ref = None
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref = refs
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_acc, dv_acc) = refs
 
     ki = pl.program_id(2)
-    kb = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
-    vb = v_ref[0, 0].astype(jnp.float32)
-    bk, d = kb.shape
-    nq_blocks = q_ref.shape[2] // block_q
-    col = ki * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
+    qi = pl.program_id(3)
 
-    def attend(qi, dk, dv):
-        start = qi * block_q
-        qb = q_ref[0, 0, pl.ds(start, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, 0, pl.ds(start, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(start, block_q), :]  # [bq, 1]
-        delta = delta_ref[0, 0, pl.ds(start, block_q), :]
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    bk = k_ref.shape[2]
+    if causal and not has_mask:
+        # q blocks strictly below the k-block diagonal start never attend
+        live = qi >= (ki * bk) // block_q
+    elif has_mask:
+        live = layout_ref[qi, ki] != 0
+    else:
+        live = True
+
+    @pl.when(live)
+    def _attend():
+        kb = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        vb = v_ref[0, 0].astype(jnp.float32)
+        qb = q_ref[0, 0].astype(jnp.float32)  # [bq, d]
+        dob = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]  # [bq, 1]
+        delta = delta_ref[0, 0]
+        col = ki * bk + lax.broadcasted_iota(jnp.int32, (block_q, bk), 1)
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * sm_scale
         if causal and not has_mask:
-            row = start + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            row = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0
+            )
             s = jnp.where(row >= col, s, NEG_INF)
         if has_mask:
-            mb = mask_ref[pl.ds(start, block_q), :]
-            s = jnp.where(mb, s, NEG_INF)
+            s = jnp.where(mask_ref[...], s, NEG_INF)
         if n_real_k % bk != 0:
             s = jnp.where(col < n_real_k, s, NEG_INF)
         if n_real_q % block_q != 0:  # padded q rows have garbage lse: drop them
-            row = start + lax.broadcasted_iota(jnp.int32, (block_q, bk), 0)
+            row = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, bk), 0
+            )
             s = jnp.where(row < n_real_q, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
+        dv_acc[...] += jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
-        dk = dk + jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_acc[...] += jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
 
-    def body(qi, carry):
-        dk, dv = carry
-        if has_mask:
-            return lax.cond(
-                layout_ref[qi, ki] != 0,
-                lambda c: attend(qi, *c),
-                lambda c: c,
-                (dk, dv),
-            )
-        return attend(qi, dk, dv)
-
-    if causal and not has_mask:
-        # q blocks strictly below the k-block diagonal start never attend here
-        lo = (ki * bk) // block_q
-    else:
-        lo = 0
-
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = lax.fori_loop(lo, nq_blocks, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq_blocks - 1)
+    def _flush():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(
@@ -350,58 +370,82 @@ def _flash_backward(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )
 
-    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0))
-    qfull = pl.BlockSpec((1, 1, n_q, d), lambda b_, h_, i: (b_, h_, 0, 0))
-    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i: (b_, h_, i, 0))
-    kfull = pl.BlockSpec((1, 1, n_k, d), lambda b_, h_, i: (b_, h_, 0, 0))
-    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i: (b_, h_, i, 0))
-    rowfull = pl.BlockSpec((1, 1, n_q, 1), lambda b_, h_, i: (b_, h_, 0, 0))
+    nq_blocks = n_q // block_q
+    nk_blocks = n_k // block_k
 
-    # dq: grid over q blocks
-    dq_in = [qspec, kfull, kfull, qspec, rowspec, rowspec]
+    # Both passes run a 4D grid with the reduction as the INNER dimension
+    # and fp32 VMEM scratch carrying the accumulator across its steps; every
+    # operand arrives as one [block, d] tile per step (auto double-buffered
+    # by Pallas), so VMEM use is flat in sequence length — the previous
+    # revision's whole-K/V ("kfull") BlockSpecs scaled VMEM with n_k and
+    # became hostile at exactly the long sequences flash exists for.
+
+    # dq: grid (b, h, qi, ki) — q-indexed tiles ignore ki, k-indexed use ki
+    qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
+    dq_in = [qspec, kspec, kspec, qspec, rowspec, rowspec]
     dq_ops = [q, k, v, do, lse, delta]
     if has_mask:
         dq_in = [
             pl.BlockSpec(memory_space=pltpu.SMEM),
             *dq_in,
-            pl.BlockSpec((block_q, n_k), lambda b_, h_, i: (i, 0)),
+            pl.BlockSpec((block_q, block_k), lambda b_, h_, i, j: (i, j)),
         ]
         dq_ops = [layout, *dq_ops, mask_pad]
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, block_k=block_k, causal=causal,
-            has_mask=has_mask, n_real_k=n_real_k,
+            has_mask=has_mask, n_real_k=n_real_k, nk_blocks=nk_blocks,
         ),
-        grid=(b, h, n_q // block_q),
+        grid=(b, h, nq_blocks, nk_blocks),
         in_specs=dq_in,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "parallel", "parallel", "arbitrary",
+            ),
+        ),
         interpret=interpret,
     )(*dq_ops)
 
-    # dk/dv: grid over k blocks
-    dkv_in = [qfull, kspec, kspec, qfull, rowfull, rowfull]
+    # dk/dv: grid (b, h, ki, qi) — k-indexed tiles ignore qi
+    kspec2 = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, i, 0))
+    qspec2 = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    rowspec2 = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, j, 0))
+    dkv_in = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
     dkv_ops = [q, k, v, do, lse, delta]
     if has_mask:
         dkv_in = [
             pl.BlockSpec(memory_space=pltpu.SMEM),
             *dkv_in,
-            pl.BlockSpec((n_q, block_k), lambda b_, h_, i: (0, i)),
+            pl.BlockSpec((block_q, block_k), lambda b_, h_, i, j: (j, i)),
         ]
         dkv_ops = [layout, *dkv_ops, mask_pad]
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, block_q=block_q, causal=causal,
             has_mask=has_mask, n_real_q=n_real_q, n_real_k=n_real_k,
-            block_k=block_k,
+            block_k=block_k, nq_blocks=nq_blocks,
         ),
-        grid=(b, h, n_k // block_k),
+        grid=(b, h, nk_blocks, nq_blocks),
         in_specs=dkv_in,
-        out_specs=[kspec, kspec],
+        out_specs=[kspec2, kspec2],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(
+                "parallel", "parallel", "parallel", "arbitrary",
+            ),
+        ),
         interpret=interpret,
     )(*dkv_ops)
     return dq, dk, dv

@@ -19,10 +19,14 @@ Run (CPU ok for small settings):
 from __future__ import annotations
 
 import argparse
+import sys
 import time
 from pathlib import Path
 
 import numpy as np
+
+# runnable as `python examples/rainbow_dalle.py` without installing
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
 def parse_args():
@@ -169,11 +173,27 @@ def main():
     test_idx = list(range(n_train, min(n_train + args.eval_samples, len(data))))
     tr_exact, tr_tok, sampled = exact_accuracy(train_idx)
     report = f"train: exact {tr_exact:.2f}, per-token {tr_tok:.3f}"
+    te_exact = te_tok = None
     if test_idx:
         te_exact, te_tok, _ = exact_accuracy(test_idx)
         report += f" | test: exact {te_exact:.2f}, per-token {te_tok:.3f}"
     print(report)
     print("(reference notebook bar at convergence: exact 1.0 train / ~0.3 test)")
+    # machine-readable line for the TPU experiment matrix
+    # (scripts/run_tpu_experiments.sh greps '^{')
+    import json
+
+    print(json.dumps({
+        "metric": "rainbow_convergence",
+        "num_samples": len(data),
+        "dalle_steps": args.dalle_steps,
+        "train_exact": round(tr_exact, 4),
+        "train_per_token": round(tr_tok, 4),
+        "test_exact": None if te_exact is None else round(te_exact, 4),
+        "test_per_token": None if te_tok is None else round(te_tok, 4),
+        "device": jax.devices()[0].device_kind,
+        "notebook_bar": "train exact 1.0 / test ~0.3",
+    }))
 
     gen = vae.apply({"params": vstate.params}, jnp.asarray(sampled),
                     method=DiscreteVAE.decode)

@@ -68,4 +68,11 @@ run bench_scan_libflash 1200 BENCH_EXECUTOR=scan BENCH_ATTN=lib_flash BENCH_REMA
 # cheaper than full dense at seq 1280 on chip?
 run bench_scan_axial 1200 BENCH_EXECUTOR=scan BENCH_ATTN=dense BENCH_ATTN_TYPES=full,axial_row,axial_col,conv_like BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 
+# 6. notebook-scale rainbow convergence (VERDICT r3 weak #8: the CPU
+# proxy is 16 samples; the reference notebook bar is 1.0 train exact at
+# ~9k samples). Last in the matrix: longest and least perf-critical.
+run rainbow_convergence 2400 python examples/rainbow_dalle.py \
+    --num-samples 9216 --vae-steps 1500 --dalle-steps 4000 \
+    --batch-size 64 --eval-samples 64 --out-dir rainbow_tpu_out
+
 echo "results -> $OUT" >&2
