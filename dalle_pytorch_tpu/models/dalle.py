@@ -204,10 +204,8 @@ class DALLE(nn.Module):
             out = self.norm_by_max(out)
         out = self.logits_norm(out)
         if self.share_input_output_emb:
-            kernel = jnp.concatenate(
-                [self.text_emb.embedding, self.image_emb.embedding], axis=0
-            ).astype(out.dtype)
-            return out @ kernel.T + self.logits_bias.astype(out.dtype)
+            kernel, bias = self._logits_kernel()
+            return out @ kernel.astype(out.dtype) + bias.astype(out.dtype)
         return self.logits_dense(out)
 
     def embed_text(self, text: jnp.ndarray, null_cond_prob: float = 0.0):
@@ -238,20 +236,7 @@ class DALLE(nn.Module):
         less HBM traffic per flagship step (BASELINE.md)."""
         from dalle_pytorch_tpu.ops.losses import chunked_masked_ce, split_weighted_mean
 
-        assert image is not None, "when training, image must be supplied"
-        if self.stable:
-            out = self.norm_by_max(out)
-        h = self.logits_norm(out)
-        if self.share_input_output_emb:
-            kernel = jnp.concatenate(
-                [self.text_emb.embedding, self.image_emb.embedding], axis=0
-            ).T
-            bias = self.logits_bias
-        else:
-            kernel = self.variables["params"]["logits_dense"]["kernel"]
-            bias = self.variables["params"]["logits_dense"].get("bias")
-
-        offsetted_image = image + self.total_text_tokens
+        h, kernel, bias, offsetted_image = self._fused_head(out, image)
         labels = jnp.concatenate([text[:, 1:], offsetted_image], axis=1)
         split = self.text_seq_len
         row_is_text = jnp.arange(seq_len) < self.text_seq_len
@@ -264,6 +249,71 @@ class DALLE(nn.Module):
         ci = self.loss_img_weight if self.img_loss_coeff is None else self.img_loss_coeff
         loss = split_weighted_mean(per_pos, split, ct, ci)
         return loss, None
+
+    def _logits_kernel(self):
+        """(kernel [D, V], bias [V] or None) of the logits head, shared by
+        both fused-CE paths."""
+        if self.share_input_output_emb:
+            kernel = jnp.concatenate(
+                [self.text_emb.embedding, self.image_emb.embedding], axis=0
+            ).T
+            return kernel, self.logits_bias
+        p = self.variables["params"]["logits_dense"]
+        return p["kernel"], p.get("bias")
+
+    def _fused_head(self, out, image):
+        """Shared fused-CE prologue: normalized head input + logits kernel
+        + vocab-offset image labels. Keeping it in one place keeps the two
+        objectives' numerics in lockstep with the dense path."""
+        assert image is not None, "when training, image must be supplied"
+        if self.stable:
+            out = self.norm_by_max(out)
+        h = self.logits_norm(out)
+        kernel, bias = self._logits_kernel()
+        return h, kernel, bias, image + self.total_text_tokens
+
+    def _fused_inverse_loss(self, out, text, image, seq_len):
+        """Inverse-mode (image->text) split CE via the vocab-chunked kernel.
+
+        Numerics match the dense inverse path (tests/test_dalle.py parity):
+        image-first row layout, the fork's drop-last-image-position quirk
+        (`:686-687`), inverse loss coefficients, and the 3-token sequence
+        accuracy — the argmax needs real logits, but only for THREE text
+        positions, so a tiny [B, 3, V] dense block replaces the full
+        [B, N, V] materialization."""
+        from dalle_pytorch_tpu.ops.losses import chunked_masked_ce, split_weighted_mean
+
+        h, kernel, bias, offsetted_image = self._fused_head(out, image)
+        labels = jnp.concatenate([offsetted_image[:, 1:], text], axis=1)
+        split = self.image_seq_len
+        # image-first layout: rows >= image_seq_len are text rows
+        row_is_text = jnp.arange(seq_len) >= split
+        per_pos = chunked_masked_ce(
+            h, kernel, bias, labels,
+            row_is_text=row_is_text,
+            num_text_vocab=self.total_text_tokens,
+        )
+        ci, ct = self.img_loss_coeff_inv, self.text_loss_coeff_inv
+        loss = split_weighted_mean(per_pos, split, ci, ct, drop_last_of_first=True)
+
+        # 3-token sequence accuracy (`:697-699`) on dense logits for rows
+        # [split, split+3) only — text rows, where every image-vocab column
+        # is blocked anyway, so only the text-vocab kernel slice is needed
+        h3 = h[:, split : split + 3]
+        logits3 = jnp.einsum(
+            "bnd,dv->bnv", h3,
+            kernel[:, : self.total_text_tokens].astype(h3.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        if bias is not None:
+            logits3 = logits3 + bias[: self.total_text_tokens].astype(jnp.float32)
+        pred3 = jnp.argmax(logits3, axis=-1)
+        accuracy = jnp.mean(
+            jnp.all(
+                pred3 == labels[:, split : split + 3], axis=-1
+            ).astype(jnp.float32)
+        )
+        return loss, accuracy
 
     def __call__(
         self,
@@ -304,15 +354,13 @@ class DALLE(nn.Module):
             tokens, reverse_model=reverse_model, deterministic=deterministic
         )
 
-        if (
-            return_loss
-            and self.fused_ce
-            and not inverse_mapping
-            and not self.is_initializing()
-        ):
+        if return_loss and self.fused_ce and not self.is_initializing():
             # vocab-chunked CE: never materializes [B, N, V] logits
-            # (ops/losses.py); init and the inverse objective (which needs
-            # full logits for its accuracy argmax) take the dense path
+            # (ops/losses.py); init takes the dense path. The inverse
+            # objective's 3-token accuracy argmax uses a [B, 3, V] dense
+            # block instead of full logits.
+            if inverse_mapping:
+                return self._fused_inverse_loss(out, text, image, seq_len)
             return self._fused_forward_loss(out, text, image, seq_len)
 
         logits = self.to_logits(out)

@@ -292,6 +292,19 @@ class TestFusedCE:
     """Vocab-chunked CE (ops/losses.py) must match the dense loss path
     bit-for-bit in semantics: same loss, same grads."""
 
+    @staticmethod
+    def _assert_grad_parity(g_dense, g_fused, atol=2e-5, label=""):
+        flat_d = {jax.tree_util.keystr(k): v
+                  for k, v in jax.tree_util.tree_leaves_with_path(g_dense)}
+        flat_f = {jax.tree_util.keystr(k): v
+                  for k, v in jax.tree_util.tree_leaves_with_path(g_fused)}
+        assert flat_d.keys() == flat_f.keys()
+        for k in flat_d:
+            np.testing.assert_allclose(
+                np.asarray(flat_d[k]), np.asarray(flat_f[k]), atol=atol,
+                err_msg=f"{label}grad mismatch at {k}",
+            )
+
     def _pair(self, share_emb=False):
         kw = dict(
             dim=32, depth=2, heads=2, dim_head=16, num_image_tokens=48,
@@ -325,33 +338,40 @@ class TestFusedCE:
         )
         g_dense = jax.grad(loss_of(dense))(params)
         g_fused = jax.grad(loss_of(fused))(params)
-        flat_d = {jax.tree_util.keystr(k): v
-                  for k, v in jax.tree_util.tree_leaves_with_path(g_dense)}
-        flat_f = {jax.tree_util.keystr(k): v
-                  for k, v in jax.tree_util.tree_leaves_with_path(g_fused)}
-        assert flat_d.keys() == flat_f.keys()
-        for k in flat_d:
-            np.testing.assert_allclose(
-                np.asarray(flat_d[k]), np.asarray(flat_f[k]), atol=2e-5,
-                err_msg=f"grad mismatch at {k}",
-            )
+        self._assert_grad_parity(g_dense, g_fused)
 
-    def test_fused_inverse_falls_back(self):
-        """Inverse objective needs full logits (accuracy argmax) — the
-        fused flag must not change its results."""
-        dense, fused = self._pair()
+    @pytest.mark.parametrize("share_emb", [False, True])
+    def test_fused_inverse_parity(self, share_emb):
+        """The fused inverse path (vocab-chunked CE + [B,3,V] dense
+        accuracy block) must match the dense inverse path: same loss,
+        same 3-token accuracy, same grads."""
+        dense, fused = self._pair(share_emb)
         rng = jax.random.PRNGKey(0)
         text = jax.random.randint(rng, (2, 12), 1, 60)
         image = jax.random.randint(rng, (2, 16), 0, 48)
         params = dense.init(rng, text, image)["params"]
+
+        def loss_of(model):
+            def f(p):
+                loss, _ = model.apply(
+                    {"params": p}, text, image, return_loss=True,
+                    inverse_mapping=True,
+                )
+                return loss
+            return f
+
         ld, accd = dense.apply(
             {"params": params}, text, image, return_loss=True, inverse_mapping=True
         )
         lf, accf = fused.apply(
             {"params": params}, text, image, return_loss=True, inverse_mapping=True
         )
-        np.testing.assert_allclose(float(ld), float(lf), rtol=1e-6)
+        np.testing.assert_allclose(float(ld), float(lf), rtol=2e-5)
         np.testing.assert_allclose(float(accd), float(accf), rtol=1e-6)
+
+        g_dense = jax.grad(loss_of(dense))(params)
+        g_fused = jax.grad(loss_of(fused))(params)
+        self._assert_grad_parity(g_dense, g_fused, label="inverse ")
 
     def test_chunk_boundary_labels(self):
         """Labels on chunk edges (0, chunk-1, chunk, V-1) gather correctly."""
