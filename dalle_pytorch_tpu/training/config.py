@@ -88,8 +88,9 @@ class DalleConfig:
     # below AUTO_FLASH_MIN_SEQ, flash above; ring when mesh.sp > 1)
     attn_impl: str = "auto"
     # layer executor: "unrolled" | "scan" (nn.scan over depth-stacked
-    # params — ~depth× smaller program/compile; uniform full attention,
-    # no shared ids; checkpoints auto-convert for cached decode)
+    # params — ~depth× smaller program/compile; masked attn_types run as
+    # dense + scanned pattern masks, no shared ids; checkpoints
+    # auto-convert for cached decode)
     executor: str = "unrolled"
 
     def attn_types_tuple(self) -> Tuple[str, ...]:
