@@ -162,6 +162,45 @@ class TestCliEndToEnd:
         pngs = list((tmp_path / "outputs").rglob("[0-9].png"))
         assert len(pngs) == 4
 
+    def test_clip_flow(self, tmp_path):
+        """train_clip.py CLI -> clip.npz -> generate.py --clip_path rerank
+        (the reference's CLIP reranking loop,
+        `/root/reference/dalle_pytorch/dalle_pytorch.py:569-571`)."""
+        vae_path = _tiny_vae_ckpt(tmp_path)
+        run_cli(
+            "train_dalle.py", "--image_text_folder", "rainbow:32",
+            "--vae_path", str(vae_path),
+            "--epochs", "1", "--batch_size", "8",
+            "--set", "model.dim=64", "--set", "model.depth=1",
+            "--set", "model.heads=2", "--set", "model.dim_head=16",
+            "--set", "model.text_seq_len=32", "--set", "bf16=false",
+            "--set", "log_images_freq=0",
+            "--set", "debug=true", cwd=tmp_path,
+        )
+        run_cli(
+            "train_clip.py", "--image_text_folder", "rainbow:32",
+            "--epochs", "1", "--batch_size", "8",
+            "--image_size", "16", "--patch_size", "8",
+            "--text_seq_len", "32", "--dim", "32", "--dim_latent", "16",
+            "--depth", "1", "--heads", "2",
+            "--output", str(tmp_path / "clip.npz"), "--debug", cwd=tmp_path,
+        )
+        assert (tmp_path / "clip.npz").exists()
+        out = run_cli(
+            "generate.py", "--dalle_path",
+            str(tmp_path / "checkpoints" / "dalle.npz"),
+            "--clip_path", str(tmp_path / "clip.npz"),
+            "--text", "small red circle", "--num_images", "2",
+            "--batch_size", "2",
+            "--outputs_dir", str(tmp_path / "outputs"), cwd=tmp_path,
+        )
+        # the rerank branch actually ran (a silently-skipped --clip_path
+        # would still produce PNGs, so file existence alone proves nothing)
+        assert "clip scores (best first):" in out
+        pngs = list((tmp_path / "outputs").rglob("[0-9].png"))
+        assert len(pngs) == 2
+        assert list((tmp_path / "outputs").rglob("grid.png"))
+
     def test_wds_training(self, tmp_path):
         """train_dalle.py straight from tar shards (the reference's --wds
         path, `/root/reference/train_dalle.py:257-278,309-313`) — guards
