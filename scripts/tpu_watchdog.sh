@@ -1,6 +1,9 @@
 #!/bin/bash
-# Polls the tunneled TPU; the moment a probe matmul succeeds, runs the
-# round-3 experiment matrix once and exits. Detach with:
+# Polls the tunneled TPU; each time a probe matmul succeeds, runs the
+# experiment matrix, then RE-ARMS (up to WATCHDOG_MAX_RUNS) — a tunnel
+# that flaps mid-matrix gets its remaining rows on the next window
+# instead of wasting it (the summarizer dedupes repeated rows, best
+# result wins). Detach with:
 #   nohup setsid bash scripts/tpu_watchdog.sh > watchdog.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
@@ -8,14 +11,24 @@ PROBE='import jax, jax.numpy as jnp; x = jnp.ones((8,8)) @ jnp.ones((8,8)); prin
 
 echo "[watchdog] started $(date -u +%H:%M:%S)"
 DEADLINE=$(( $(date +%s) + ${WATCHDOG_MAX_S:-18000} ))  # stop polling after 5h
+RUNS=0
+MAX_RUNS=${WATCHDOG_MAX_RUNS:-3}
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
-        echo "[watchdog] tunnel recovered at $(date -u +%H:%M:%S); running matrix"
+        RUNS=$((RUNS + 1))
+        echo "[watchdog] tunnel up at $(date -u +%H:%M:%S); matrix run $RUNS/$MAX_RUNS"
         bash scripts/run_tpu_experiments.sh TPU_RESULTS.jsonl
-        echo "[watchdog] matrix done at $(date -u +%H:%M:%S)"
-        exit 0
+        echo "[watchdog] matrix run $RUNS done at $(date -u +%H:%M:%S)"
+        if [ "$RUNS" -ge "$MAX_RUNS" ]; then
+            echo "[watchdog] max runs reached; exiting"
+            exit 0
+        fi
+        # brief cool-down, then keep polling: if the tunnel died mid-run
+        # the next window re-runs the matrix (null rows get another shot)
+        sleep 120
+    else
+        echo "[watchdog] $(date -u +%H:%M:%S) tunnel still down"
+        sleep 240
     fi
-    echo "[watchdog] $(date -u +%H:%M:%S) tunnel still down"
-    sleep 240
 done
 echo "[watchdog] giving up at $(date -u +%H:%M:%S) (deadline reached)"
