@@ -15,6 +15,11 @@ RUNS=0
 MAX_RUNS=${WATCHDOG_MAX_RUNS:-3}
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     if timeout 90 python -c "$PROBE" 2>/dev/null | grep -q PROBE_OK; then
+        if [ "$RUNS" -ge 1 ] && ! grep -q '"result": null' TPU_RESULTS.jsonl 2>/dev/null; then
+            # previous run completed every row — nothing left to retry
+            echo "[watchdog] matrix complete (no null rows); exiting"
+            exit 0
+        fi
         RUNS=$((RUNS + 1))
         echo "[watchdog] tunnel up at $(date -u +%H:%M:%S); matrix run $RUNS/$MAX_RUNS"
         bash scripts/run_tpu_experiments.sh TPU_RESULTS.jsonl
@@ -23,8 +28,8 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
             echo "[watchdog] max runs reached; exiting"
             exit 0
         fi
-        # brief cool-down, then keep polling: if the tunnel died mid-run
-        # the next window re-runs the matrix (null rows get another shot)
+        # brief cool-down, then keep polling: a run truncated by a tunnel
+        # death leaves null rows, which the next window retries
         sleep 120
     else
         echo "[watchdog] $(date -u +%H:%M:%S) tunnel still down"

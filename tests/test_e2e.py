@@ -201,6 +201,34 @@ class TestCliEndToEnd:
         assert len(pngs) == 2
         assert list((tmp_path / "outputs").rglob("grid.png"))
 
+    def test_taming_vqgan_flow(self, tmp_path):
+        """train_dalle.py --taming (host-side VQGAN encode, reference
+        `train_dalle.py:139-186` precedence) -> generate.py rebuilding the
+        VQGAN from the checkpoint's stored config paths."""
+        from test_vqgan import make_taming_ckpt
+
+        _, vq_ckpt, vq_yaml = make_taming_ckpt(tmp_path)
+        run_cli(
+            "train_dalle.py", "--image_text_folder", "rainbow:32",
+            "--taming", "--epochs", "1", "--batch_size", "8",
+            "--set", f"vqgan_model_path={vq_ckpt}",
+            "--set", f"vqgan_config_path={vq_yaml}",
+            "--set", "model.dim=64", "--set", "model.depth=1",
+            "--set", "model.heads=2", "--set", "model.dim_head=16",
+            "--set", "model.text_seq_len=16", "--set", "bf16=false",
+            "--set", "truncate_captions=true", "--set", "log_images_freq=0",
+            "--set", "debug=true", cwd=tmp_path,
+        )
+        ckpt = tmp_path / "checkpoints" / "dalle.npz"
+        assert ckpt.exists()
+        run_cli(
+            "generate.py", "--dalle_path", str(ckpt),
+            "--text", "small red circle", "--num_images", "1",
+            "--batch_size", "1",
+            "--outputs_dir", str(tmp_path / "outputs"), cwd=tmp_path,
+        )
+        assert list((tmp_path / "outputs").rglob("grid.png"))
+
     def test_wds_training(self, tmp_path):
         """train_dalle.py straight from tar shards (the reference's --wds
         path, `/root/reference/train_dalle.py:257-278,309-313`) — guards

@@ -227,11 +227,12 @@ class TVQGAN(nn.Module):
 # ------------------------------------------------------------------ fixtures
 
 
-@pytest.fixture(scope="module")
-def ckpt(tmp_path_factory):
-    torch.manual_seed(0)
+def make_taming_ckpt(d, seed=0):
+    """Write a toy-geometry taming checkpoint + config into dir `d`;
+    returns (torch model, ckpt path, config path). Shared with the CLI
+    e2e taming flow (tests/test_e2e.py)."""
+    torch.manual_seed(seed)
     model = TVQGAN().eval()
-    d = tmp_path_factory.mktemp("vqgan")
     torch.save({"state_dict": model.state_dict()}, d / "model.ckpt")
     config = {
         "model": {
@@ -240,6 +241,13 @@ def ckpt(tmp_path_factory):
         }
     }
     (d / "config.yaml").write_text(yaml.safe_dump(config))
+    return model, d / "model.ckpt", d / "config.yaml"
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    d = tmp_path_factory.mktemp("vqgan")
+    model, _, _ = make_taming_ckpt(d)
     return model, d
 
 
