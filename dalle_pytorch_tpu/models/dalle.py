@@ -440,6 +440,7 @@ def init_decode_cache(model: DALLE, batch: int, dtype=None) -> dict:
         image_fmap_size=model.image_fmap_size,
         shift_tokens=model.shift_tokens,
         dtype=model.dtype if dtype is None else dtype,
+        executor=model.executor,
     )
 
 

@@ -41,8 +41,9 @@ Layer executors (orthogonal to the reversible memory modes):
     tunneled TPU backend has repeatedly died mid-compile on the unrolled
     flagship program) at identical runtime math. Attn-type cycling runs
     as dense attention with per-layer pattern masks scanned over depth;
-    no cross-layer sharing; cached decode converts the checkpoint to the
-    unrolled layout via `scan_params_to_unrolled`.
+    no cross-layer sharing. KV-cached decode is native (the depth-stacked
+    cache rides the layer scan as scanned input and output); only masked
+    attn-type checkpoints need `scan_params_to_unrolled` for decode.
 """
 
 from __future__ import annotations
@@ -145,6 +146,23 @@ def _build_static_mask(
     raise ValueError(f'attention type "{attn_type}" is not valid')
 
 
+def shift_with_ring(h, ring, pos, text_len, fmap):
+    """Token-shift dispatch shared by both executors' cached paths.
+
+    ring None: pure batch shift (uncached). Prefill (n > 1, necessarily
+    from position 0): batch shift + build the ring from trailing tokens.
+    Single-token decode: streaming shift at traced position `pos`.
+    Returns (shifted h, new ring or None).
+    """
+    if ring is None:
+        return shift_tokens_dalle(h, text_len, fmap), None
+    if h.shape[1] > 1:
+        return shift_tokens_dalle(h, text_len, fmap), shift_ring_from_prefill(
+            h, fmap
+        )
+    return shift_token_step(h, ring, pos, text_len, fmap)
+
+
 class _ScanBlock(nn.Module):
     """One (attn, ff) residual pair in scannable form.
 
@@ -174,20 +192,25 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_scale, ff_scale, pattern_idx, pattern_table,
-                 key_mask, rotary):
+                 cache, key_mask, rotary):
         # pattern_idx is the scanned per-layer index into the broadcast
         # table of unique [S, S] pattern masks; None = uniform full attention
         pattern_mask = (
             None if pattern_table is None else pattern_table[pattern_idx]
         )
+        cached = cache is not None
+        pos = cache["attn"]["index"] if cached else None
 
-        def shift(h):
+        def shift(h, ring):
             if not self.shift_tokens:
-                return h
-            return shift_tokens_dalle(h, self.text_len, self.image_fmap_size)
+                return h, None
+            return shift_with_ring(
+                h, ring, pos, self.text_len, self.image_fmap_size
+            )
 
         h = nn.LayerNorm(dtype=self.dtype, name="norm_attn")(x)
-        h, _ = Attention(
+        h, ring_attn = shift(h, cache.get("shift_attn") if cached else None)
+        h, attn_cache = Attention(
             dim=self.dim,
             seq_len=self.seq_len,
             heads=self.heads,
@@ -200,21 +223,30 @@ class _ScanBlock(nn.Module):
             sp_mesh=self.sp_mesh,
             dtype=self.dtype,
             name="attn",
-        )(shift(h), key_mask=key_mask, rotary=rotary,
+        )(h, key_mask=key_mask, rotary=rotary,
+          cache=cache["attn"] if cached else None,
           deterministic=self.deterministic, mask_array=pattern_mask)
         if self.sandwich_norm:
             h = nn.LayerNorm(dtype=self.dtype, name="norm_attn_out")(h)
         x = x + h * attn_scale.astype(h.dtype)
 
         h = nn.LayerNorm(dtype=self.dtype, name="norm_ff")(x)
+        h, ring_ff = shift(h, cache.get("shift_ff") if cached else None)
         h = FeedForward(
             dim=self.dim, mult=self.ff_mult, dropout=self.ff_dropout,
             dtype=self.dtype, name="ff",
-        )(shift(h), deterministic=self.deterministic)
+        )(h, deterministic=self.deterministic)
         if self.sandwich_norm:
             h = nn.LayerNorm(dtype=self.dtype, name="norm_ff_out")(h)
         x = x + h * ff_scale.astype(h.dtype)
-        return x, None
+
+        if not cached:
+            return x, None
+        new_cache = {"attn": attn_cache}
+        if self.shift_tokens:
+            new_cache["shift_attn"] = ring_attn
+            new_cache["shift_ff"] = ring_ff
+        return x, new_cache
 
 
 class _ScanStack(nn.Module):
@@ -232,10 +264,10 @@ class _ScanStack(nn.Module):
 
     @nn.compact
     def __call__(self, x, attn_scales, ff_scales, pattern_idx, pattern_table,
-                 key_mask, rotary, reverse: bool = False,
+                 key_mask, rotary, cache=None, reverse: bool = False,
                  deterministic: bool = True):
         body = _ScanBlock
-        if self.remat:
+        if self.remat and cache is None:
             policy = (
                 getattr(jax.checkpoint_policies, self.remat_policy)
                 if self.remat_policy
@@ -245,23 +277,29 @@ class _ScanStack(nn.Module):
             body = nn.remat(body, policy=policy, prevent_cse=False)
         # attn-type cycling: each layer picks its pattern mask from the
         # broadcast table of UNIQUE masks via a scanned [depth] index;
-        # None (uniform full attention) broadcasts through
+        # None (uniform full attention) broadcasts through. The decode
+        # cache (depth-stacked leaves) is scanned in AND collected back
+        # out as the scan's per-layer output.
         idx_axis = nn.broadcast if pattern_idx is None else 0
+        cache_axis = nn.broadcast if cache is None else 0
         scanned = nn.scan(
             body,
             variable_axes={"params": 0},
             split_rngs={"params": True, "dropout": True},
-            in_axes=(0, 0, idx_axis, nn.broadcast, nn.broadcast, nn.broadcast),
+            in_axes=(0, 0, idx_axis, nn.broadcast, cache_axis, nn.broadcast,
+                     nn.broadcast),
             length=self.depth,
             reverse=reverse,
         )
         stack = scanned(
             deterministic=deterministic, name="layers", **self.block_kwargs
         )
-        x, _ = stack(
-            x, attn_scales, ff_scales, pattern_idx, pattern_table, key_mask,
-            rotary,
+        x, new_cache = stack(
+            x, attn_scales, ff_scales, pattern_idx, pattern_table, cache,
+            key_mask, rotary,
         )
+        if cache is not None:
+            return x, new_cache
         return x
 
 
@@ -297,8 +335,8 @@ class Transformer(nn.Module):
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
     # "unrolled" | "scan" — see module docstring. "scan" compiles one layer
     # body instead of `depth` copies; masked attn types run as dense with
-    # depth-stacked scanned pattern masks. No shared ids, no revnet,
-    # uncached calls only.
+    # depth-stacked scanned pattern masks; cached decode is native
+    # (uniform full attention only). No shared ids, no revnet.
     executor: str = "unrolled"
     dtype: Any = jnp.float32
 
@@ -508,22 +546,10 @@ class Transformer(nn.Module):
         )
 
     def _shift(self, h: jnp.ndarray, ring, pos):
-        """Token-shift h; in cached mode also maintain the ring buffer.
-
-        Uncached (ring is None): pure batch shift. Cached prefill (n > 1,
-        necessarily from position 0): batch shift + build the ring from the
-        trailing tokens. Cached decode (n == 1): streaming shift at traced
-        position `pos`.
-        """
-        fmap = self.image_fmap_size
-        assert fmap is not None
-        if ring is None:
-            return shift_tokens_dalle(h, self.text_len, fmap), None
-        if h.shape[1] > 1:
-            return shift_tokens_dalle(h, self.text_len, fmap), shift_ring_from_prefill(
-                h, fmap
-            )
-        return shift_token_step(h, ring, pos, self.text_len, fmap)
+        """Token-shift h; in cached mode also maintain the ring buffer
+        (see `shift_with_ring` — shared with the scan executor)."""
+        assert self.image_fmap_size is not None
+        return shift_with_ring(h, ring, pos, self.text_len, self.image_fmap_size)
 
     def _half_attn(self, i, x, key_mask, layer_cache, deterministic=True):
         """Attention half-block f (norm → shift → attn → [sandwich] → scale),
@@ -665,11 +691,11 @@ class Transformer(nn.Module):
         deterministic: bool = True,
     ):
         if self.executor == "scan":
-            if cache is not None:
+            if cache is not None and self.scan_pattern_table is not None:
                 raise ValueError(
-                    'executor="scan" has no cached-decode path; convert the '
-                    "checkpoint with scan_params_to_unrolled() and decode "
-                    "with the default executor"
+                    'executor="scan" cached decode supports uniform full '
+                    "attention only (pattern masks are traced scanned "
+                    "inputs; the cached path cannot row-slice them)"
                 )
             return self.scan_stack(
                 x,
@@ -679,6 +705,7 @@ class Transformer(nn.Module):
                 self.scan_pattern_table,
                 key_mask,
                 self.rotary_table,
+                cache=cache,
                 reverse=reverse_model,
                 deterministic=deterministic,
             )
@@ -748,6 +775,7 @@ class Transformer(nn.Module):
             image_fmap_size=self.image_fmap_size,
             shift_tokens=self.shift_tokens,
             dtype=dtype,
+            executor=self.executor,
         )
 
 
@@ -810,12 +838,33 @@ def make_decode_cache(
     image_fmap_size: Optional[int] = None,
     shift_tokens: bool = False,
     dtype=jnp.float32,
+    executor: str = "unrolled",
 ) -> dict:
     """Decode cache pytree for a Transformer of this geometry.
 
     Standalone (not a module method) so model owners like DALLE can build
-    it from config without binding parameters.
+    it from config without binding parameters. The unrolled executor
+    takes per-layer dicts ("layer_{i}"); the scan executor takes the same
+    leaves depth-stacked along axis 0 (they ride the layer scan as
+    scanned inputs/outputs).
     """
+    if executor == "scan":
+        cache = {
+            "attn": {
+                "k": jnp.zeros((depth, batch, heads, max_len, dim_head), dtype),
+                "v": jnp.zeros((depth, batch, heads, max_len, dim_head), dtype),
+                "index": jnp.zeros((depth,), jnp.int32),
+            }
+        }
+        if shift_tokens:
+            assert image_fmap_size is not None
+            cache["shift_attn"] = jnp.zeros(
+                (depth, batch, image_fmap_size, dim), dtype
+            )
+            cache["shift_ff"] = jnp.zeros(
+                (depth, batch, image_fmap_size, dim), dtype
+            )
+        return cache
     cache = {}
     for i in range(depth):
         layer = {

@@ -89,8 +89,8 @@ class DalleConfig:
     attn_impl: str = "auto"
     # layer executor: "unrolled" | "scan" (nn.scan over depth-stacked
     # params — ~depth× smaller program/compile; masked attn_types run as
-    # dense + scanned pattern masks, no shared ids; checkpoints
-    # auto-convert for cached decode)
+    # dense + scanned pattern masks, no shared ids; cached decode is
+    # native for uniform full attention, masked checkpoints auto-convert)
     executor: str = "unrolled"
 
     def attn_types_tuple(self) -> Tuple[str, ...]:

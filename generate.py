@@ -85,10 +85,13 @@ def main():
         # the mesh sp axis); KV-cached decode never runs it, so a
         # ring-trained checkpoint generates with the dense/auto kernel
         cfg.model.attn_impl = "auto"
-    if getattr(cfg.model, "executor", "unrolled") == "scan":
-        # the scan executor is a training-time compile optimization; its
-        # depth-stacked checkpoint converts losslessly to the unrolled
-        # layout, which owns the KV-cached decode path
+    if (
+        getattr(cfg.model, "executor", "unrolled") == "scan"
+        and any(t != "full" for t in cfg.model.attn_types_tuple())
+    ):
+        # scan cached decode is uniform-full-attention only (pattern masks
+        # are scanned inputs); masked checkpoints convert losslessly to
+        # the unrolled layout, whose cached path row-slices static masks
         from dalle_pytorch_tpu.models.transformer import scan_params_to_unrolled
 
         dalle_params = dict(dalle_params)

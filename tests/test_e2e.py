@@ -372,9 +372,9 @@ class TestAttnImplCli:
 
     def test_train_with_scan_executor_and_generate(self, tmp_path):
         """2 steps with --set model.executor=scan (depth-stacked nn.scan
-        params), then generate.py from that checkpoint: the cached
-        decoder must auto-convert the stacked params to the unrolled
-        layout."""
+        params), then generate.py from that checkpoint: the scan
+        executor's native KV-cached decode runs directly on the stacked
+        params (no layout conversion)."""
         vae_path = _tiny_vae_ckpt(tmp_path)
         run_cli(
             "train_dalle.py", "--image_text_folder", "rainbow:16",
