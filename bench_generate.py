@@ -34,13 +34,17 @@ def main():
     fmap = int(os.environ.get("GEN_FMAP", "32"))
     runs = int(os.environ.get("GEN_RUNS", "5"))
     cond_scale = float(os.environ.get("GEN_COND_SCALE", "1.0"))
+    # "scan" decodes natively on the depth-stacked layout: one compiled
+    # layer body, the smallest decode program through a fragile tunnel
+    executor = os.environ.get("GEN_EXECUTOR", "unrolled")
     text_seq = 256
 
     model = DALLE(
         dim=1024, depth=12, heads=16, dim_head=64,
         num_image_tokens=8192, image_fmap_size=fmap,
         num_text_tokens=10000, text_seq_len=text_seq,
-        shift_tokens=True, rotary_emb=True, dtype=jnp.bfloat16,
+        shift_tokens=True, rotary_emb=True, executor=executor,
+        dtype=jnp.bfloat16,
     )
     text = jnp.ones((batch, text_seq), jnp.int32)
     tokens = jnp.zeros((batch, fmap * fmap), jnp.int32)
@@ -77,7 +81,8 @@ def main():
         "tokens_per_sec": round(batch * fmap * fmap / p50, 1),
         "device": jax.devices()[0].device_kind,
         "config": f"dim1024-depth12-fmap{fmap}-bs{batch}"
-                  f"-cond{cond_scale}-bf16-cached",
+                  f"-cond{cond_scale}-bf16-cached"
+                  f"{'-scan' if executor == 'scan' else ''}",
     }
     if jax.devices()[0].platform == "cpu":
         out["fallback"] = True  # CPU smoke record, not a perf signal

@@ -40,7 +40,7 @@ run probe_peak        600 PROBE_K=8 python scripts/perf_probe.py peak
 # flash+policy+fused_ce first and falls back to dense; one call does it.
 run bench_main       2400 BENCH_NO_EXTRA=1 python bench.py
 
-# 2. inference north star
+# 2. inference north star (scan decode A/B later in the matrix)
 run generate_p50     1500 python bench_generate.py
 
 # 3. pallas on-chip validation: compiled parity + dense-vs-flash A/B
@@ -67,6 +67,9 @@ run bench_scan_libflash 1200 BENCH_EXECUTOR=scan BENCH_ATTN=lib_flash BENCH_REMA
 # scan executor: dense + depth-stacked pattern masks — is masked-dense
 # cheaper than full dense at seq 1280 on chip?
 run bench_scan_axial 1200 BENCH_EXECUTOR=scan BENCH_ATTN=dense BENCH_ATTN_TYPES=full,axial_row,axial_col,conv_like BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+
+# scan-native cached decode vs the unrolled decode program
+run generate_p50_scan 1200 GEN_EXECUTOR=scan python bench_generate.py --child
 
 # 6. notebook-scale rainbow convergence (VERDICT r3 weak #8: the CPU
 # proxy is 16 samples; the reference notebook bar is 1.0 train exact at
