@@ -204,6 +204,9 @@ def _microbatch_of(env) -> "int | None":
 
 
 if __name__ == "__main__":
+    from bench_common import ensure_compile_cache
+
+    ensure_compile_cache()
     if "--child" in sys.argv:
         main()
     else:

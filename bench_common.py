@@ -48,6 +48,20 @@ def _last_json_line(text: str):
     return None
 
 
+
+def ensure_compile_cache():
+    """Persistent XLA executable cache at <repo>/.jax_cache (idempotent).
+
+    A tunnel drop or OOM retry then re-uses the already-built executable
+    instead of paying (and risking) the same giant remote compile again;
+    harmless if the backend ignores it. Call before any jax import.
+    """
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+
+
 def probe_device(timeout: float = 90.0):
     """Tiny matmul in a subprocess. Returns device info dict or None.
 
@@ -160,14 +174,7 @@ def run_guarded(
     skipped entirely — they encode accelerator trade-offs and would
     mislabel the record.
     """
-    # persistent compile cache for every probe/child: a tunnel drop or OOM
-    # retry then re-uses the already-built executable instead of paying
-    # (and risking) the same giant remote compile again. Harmless if the
-    # backend ignores it.
-    os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-    )
+    ensure_compile_cache()
     info = probe_device()
     if info is None:
         emit_failure(

@@ -90,6 +90,9 @@ def main():
 
 
 if __name__ == "__main__":
+    from bench_common import ensure_compile_cache
+
+    ensure_compile_cache()
     if "--child" in sys.argv:
         main()
     else:
