@@ -159,10 +159,60 @@ def measure_attention_chain():
     return total, linear
 
 
+def decode_step_floor(batch=4):
+    """Bandwidth floor for the generate north star: cost-analyze ONE
+    cached decode step (loop-free) and multiply by the image length."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models.dalle import DALLE, init_decode_cache
+
+    model = DALLE(
+        dim=DIM, depth=DEPTH, heads=HEADS, dim_head=DIM_HEAD,
+        num_image_tokens=8192, image_fmap_size=FMAP,
+        num_text_tokens=10000, text_seq_len=TEXT_SEQ,
+        shift_tokens=True, rotary_emb=True, dtype=jnp.bfloat16,
+    )
+    text = jnp.ones((batch, TEXT_SEQ), jnp.int32)
+    tokens = jnp.zeros((batch, FMAP * FMAP), jnp.int32)
+    params = jax.eval_shape(model.init, jax.random.PRNGKey(0), text, tokens)[
+        "params"
+    ]
+    params = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), params)
+    cache = init_decode_cache(model, batch)
+
+    def step(params, tok, pos, cache):
+        return model.apply(
+            {"params": params}, tok, pos, cache,
+            method=DALLE.decode_image_step,
+        )
+
+    compiled = jax.jit(step).lower(
+        params, jnp.zeros((batch,), jnp.int32), jnp.zeros((), jnp.int32),
+        cache,
+    ).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    nbytes = float(cost.get("bytes accessed", 0.0))
+    n_img = FMAP * FMAP
+    floor_s = n_img * nbytes / V5E_HBM_BPS
+    emit({
+        "component": "cached_decode_step",
+        "batch": batch,
+        "gbytes_per_step": round(nbytes / 1e9, 2),
+        "p50_bw_floor_s": round(floor_s, 2),
+        "note": f"x{n_img} sequential steps; op-level bytes (overcounts "
+                "fused traffic), params+cache re-read every step",
+        "measured": "xla_cost_analysis",
+    })
+
+
 def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    decode_step_floor()
 
     # loop-free compiled rows (forward_forward runs two inline applies)
     analyze("dense_remat_full", "forward_only", None)
