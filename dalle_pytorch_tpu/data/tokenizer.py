@@ -11,11 +11,13 @@ Differences from the reference, by design:
     steps as arrays, so no torch dependency;
   * the CLIP BPE vocabulary file is NOT vendored (262k lines; and this
     build environment has no egress) — `SimpleTokenizer` accepts any
-    CLIP-format merges file via `bpe_path`;
+    CLIP-format merges file via `bpe_path` and is byte-exact against the
+    published CLIP BPE (tests/test_tokenizer_goldens.py);
+  * the DEFAULT is the shipped CLIP-scale 32k-merge native C++ BPE
+    vocabulary (`default_bpe_32k.model`, `NativeBPETokenizer`) — the
+    in-repo replacement for the reference's youtokentome dependency;
   * `ByteTokenizer` is a dependency-free fallback (raw UTF-8 bytes +
-    offset) so the full pipeline runs with zero data files;
-  * `YttmTokenizer`'s C++ BPE is covered by our own native BPE encoder
-    (see native/ — planned), with a HuggingFace bridge meanwhile.
+    offset) so the full pipeline runs with zero data files.
 """
 
 from __future__ import annotations
@@ -272,8 +274,10 @@ class ChineseTokenizer(_TokenizerBase):
 class YttmTokenizer(_TokenizerBase):
     """youtokentome-model bridge (reference `tokenizer.py:232-266`).
 
-    youtokentome (C++ BPE) is not in this environment; raise with guidance.
-    A native C++ BPE encoder under native/ is the planned replacement.
+    youtokentome (C++ BPE) is not in this environment; raise with
+    guidance. `NativeBPETokenizer` (native/bpe.cpp) is the in-repo
+    replacement for new vocabularies; this bridge exists for users with
+    existing yttm model files and an installed youtokentome.
     """
 
     def __init__(self, bpe_path: Union[str, Path]):
