@@ -41,7 +41,12 @@ def main():
     import jax.numpy as jnp
 
     from dalle_pytorch_tpu.models.dalle import DALLE
-    from dalle_pytorch_tpu.training import TrainState, make_optimizer, make_dalle_train_step
+    from dalle_pytorch_tpu.training import (
+        TrainState,
+        make_dalle_train_step,
+        make_multi_step,
+        make_optimizer,
+    )
     from dalle_pytorch_tpu.utils.flops import transformer_train_flops
 
     # BASELINE.json ladder config: DALLE dim=1024 depth=12 with OpenAI-dVAE
@@ -72,6 +77,12 @@ def main():
     # smaller program; the tunneled backend has died mid-compile on the
     # unrolled flagship repeatedly, so small compiles are also robustness
     executor = os.environ.get("BENCH_EXECUTOR", "unrolled")
+    # BENCH_SCAN_STEPS=S runs S optimizer steps per dispatch via
+    # make_multi_step (host-loop elimination): on synchronous-dispatch
+    # backends (the tunneled TPU) each jitted call pays a full round
+    # trip, which bounds steps/sec regardless of program speed; scanning
+    # amortizes one round trip over S real steps.
+    scan_steps = int(os.environ.get("BENCH_SCAN_STEPS", "1"))
     image_seq = fmap * fmap
     seq = text_seq + image_seq
 
@@ -94,15 +105,32 @@ def main():
         apply_fn=model.apply, params=params,
         tx=make_optimizer(3e-4, clip_grad_norm=0.5),
     )
-    step = jax.jit(make_dalle_train_step(model, grad_accum=accum), donate_argnums=0)
+    step_fn = make_dalle_train_step(model, grad_accum=accum)
+    if scan_steps > 1:
+        step = jax.jit(make_multi_step(step_fn, scan_steps), donate_argnums=0)
+    else:
+        step = jax.jit(step_fn, donate_argnums=0)
     batch_dict = {"text": text, "image_tokens": tokens}
+    if scan_steps > 1:
+        # token ids only — the [S, B, seq] int32 window is ~a few MB
+        batch_dict = jax.tree.map(
+            lambda x: jnp.repeat(x[None], scan_steps, 0), batch_dict
+        )
     rng = jax.random.PRNGKey(1)
 
+    def call(state, b, r):
+        if scan_steps > 1:
+            return step(state, b, jax.random.split(r, scan_steps))
+        return step(state, b, r)
+
     # warmup / compile (float() forces completion; see timing note below)
-    state, metrics = step(state, batch_dict, rng)
+    state, metrics = call(state, batch_dict, rng)
     float(metrics["loss"])
 
     n_steps = int(os.environ.get("BENCH_STEPS", "20"))
+    # keep the dispatch count whole; the metric divides by the true count
+    n_dispatches = max(1, n_steps // scan_steps)
+    n_steps = n_dispatches * scan_steps
     # BENCH_INPUT=host: feed every step through the real input machinery —
     # per-step host batch assembly (numpy tokenize-shaped work + device_put)
     # overlapped via the Prefetcher — and report the measured input-bound
@@ -119,14 +147,25 @@ def main():
         def host_batches():
             # batch GENERATION stays inside the pipeline so the measured
             # wait fraction includes real host-side assembly work, not just
-            # the transfer
-            for _ in range(n_steps):
-                yield {
-                    "text": host_rng.randint(1, 9000, (batch, text_seq)),
-                    "image_tokens": host_rng.randint(0, 8192, (batch, image_seq)),
-                }
+            # the transfer; with multi-stepping one yielded item is a whole
+            # [scan_steps, ...] window (one transfer per dispatch)
+            for _ in range(n_dispatches):
+                window = [
+                    {
+                        "text": host_rng.randint(1, 9000, (batch, text_seq)),
+                        "image_tokens": host_rng.randint(
+                            0, 8192, (batch, image_seq)
+                        ),
+                    }
+                    for _ in range(scan_steps)
+                ]
+                yield window if scan_steps > 1 else window[0]
 
         def assemble(b):
+            if scan_steps > 1:
+                from dalle_pytorch_tpu.training import stack_batches
+
+                b = stack_batches(b)
             return {
                 "text": jax.device_put(b["text"].astype(np.int32)),
                 "image_tokens": jax.device_put(b["image_tokens"].astype(np.int32)),
@@ -139,13 +178,13 @@ def main():
     if prefetcher is not None:
         for dev_batch in prefetcher:
             rng, r = jax.random.split(rng)
-            state, metrics = step(state, dev_batch, r)
-            done_steps += 1
+            state, metrics = call(state, dev_batch, r)
+            done_steps += scan_steps
         assert done_steps == n_steps, (done_steps, n_steps)
     else:
-        for _ in range(n_steps):
+        for _ in range(n_dispatches):
             rng, r = jax.random.split(rng)
-            state, metrics = step(state, batch_dict, r)
+            state, metrics = call(state, batch_dict, r)
     # force completion with a value readback: block_until_ready is a no-op
     # on some tunneled backends, which would time dispatch instead of compute
     float(metrics["loss"])
@@ -179,7 +218,8 @@ def main():
             f"{'-types=' + ','.join(attn_types) if attn_types else ''}"
             f"-remat{int(remat)}{'-' + remat_policy if remat_policy else ''}"
             f"{'-fusedce' if fused_ce else ''}"
-            f"{'-scan' if executor == 'scan' else ''}-bf16"
+            f"{'-scan' if executor == 'scan' else ''}"
+            f"{'-steps' + str(scan_steps) if scan_steps > 1 else ''}-bf16"
         ),
     }
     if prefetcher is not None:
@@ -241,6 +281,23 @@ if __name__ == "__main__":
             # traffic). Any failure falls through to the next profile;
             # the last is the round-3 known-good 7.2%-MFU config.
             profiles=[
+                (
+                    # fastest first: everything below PLUS 8 optimizer
+                    # steps per dispatch (make_multi_step) — on the
+                    # synchronous-dispatch tunnel the per-call round trip
+                    # is a large fixed cost; r4 measured the same ~2s/step
+                    # wall for dense AND flash programs, the signature of
+                    # dispatch-bound timing.
+                    "scan+flash+dots_policy+fused_ce+steps8",
+                    {
+                        "BENCH_EXECUTOR": "scan",
+                        "BENCH_ATTN": "flash",
+                        "BENCH_REMAT_POLICY": "dots_with_no_batch_dims_saveable",
+                        "BENCH_FUSED_CE": "1",
+                        "BENCH_SCAN_STEPS": "8",
+                        "BENCH_STEPS": "32",
+                    },
+                ),
                 (
                     # nn.scan executor first: ~12x smaller program. The
                     # tunneled backend's relay has died mid-compile on the

@@ -4,6 +4,8 @@ from dalle_pytorch_tpu.training.steps import (
     make_vae_train_step,
     make_dalle_train_step,
     make_clip_train_step,
+    make_multi_step,
+    stack_batches,
     set_learning_rate,
     get_learning_rate,
 )

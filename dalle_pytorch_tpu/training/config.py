@@ -153,6 +153,12 @@ class TrainConfig:
     keep_n_checkpoints: Optional[int] = None
     batch_size: int = 4
     ga_steps: int = 1
+    # optimizer steps scanned into ONE device dispatch (make_multi_step):
+    # eliminates the host-loop round trip per step — the dominant cost on
+    # synchronous-dispatch backends. Logging/checkpoint cadences fire on
+    # interval crossings, so their effective granularity becomes this many
+    # steps. 1 = classic per-step host loop.
+    steps_per_dispatch: int = 1
     # batches assembled ahead of the step by the prefetch thread
     # (DataLoader-workers equivalent, `train_dalle.py:309-316`); 0 would
     # mean no lookahead but still off-thread assembly

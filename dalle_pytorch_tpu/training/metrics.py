@@ -116,14 +116,23 @@ class ThroughputMeter:
     def __init__(self, interval: int = 10):
         self.interval = interval
         self._t0 = None
+        self._step0 = None
 
     def update(self, step: int, batch_size: int) -> Optional[float]:
-        if step % self.interval == 0:
+        """Fires on interval crossings and scales by the true step delta,
+        so it stays correct when the trainer advances multiple steps per
+        call (steps_per_dispatch windows)."""
+        if self._t0 is None:
+            # initialize on the FIRST call, whatever the step: stride>1
+            # step sequences may never land on an exact interval multiple
+            self._t0 = time.time()
+            self._step0 = step
+            return None
+        if step // self.interval > self._step0 // self.interval:
             now = time.time()
-            rate = None
-            if self._t0 is not None:
-                rate = batch_size * self.interval / (now - self._t0)
+            rate = batch_size * (step - self._step0) / (now - self._t0)
             self._t0 = now
+            self._step0 = step
             return rate
         return None
 

@@ -40,6 +40,19 @@ run probe_peak        600 PROBE_K=8 python scripts/perf_probe.py peak
 # flash+policy+fused_ce first and falls back to dense; one call does it.
 run bench_main       2400 BENCH_NO_EXTRA=1 python bench.py
 
+# 1b. multi-step dispatch-amortization A/B (r4: dense and flash single-
+# step programs measured the SAME ~2s/step wall — the signature of a
+# fixed per-dispatch cost on the synchronous tunnel). steps8 flash vs
+# steps8 dense vs the single-step rows separates dispatch overhead from
+# program time quantitatively.
+run bench_steps8_flash 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+run bench_steps8_dense 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_EXECUTOR=scan BENCH_ATTN=dense BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+run bench_steps16_flash 1200 BENCH_SCAN_STEPS=16 BENCH_STEPS=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+
+# 1c. on-device step probe: K steps inside ONE jit (zero per-step
+# dispatch) — the pure device-time denominator for the overhead split
+run probe_step       1500 PROBE_K=8 python scripts/perf_probe.py step
+
 # 2. inference north star (scan decode A/B later in the matrix)
 run generate_p50     1500 python bench_generate.py
 

@@ -133,6 +133,40 @@ class TestDalleStep:
         )
         assert np.isfinite(float(metrics["loss"]))
 
+    def test_multi_step_matches_sequential(self, batch):
+        """One make_multi_step dispatch == n sequential step dispatches,
+        bit-compatible params and per-key RNG stream (the trainer's
+        fold_in(rng, global_step) keys are passed stacked)."""
+        from dalle_pytorch_tpu.training import make_multi_step, stack_batches
+
+        model = small_dalle()
+        state = dalle_state(model, batch)
+        step = make_dalle_train_step(model)
+        rng = jax.random.PRNGKey(7)
+        keys = jnp.stack([jax.random.fold_in(rng, i) for i in range(3)])
+
+        seq_state = state
+        losses = []
+        jstep = jax.jit(step)
+        for i in range(3):
+            seq_state, m = jstep(seq_state, batch, keys[i])
+            losses.append(float(m["loss"]))
+
+        batches = stack_batches([batch] * 3)
+        multi = jax.jit(make_multi_step(step, 3))
+        multi_state, mm = multi(state, batches, keys)
+
+        assert int(multi_state.step) == 3
+        np.testing.assert_allclose(
+            float(mm["loss"]), np.mean(losses), rtol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            multi_state.params, seq_state.params,
+        )
+
     def test_grad_accum_matches_full_batch(self, batch):
         model = small_dalle()
         state = dalle_state(model, batch)
@@ -144,6 +178,51 @@ class TestDalleStep:
         np.testing.assert_allclose(
             float(m_full["loss"]), float(m_acc["loss"]), rtol=1e-4
         )
+
+
+class TestThroughputMeter:
+    def test_stride_never_hits_exact_multiple(self, monkeypatch):
+        """steps_per_dispatch strides (3,6,8,11,...) never land on a
+        multiple of 10; the meter must still initialize and fire on
+        interval crossings, scaling by the true step delta."""
+        from dalle_pytorch_tpu.training.metrics import ThroughputMeter
+
+        t = [100.0]
+        monkeypatch.setattr(
+            "dalle_pytorch_tpu.training.metrics.time",
+            type("T", (), {"time": staticmethod(lambda: t[0])}),
+        )
+        meter = ThroughputMeter(interval=10)
+        assert meter.update(3, batch_size=8) is None  # initializes here
+        t[0] += 1.0
+        assert meter.update(6, 8) is None
+        t[0] += 1.0
+        rate = meter.update(11, 8)  # crosses 10
+        # 8 samples/step * (11-3) steps over 2.0s
+        assert rate == pytest.approx(8 * 8 / 2.0)
+        t[0] += 4.0
+        assert meter.update(14, 8) is None
+        assert meter.update(21, 8) == pytest.approx(8 * 10 / 4.0)
+
+    def test_stride_one_matches_classic_cadence(self, monkeypatch):
+        from dalle_pytorch_tpu.training.metrics import ThroughputMeter
+
+        t = [0.0]
+        monkeypatch.setattr(
+            "dalle_pytorch_tpu.training.metrics.time",
+            type("T", (), {"time": staticmethod(lambda: t[0])}),
+        )
+        meter = ThroughputMeter(interval=10)
+        fired = []
+        for step in range(1, 31):
+            t[0] += 0.5
+            r = meter.update(step, 4)
+            if r is not None:
+                fired.append((step, r))
+        assert [s for s, _ in fired] == [10, 20, 30]
+        # 9 steps over 4.5s for the first window, then exactly 10/5.0
+        assert fired[0][1] == pytest.approx(4 * 9 / 4.5)
+        assert fired[1][1] == pytest.approx(4 * 10 / 5.0)
 
 
 class TestLRControl:

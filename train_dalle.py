@@ -69,9 +69,10 @@ def main():
     # single-host. Must run before the first device query.
     initialize_distributed()
     from dalle_pytorch_tpu.training import (
-        TrainState, make_optimizer, make_dalle_train_step, ReduceLROnPlateau,
-        set_learning_rate, get_learning_rate,
+        TrainState, make_optimizer, make_dalle_train_step, make_multi_step,
+        stack_batches, ReduceLROnPlateau, set_learning_rate, get_learning_rate,
     )
+    from jax.sharding import NamedSharding, PartitionSpec as P
     from dalle_pytorch_tpu.data.prefetch import Prefetcher
     from dalle_pytorch_tpu.training.config import load_config
     from dalle_pytorch_tpu.training.checkpoint import CheckpointManager
@@ -181,24 +182,39 @@ def main():
         vae_sh = partition_params(vae_params, mesh)
         vae_params = jax.device_put(vae_params, vae_sh)
         batch_shardings = {"text": txt_sh, "images": img_sh}
-        step_fn = jax.jit(
-            make_dalle_train_step(
-                model, vae=vae, mode=cfg.mode, grad_accum=cfg.ga_steps,
-                null_cond_prob=cfg.null_cond_prob,
-            ),
-            in_shardings=(state_sh, batch_shardings, None, vae_sh),
-            out_shardings=(state_sh, None),
-            donate_argnums=0,
+        raw_step = make_dalle_train_step(
+            model, vae=vae, mode=cfg.mode, grad_accum=cfg.ga_steps,
+            null_cond_prob=cfg.null_cond_prob,
         )
+        extra_shardings = (vae_sh,)
     else:
         # pretrained torch-backed VAE: encode on host, feed tokens
         batch_shardings = {"text": txt_sh, "image_tokens": txt_sh}
-        step_fn = jax.jit(
-            make_dalle_train_step(
-                model, mode=cfg.mode, grad_accum=cfg.ga_steps,
-                null_cond_prob=cfg.null_cond_prob,
-            ),
-            in_shardings=(state_sh, batch_shardings, None),
+        raw_step = make_dalle_train_step(
+            model, mode=cfg.mode, grad_accum=cfg.ga_steps,
+            null_cond_prob=cfg.null_cond_prob,
+        )
+        extra_shardings = ()
+    step_fn = jax.jit(
+        raw_step,
+        in_shardings=(state_sh, batch_shardings, None) + extra_shardings,
+        out_shardings=(state_sh, None),
+        donate_argnums=0,
+    )
+    # steps_per_dispatch>1: scan T optimizer steps into one dispatch
+    # (make_multi_step) — host-loop elimination; window batches get a
+    # leading unsharded step axis on top of the per-step batch specs
+    steps_per_dispatch = max(1, int(cfg.steps_per_dispatch))
+    multi_fn = None
+    if steps_per_dispatch > 1:
+        win_shardings = jax.tree.map(
+            lambda sh: NamedSharding(mesh, P(None, *sh.spec)),
+            batch_shardings,
+            is_leaf=lambda x: isinstance(x, NamedSharding),
+        )
+        multi_fn = jax.jit(
+            make_multi_step(raw_step, steps_per_dispatch),
+            in_shardings=(state_sh, win_shardings, None) + extra_shardings,
             out_shardings=(state_sh, None),
             donate_argnums=0,
         )
@@ -275,43 +291,74 @@ def main():
         epoch_losses = []
         last_loss = None
         epoch_batch = 0
-        def assemble(batch):
-            """Host->device batch assembly, run ahead of the step in the
-            prefetch thread so decode/tokenize/transfer overlap compute
-            (the DataLoader-workers equivalent, ref `:309-316`). Returns
-            (device_batch, captions) — captions ride separately because the
-            device batch's pytree must match the step's in_shardings."""
+        def host_arrays(batch):
+            """Per-batch host-side prep: captions split off (the device
+            pytree must match the step's in_shardings), sample-logging head
+            row fetched while host-local, torch-backed VAE encoded."""
             caps = batch.get("captions")
             # host-local head row for root-only sample logging: the global
             # dev batch spans non-addressable devices on multi-host, so it
             # cannot be fetched there
             text_head = np.asarray(batch["text"][:1])
             if in_step_encode:
-                dev = {
-                    "text": put_host_batch(batch["text"], txt_sh),
-                    "images": put_host_batch(
-                        batch["images"], batch_shardings["images"]
-                    ),
-                }
+                host = {"text": batch["text"], "images": batch["images"]}
             else:
                 if "image_tokens" in batch:  # precomputed (TokenDataset)
                     tokens = batch["image_tokens"]
                 else:  # pretrained torch-backed VAE: host-side encode
                     tokens = vae.get_codebook_indices(jnp.asarray(batch["images"]))
-                dev = {
-                    "text": put_host_batch(batch["text"], txt_sh),
-                    "image_tokens": put_host_batch(tokens, txt_sh),
-                }
+                host = {"text": batch["text"], "image_tokens": tokens}
+            return host, caps, text_head
+
+        def assemble(batch):
+            """Host->device batch assembly, run ahead of the step in the
+            prefetch thread so decode/tokenize/transfer overlap compute
+            (the DataLoader-workers equivalent, ref `:309-316`)."""
+            host, caps, text_head = host_arrays(batch)
+            dev = {
+                k: put_host_batch(v, batch_shardings[k]) for k, v in host.items()
+            }
             return dev, caps, text_head
 
-        batch_iter = Prefetcher(
-            dataset.batches(
-                cfg.batch_size, shuffle_seed=cfg.seed + epoch, shard=shard,
-                start_batch=skip_batches if epoch == resume_epoch else 0,
-            ),
-            transform=assemble,
-            depth=cfg.prefetch_depth,
+        def assemble_window(win):
+            """steps_per_dispatch batches -> one [T, ...] device window
+            (one transfer per dispatch). An epoch-tail window shorter than
+            T is assembled per-batch and replayed through the single-step
+            program — same RNG/cadence semantics, no second window-sized
+            compile."""
+            if len(win) < steps_per_dispatch:
+                return [assemble(b) for b in win], None, None
+            hosts, caps, heads = zip(*[host_arrays(b) for b in win])
+            stacked = stack_batches(list(hosts))
+            dev = {
+                k: put_host_batch(v, win_shardings[k]) for k, v in stacked.items()
+            }
+            return dev, caps[0], heads[0]
+
+        def window_iter(it, n):
+            buf = []
+            for b in it:
+                buf.append(b)
+                if len(buf) == n:
+                    yield buf
+                    buf = []
+            if buf:
+                yield buf
+
+        raw_batches = dataset.batches(
+            cfg.batch_size, shuffle_seed=cfg.seed + epoch, shard=shard,
+            start_batch=skip_batches if epoch == resume_epoch else 0,
         )
+        if steps_per_dispatch > 1:
+            batch_iter = Prefetcher(
+                window_iter(raw_batches, steps_per_dispatch),
+                transform=assemble_window,
+                depth=cfg.prefetch_depth,
+            )
+        else:
+            batch_iter = Prefetcher(
+                raw_batches, transform=assemble, depth=cfg.prefetch_depth
+            )
         if epoch == resume_epoch and skip_batches:
             epoch_batch = skip_batches
             # carry the interrupted epoch's loss history so the epoch-end
@@ -323,21 +370,50 @@ def main():
         try:
             for dev_batch, captions, text_head in batch_iter:
                 profiler.before_step(global_step)
+                prev_step = global_step
                 # fold_in(global_step), not sequential split: the key stream
                 # is a pure function of the step index, so a mid-epoch
                 # resume replays the exact dropout/null-cond randomness an
-                # uninterrupted run would use
-                r = jax.random.fold_in(rng, global_step)
-                if in_step_encode:
-                    state, metrics = step_fn(state, dev_batch, r, vae_params)
+                # uninterrupted run would use — and the multi-step window
+                # passes the SAME per-step folded keys stacked, so
+                # steps_per_dispatch never changes the randomness
+                if multi_fn is not None and not isinstance(dev_batch, list):
+                    keys = jnp.stack([
+                        jax.random.fold_in(rng, global_step + i)
+                        for i in range(steps_per_dispatch)
+                    ])
+                    if in_step_encode:
+                        state, metrics = multi_fn(state, dev_batch, keys, vae_params)
+                    else:
+                        state, metrics = multi_fn(state, dev_batch, keys)
+                    global_step += steps_per_dispatch
+                    epoch_batch += steps_per_dispatch
                 else:
-                    state, metrics = step_fn(state, dev_batch, r)
+                    singles = (
+                        dev_batch if isinstance(dev_batch, list)
+                        else [(dev_batch, captions, text_head)]
+                    )
+                    for dev_b, caps_i, head_i in singles:
+                        captions, text_head = caps_i, head_i
+                        r = jax.random.fold_in(rng, global_step)
+                        if in_step_encode:
+                            state, metrics = step_fn(state, dev_b, r, vae_params)
+                        else:
+                            state, metrics = step_fn(state, dev_b, r)
+                        global_step += 1
+                        epoch_batch += 1
 
-                global_step += 1
-                epoch_batch += 1
+                def crossed(interval):
+                    # cadences fire on interval CROSSINGS so a >1-step
+                    # dispatch can't step over them; with stride 1 this is
+                    # exactly the old `global_step % interval == 0`
+                    return bool(interval) and (
+                        global_step // interval > prev_step // interval
+                    )
+
                 last_loss = metrics["loss"]  # lazy device scalar; no sync here
                 log = {}
-                if global_step % 10 == 0:
+                if crossed(10):
                     step_loss = float(last_loss)
                     epoch_losses.append(step_loss)
                     log.update(
@@ -349,7 +425,7 @@ def main():
                         log["accuracy"] = float(metrics["accuracy"])
                     print(epoch, global_step, f"loss - {step_loss:.5f}")
 
-                if global_step % cfg.save_every_n_steps == 0:
+                if crossed(cfg.save_every_n_steps):
                     # pass the sharded state directly: Orbax handles
                     # cross-host-sharded arrays natively (and copies to
                     # host before its async write), where device_get would
@@ -370,7 +446,7 @@ def main():
                 # ALL processes run the sampling computation (it is an
                 # SPMD program over the sharded params); only the logger
                 # (enabled on root) writes the image
-                if cfg.log_images_freq and global_step % cfg.log_images_freq == 0:
+                if crossed(cfg.log_images_freq):
                     # in-loop sample generation in EVERY configuration —
                     # trainable dVAE, precomputed tokens, VQGAN/OpenAI — like
                     # the reference (`train_dalle.py:564-576`)
