@@ -355,11 +355,14 @@ if __name__ == "__main__":
             )
             for label, cmd in (
                 ("generate_p50", [py, os.path.join(here, "bench_generate.py")]),
-                ("pallas_onchip",
-                 [py, os.path.join(here, "scripts", "pallas_onchip.py")]),
+                # probes before the Pallas A/B: the isolated-kernel script
+                # has blown the extras budget mid-compile (and preceded two
+                # relay deaths) — it must not starve the cheap rows
                 ("perf_probe",
                  [py, os.path.join(here, "scripts", "perf_probe.py"),
-                  "peak", "hbm", "attn", "ff", "logits"]),
+                  "peak", "hbm", "step", "attn", "ff", "logits"]),
+                ("pallas_onchip",
+                 [py, os.path.join(here, "scripts", "pallas_onchip.py")]),
             ):
                 left = extras_deadline - time.monotonic()
                 if left < 60:

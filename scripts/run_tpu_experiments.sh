@@ -56,9 +56,6 @@ run probe_step       1500 PROBE_K=8 python scripts/perf_probe.py step
 # 2. inference north star (scan decode A/B later in the matrix)
 run generate_p50     1500 python bench_generate.py
 
-# 3. pallas on-chip validation: compiled parity + dense-vs-flash A/B
-run pallas_onchip    1500 PROBE_K=8 python scripts/pallas_onchip.py
-
 # 4. per-component costs (attn/ff/logits AI table)
 run probe_components 1200 PROBE_K=8 python scripts/perf_probe.py hbm attn ff logits
 
@@ -90,5 +87,12 @@ run generate_p50_scan 1200 GEN_EXECUTOR=scan python bench_generate.py --child
 run rainbow_convergence 2400 python examples/rainbow_dalle.py \
     --num-samples 9216 --vae-steps 1500 --dalle-steps 4000 \
     --batch-size 64 --eval-samples 64 --out-dir rainbow_tpu_out
+
+# 7. LAST: pallas isolated-kernel validation (compiled parity +
+# dense-vs-flash A/B). Its Mosaic compile has preceded two relay deaths
+# and once ate 21 min without emitting a row — nothing of value may be
+# scheduled after it. The in-train-step flash-vs-dense answer comes from
+# the bench_steps8 rows above regardless.
+run pallas_onchip    1500 PROBE_K=8 python scripts/pallas_onchip.py
 
 echo "results -> $OUT" >&2

@@ -16,6 +16,7 @@ Default inputs: TPU_RESULTS.jsonl EXTRA_RESULTS.jsonl (repo root).
 from __future__ import annotations
 
 import json
+import re
 import sys
 from pathlib import Path
 
@@ -89,6 +90,51 @@ def main():
             print(
                 f"\nBest: {best['value']} img-tok/s/chip "
                 f"(MFU {best.get('mfu')}) @ {best.get('config')}"
+            )
+
+    # dispatch-overhead split: pair single-dispatch and steps-S rows whose
+    # configs differ ONLY in '-stepsS'. With per-step walls t1 and tS,
+    #   RTT  = (t1 - tS) * S/(S-1)        (fixed per-dispatch cost)
+    #   tdev = tS - RTT/S = (S*tS - t1)/(S-1)   (pure device step time)
+    # probe_step (K steps inside ONE jit) is the zero-dispatch
+    # cross-check for tdev.
+    by_cfg = {}
+    for name, r in bench:
+        cfg = r.get("config")
+        if not (cfg and r.get("ok") and not r.get("fallback")):
+            continue
+        if not r.get("samples_per_sec"):
+            continue
+        m = re.search(r"gbs(\d+)", cfg)
+        s = re.search(r"-steps(\d+)", cfg)
+        if not m:
+            continue
+        steps = int(s.group(1)) if s else 1
+        key = re.sub(r"-steps\d+", "", cfg)
+        t_step = int(m.group(1)) / r["samples_per_sec"]
+        prev = by_cfg.setdefault(key, {})
+        if steps not in prev or t_step < prev[steps]:
+            prev[steps] = t_step
+    splits = []
+    for key, walls in by_cfg.items():
+        if 1 not in walls:
+            continue
+        for s, ts in walls.items():
+            if s > 1:
+                # rtt <= 0 is itself the answer ("dispatch is NOT the
+                # bottleneck") — report it, don't drop the pair
+                rtt = (walls[1] - ts) * s / (s - 1)
+                tdev = (s * ts - walls[1]) / (s - 1)
+                splits.append((key, s, walls[1], ts, rtt, tdev))
+    if splits:
+        print("\n## Dispatch-overhead split\n")
+        print("| config | S | t1 s/step | tS s/step | RTT/dispatch | device s/step |")
+        print("|---|---|---|---|---|---|")
+        for key, s, t1, ts, rtt, tdev in splits:
+            note = " (no positive overhead)" if rtt <= 0 else ""
+            print(
+                f"| {key} | {s} | {t1:.3f} | {ts:.3f} | {rtt:.3f}{note} | "
+                f"{tdev:.3f} |"
             )
 
     if gen:
