@@ -48,6 +48,10 @@ run bench_main       2400 BENCH_NO_EXTRA=1 python bench.py
 run bench_steps8_flash 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 run bench_steps8_dense 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_EXECUTOR=scan BENCH_ATTN=dense BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 run bench_steps16_flash 1200 BENCH_SCAN_STEPS=16 BENCH_STEPS=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+run bench_steps32_flash 1200 BENCH_SCAN_STEPS=32 BENCH_STEPS=64 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+# amortization x larger per-dispatch work: batch 32 lifts FF/logits
+# arithmetic intensity on top of the RTT amortization
+run bench_steps8_b32 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_BATCH=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 
 # 1c. on-device step probe: K steps inside ONE jit (zero per-step
 # dispatch) — the pure device-time denominator for the overhead split
