@@ -6,6 +6,7 @@ from dalle_pytorch_tpu.training.steps import (
     make_clip_train_step,
     make_multi_step,
     stack_batches,
+    window_iter,
     set_learning_rate,
     get_learning_rate,
 )

@@ -146,9 +146,13 @@ class ProfilerHook:
         self.profile_step = profile_step
         self.out_dir = out_dir
         self._active = False
+        self._done = False
 
     def before_step(self, step: int) -> None:
-        if self.enabled and step == self.profile_step:
+        # >= (not ==): a steps_per_dispatch>1 trainer may never land on the
+        # exact step index; profile the first dispatch at/after it instead
+        # of stopping later without ever having traced
+        if self.enabled and not self._done and step >= self.profile_step:
             Path(self.out_dir).mkdir(parents=True, exist_ok=True)
             jax.profiler.start_trace(self.out_dir)
             self._active = True
@@ -158,5 +162,6 @@ class ProfilerHook:
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
             print(f"[profiler] trace for step {step} written to {self.out_dir}")
-        return self.enabled and step > self.profile_step
+        return self.enabled and self._done

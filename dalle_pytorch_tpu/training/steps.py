@@ -248,6 +248,20 @@ def stack_batches(batches: list):
     return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
 
+def window_iter(it, n: int):
+    """Group an iterator into lists of `n` (the final group may be
+    shorter — trainers replay such epoch tails through their single-step
+    program). Shared by every steps_per_dispatch trainer loop."""
+    buf = []
+    for b in it:
+        buf.append(b)
+        if len(buf) == n:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
+
+
 def make_clip_train_step(clip_model, grad_accum: int = 1) -> Callable:
     """step(state, batch{text,images}, rng) -> (state, metrics)."""
 

@@ -183,6 +183,8 @@ class TestCliEndToEnd:
             "--image_size", "16", "--patch_size", "8",
             "--text_seq_len", "32", "--dim", "32", "--dim_latent", "16",
             "--depth", "1", "--heads", "2",
+            # windowed dispatch: 4 batches -> one [2,...] window x2
+            "--steps_per_dispatch", "2",
             "--output", str(tmp_path / "clip.npz"), "--debug", cwd=tmp_path,
         )
         assert (tmp_path / "clip.npz").exists()
@@ -369,6 +371,21 @@ class TestAttnImplCli:
             cwd=tmp_path,
         )
         assert (tmp_path / "checkpoints" / "dalle.npz").exists()
+
+    def test_vae_train_with_steps_per_dispatch(self, tmp_path):
+        """train_vae.py with steps_per_dispatch=3: 4 batches/epoch -> one
+        full [3,...] window + a 1-batch tail; gumbel temp rides as a
+        per-dispatch constant."""
+        run_cli(
+            "train_vae.py", "--image_folder", "rainbow:32", "--epochs", "1",
+            "--batch_size", "8", "--output", str(tmp_path / "vae_spd.npz"),
+            "--set", "steps_per_dispatch=3",
+            "--set", "vae.image_size=16", "--set", "vae.num_layers=2",
+            "--set", "vae.num_tokens=32", "--set", "vae.codebook_dim=16",
+            "--set", "vae.hidden_dim=16", "--set", "debug=true",
+            cwd=tmp_path,
+        )
+        assert (tmp_path / "vae_spd.npz").exists()
 
     def test_train_with_steps_per_dispatch(self, tmp_path):
         """steps_per_dispatch=3 over rainbow:64 at batch 8 -> 8 batches/

@@ -225,6 +225,33 @@ class TestThroughputMeter:
         assert fired[1][1] == pytest.approx(4 * 10 / 5.0)
 
 
+class TestProfilerHook:
+    def test_stride_skips_exact_step(self, monkeypatch, tmp_path):
+        """steps_per_dispatch can step OVER profile_step; the hook must
+        trace the first dispatch at/after it and only then stop training
+        (previously it stopped without ever tracing)."""
+        from dalle_pytorch_tpu.training.metrics import ProfilerHook
+
+        calls = []
+        monkeypatch.setattr(
+            "dalle_pytorch_tpu.training.metrics.jax.profiler",
+            type("P", (), {
+                "start_trace": staticmethod(lambda d: calls.append(("start", d))),
+                "stop_trace": staticmethod(lambda: calls.append(("stop",))),
+            }),
+        )
+        hook = ProfilerHook(True, profile_step=200, out_dir=str(tmp_path / "p"))
+        # stride-3 window sequence around 200: 198 -> 201 -> 204
+        hook.before_step(198)
+        assert not calls and hook.after_step(201) is False
+        hook.before_step(201)
+        assert calls == [("start", str(tmp_path / "p"))]
+        assert hook.after_step(204) is True  # traced, now stop
+        assert calls[-1] == ("stop",)
+        hook.before_step(204)  # must not restart
+        assert len(calls) == 2
+
+
 class TestLRControl:
     def test_set_get_lr(self, batch):
         model = small_dalle()

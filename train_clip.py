@@ -33,6 +33,11 @@ def parse_args():
         help="layer executor for both encoders; scan compiles one layer "
         "body instead of depth copies (models/transformer.py)",
     )
+    p.add_argument(
+        "--steps_per_dispatch", type=int, default=1,
+        help="optimizer steps scanned into one device dispatch "
+        "(host-loop elimination; see training/steps.py make_multi_step)",
+    )
     p.add_argument("--debug", action="store_true")
     return p.parse_args()
 
@@ -55,7 +60,8 @@ def main():
     initialize_distributed()
     from dalle_pytorch_tpu.training.config import TrainConfig
     from dalle_pytorch_tpu.training.steps import (
-        TrainState, make_optimizer, make_clip_train_step,
+        TrainState, make_optimizer, make_clip_train_step, make_multi_step,
+        stack_batches, window_iter,
     )
     from dalle_pytorch_tpu.training.pipeline import (
         build_dataset, build_tokenizer, save_clip_checkpoint,
@@ -97,7 +103,10 @@ def main():
         apply_fn=clip.apply, params=params,
         tx=make_optimizer(args.learning_rate, clip_grad_norm=1.0),
     )
-    step_fn = jax.jit(make_clip_train_step(clip))
+    raw_step = make_clip_train_step(clip)
+    step_fn = jax.jit(raw_step)
+    spd = max(1, args.steps_per_dispatch)
+    multi_fn = jax.jit(make_multi_step(raw_step, spd)) if spd > 1 else None
     logger = MetricsLogger(project="clip_tpu", config=vars(args),
                            debug=args.debug)
     meter = ThroughputMeter()
@@ -105,16 +114,30 @@ def main():
     rng = jax.random.PRNGKey(1)
     global_step = 0
     for epoch in range(args.epochs):
-        for batch in batches(epoch):
-            rng, r = jax.random.split(rng)
-            state, m = step_fn(
-                state,
-                {"text": jnp.asarray(batch["text"]),
-                 "images": jnp.asarray(batch["images"])},
-                r,
-            )
-            global_step += 1
-            if global_step % 10 == 0:
+        for win in window_iter(batches(epoch), spd):
+            prev_step = global_step
+            if multi_fn is not None and len(win) == spd:
+                rng, sub = jax.random.split(rng)
+                stacked = stack_batches([
+                    {"text": b["text"], "images": b["images"]} for b in win
+                ])
+                state, m = multi_fn(
+                    state,
+                    {k: jnp.asarray(v) for k, v in stacked.items()},
+                    jax.random.split(sub, spd),
+                )
+                global_step += spd
+            else:
+                for batch in win:  # spd==1 or epoch tail: per-step replay
+                    rng, r = jax.random.split(rng)
+                    state, m = step_fn(
+                        state,
+                        {"text": jnp.asarray(batch["text"]),
+                         "images": jnp.asarray(batch["images"])},
+                        r,
+                    )
+                    global_step += 1
+            if global_step // 10 > prev_step // 10:
                 loss = float(m["loss"])
                 print(f"epoch {epoch} step {global_step}: loss {loss:.4f}")
                 logger.log({"loss": loss, "epoch": epoch}, step=global_step)

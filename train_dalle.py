@@ -70,7 +70,8 @@ def main():
     initialize_distributed()
     from dalle_pytorch_tpu.training import (
         TrainState, make_optimizer, make_dalle_train_step, make_multi_step,
-        stack_batches, ReduceLROnPlateau, set_learning_rate, get_learning_rate,
+        stack_batches, window_iter, ReduceLROnPlateau, set_learning_rate,
+        get_learning_rate,
     )
     from jax.sharding import NamedSharding, PartitionSpec as P
     from dalle_pytorch_tpu.data.prefetch import Prefetcher
@@ -334,16 +335,6 @@ def main():
                 k: put_host_batch(v, win_shardings[k]) for k, v in stacked.items()
             }
             return dev, caps[0], heads[0]
-
-        def window_iter(it, n):
-            buf = []
-            for b in it:
-                buf.append(b)
-                if len(buf) == n:
-                    yield buf
-                    buf = []
-            if buf:
-                yield buf
 
         raw_batches = dataset.batches(
             cfg.batch_size, shuffle_seed=cfg.seed + epoch, shard=shard,

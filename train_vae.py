@@ -58,9 +58,11 @@ def main():
     # single-host. Must run before the first device query.
     initialize_distributed()
     from dalle_pytorch_tpu.training import (
-        TrainState, make_optimizer, make_vae_train_step, ExponentialDecay,
-        set_learning_rate, get_learning_rate,
+        TrainState, make_optimizer, make_vae_train_step, make_multi_step,
+        stack_batches, window_iter, ExponentialDecay, set_learning_rate,
+        get_learning_rate,
     )
+    from jax.sharding import NamedSharding, PartitionSpec as P
     from dalle_pytorch_tpu.training.config import load_config
     from dalle_pytorch_tpu.training.metrics import MetricsLogger, ThroughputMeter
     from dalle_pytorch_tpu.training.pipeline import (
@@ -96,12 +98,27 @@ def main():
     state_sh = state_shardings(state, mesh)
     img_sh = batch_sharding(mesh, extra_dims=3)
     state = jax.device_put(state, state_sh)
+    raw_step = make_vae_train_step(vae, grad_accum=cfg.ga_steps)
     step_fn = jax.jit(
-        make_vae_train_step(vae, grad_accum=cfg.ga_steps),
+        raw_step,
         in_shardings=(state_sh, img_sh, None, None),
         out_shardings=(state_sh, None),
         donate_argnums=0,
     )
+    # steps_per_dispatch>1: scan T steps into one dispatch (see
+    # train_dalle.py). The gumbel temp rides as a per-dispatch constant —
+    # it only changes at 100-step crossings anyway, which align with
+    # dispatch boundaries under the crossing-based cadence below.
+    steps_per_dispatch = max(1, int(cfg.steps_per_dispatch))
+    multi_fn = None
+    if steps_per_dispatch > 1:
+        win_img_sh = NamedSharding(mesh, P(None, *img_sh.spec))
+        multi_fn = jax.jit(
+            make_multi_step(raw_step, steps_per_dispatch),
+            in_shardings=(state_sh, win_img_sh, None, None),
+            out_shardings=(state_sh, None),
+            donate_argnums=0,
+        )
 
     logger = MetricsLogger(
         project=cfg.project, config={"cli": "train_vae"},
@@ -124,19 +141,54 @@ def main():
             # fetch the global array (non-addressable on multi-host)
             return put_host_batch(b["images"], img_sh), np.asarray(b["images"][:4])
 
-        batch_iter = Prefetcher(
-            dataset.batches(cfg.batch_size, shuffle_seed=epoch, shard=shard),
-            transform=assemble,
-            depth=cfg.prefetch_depth,
+        def assemble_window(win):
+            if len(win) < steps_per_dispatch:  # epoch tail: per-step replay
+                return [assemble(b) for b in win], None
+            stacked = stack_batches([b["images"] for b in win])
+            return (
+                put_host_batch(stacked, win_img_sh),
+                np.asarray(win[0]["images"][:4]),
+            )
+
+        raw_batches = dataset.batches(
+            cfg.batch_size, shuffle_seed=epoch, shard=shard
         )
+        if steps_per_dispatch > 1:
+            batch_iter = Prefetcher(
+                window_iter(raw_batches, steps_per_dispatch),
+                transform=assemble_window, depth=cfg.prefetch_depth,
+            )
+        else:
+            batch_iter = Prefetcher(
+                raw_batches, transform=assemble, depth=cfg.prefetch_depth
+            )
         try:
             for images, images_head in batch_iter:
-                rng, r = jax.random.split(rng)
-                state, metrics = step_fn(state, images, r, jnp.float32(temp))
-                global_step += 1
+                prev_step = global_step
+                if multi_fn is not None and not isinstance(images, list):
+                    rng, sub = jax.random.split(rng)
+                    keys = jax.random.split(sub, steps_per_dispatch)
+                    state, metrics = multi_fn(state, images, keys, jnp.float32(temp))
+                    r = keys[-1]  # for the recon-grid gumbel sample below
+                    global_step += steps_per_dispatch
+                else:
+                    singles = (
+                        images if isinstance(images, list)
+                        else [(images, images_head)]
+                    )
+                    for img_b, head_b in singles:
+                        images_head = head_b
+                        rng, r = jax.random.split(rng)
+                        state, metrics = step_fn(state, img_b, r, jnp.float32(temp))
+                        global_step += 1
+
+                def crossed(interval):
+                    return bool(interval) and (
+                        global_step // interval > prev_step // interval
+                    )
 
                 log = {}
-                if global_step % 100 == 0:
+                if crossed(100):
                     # recon grids: soft (gumbel) + hard (argmax->decode),
                     # computed from the host-local head rows
                     k = images_head.shape[0]
@@ -159,15 +211,22 @@ def main():
                          np.asarray(hard) * 0.5 + 0.5], axis=0
                     )
                     logger.log_images(grid, "orig | soft | hard", "recons", global_step)
-                    # temperature anneal (`train_vae.py:278`)
-                    temp = max(
-                        temp * math.exp(-cfg.vae.anneal_rate * global_step),
-                        cfg.vae.temp_min,
-                    )
-                    if sched is not None:
-                        state = set_learning_rate(
-                            state, sched.step(0.0, get_learning_rate(state))
+                    # temperature anneal (`train_vae.py:278`) + LR decay:
+                    # one application PER crossed 100-step boundary (a
+                    # steps_per_dispatch>100 window can span several), each
+                    # at its boundary's step value, so the schedule matches
+                    # a single-step run regardless of window size
+                    for boundary in range(
+                        prev_step // 100 + 1, global_step // 100 + 1
+                    ):
+                        temp = max(
+                            temp * math.exp(-cfg.vae.anneal_rate * boundary * 100),
+                            cfg.vae.temp_min,
                         )
+                        if sched is not None:
+                            state = set_learning_rate(
+                                state, sched.step(0.0, get_learning_rate(state))
+                            )
                     log.update(
                         temperature=temp,
                         lr=get_learning_rate(state),
@@ -177,7 +236,7 @@ def main():
                 rate = meter.update(global_step, cfg.batch_size)
                 if rate is not None:
                     log["sample_per_sec"] = rate
-                if global_step % 10 == 0:
+                if crossed(10):
                     log["loss"] = float(metrics["loss"])
                     print(epoch, global_step, f"loss - {log['loss']:.5f}")
                 if log:
