@@ -52,6 +52,13 @@ run bench_steps32_flash 1200 BENCH_SCAN_STEPS=32 BENCH_STEPS=64 BENCH_EXECUTOR=s
 # amortization x larger per-dispatch work: batch 32 lifts FF/logits
 # arithmetic intensity on top of the RTT amortization
 run bench_steps8_b32 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_BATCH=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
+# device-time levers on top of the amortized dispatch: full-recompute
+# remat (policy's FLOP saving quantified — under flash the attention
+# dots are Pallas-internal, so dot POLICIES only differ on the FF/logits
+# projections; the real A/B is policy vs none), and no remat at
+# microbatch 8 (zero recompute, 2x accumulation)
+run bench_steps8_fullremat 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=none BENCH_FUSED_CE=1 python bench.py --child
+run bench_steps8_noremat_a2 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_REMAT=0 BENCH_ACCUM=2 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_FUSED_CE=1 python bench.py --child
 
 # 1c. on-device step probe: K steps inside ONE jit (zero per-step
 # dispatch) — the pure device-time denominator for the overhead split
