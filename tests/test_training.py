@@ -133,30 +133,33 @@ class TestDalleStep:
         )
         assert np.isfinite(float(metrics["loss"]))
 
-    def test_multi_step_matches_sequential(self, batch):
+    @pytest.mark.parametrize("grad_accum,n_steps", [(1, 3), (2, 2)])
+    def test_multi_step_matches_sequential(self, batch, grad_accum, n_steps):
         """One make_multi_step dispatch == n sequential step dispatches,
         bit-compatible params and per-key RNG stream (the trainer's
-        fold_in(rng, global_step) keys are passed stacked)."""
+        fold_in(rng, global_step) keys are passed stacked). grad_accum=2
+        covers the nested-scan combination the bench's OOM ladder
+        produces on hardware."""
         from dalle_pytorch_tpu.training import make_multi_step, stack_batches
 
         model = small_dalle()
         state = dalle_state(model, batch)
-        step = make_dalle_train_step(model)
+        step = make_dalle_train_step(model, grad_accum=grad_accum)
         rng = jax.random.PRNGKey(7)
-        keys = jnp.stack([jax.random.fold_in(rng, i) for i in range(3)])
+        keys = jnp.stack([jax.random.fold_in(rng, i) for i in range(n_steps)])
 
         seq_state = state
         losses = []
         jstep = jax.jit(step)
-        for i in range(3):
+        for i in range(n_steps):
             seq_state, m = jstep(seq_state, batch, keys[i])
             losses.append(float(m["loss"]))
 
-        batches = stack_batches([batch] * 3)
-        multi = jax.jit(make_multi_step(step, 3))
+        batches = stack_batches([batch] * n_steps)
+        multi = jax.jit(make_multi_step(step, n_steps))
         multi_state, mm = multi(state, batches, keys)
 
-        assert int(multi_state.step) == 3
+        assert int(multi_state.step) == n_steps
         np.testing.assert_allclose(
             float(mm["loss"]), np.mean(losses), rtol=1e-5
         )
