@@ -59,6 +59,10 @@ run bench_steps8_b32 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_BATCH=32 BENCH
 # microbatch 8 (zero recompute, 2x accumulation)
 run bench_steps8_fullremat 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=none BENCH_FUSED_CE=1 python bench.py --child
 run bench_steps8_noremat_a2 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_REMAT=0 BENCH_ACCUM=2 BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_FUSED_CE=1 python bench.py --child
+# real host input under 8-step windows: whole [8,B,...] windows are
+# assembled+transferred per dispatch — input_wait_frac shows whether the
+# host pipeline keeps up with the burstier demand
+run bench_steps8_host 1200 BENCH_SCAN_STEPS=8 BENCH_STEPS=32 BENCH_INPUT=host BENCH_EXECUTOR=scan BENCH_ATTN=flash BENCH_REMAT_POLICY=dots_with_no_batch_dims_saveable BENCH_FUSED_CE=1 python bench.py --child
 
 # 1c. on-device step probe: K steps inside ONE jit (zero per-step
 # dispatch) — the pure device-time denominator for the overhead split
