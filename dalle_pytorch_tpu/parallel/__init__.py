@@ -16,3 +16,9 @@ from dalle_pytorch_tpu.parallel.partition import (
     state_shardings,
 )
 from dalle_pytorch_tpu.parallel.ring import ring_attention
+from dalle_pytorch_tpu.parallel.gpipe import (
+    gpipe_apply,
+    make_pp_mesh,
+    pipeline_layers,
+    stage_params_sharding,
+)

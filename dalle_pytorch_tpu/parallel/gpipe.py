@@ -1,0 +1,162 @@
+"""GPipe-style pipeline parallelism over a `pp` mesh axis.
+
+The reference has no pipeline parallelism at all (SURVEY.md §2.2 row PP:
+"none") — its depth scaling is reversibility + DeepSpeed ZeRO. On TPU the
+idiomatic construction is SPMD: shard the depth-stacked layer parameters
+over a `pp` mesh axis and move ACTIVATIONS between stages with
+`lax.ppermute` inside `shard_map`, exactly like ring attention moves K/V
+blocks (`parallel/ring.py`). XLA lowers the permute onto ICI
+neighbor links; the schedule below is classic GPipe: M microbatches flow
+through P stages in M + P - 1 ticks, each stage running its local slice
+of layers per tick (bubble fraction (P-1)/(M+P-1)).
+
+Everything is a pure jittable function — `jax.grad` differentiates
+straight through the schedule (ppermute's transpose is the reverse
+permutation; the backward pipeline runs automatically in reverse), so a
+training step needs no hand-written backward schedule.
+
+Scope: a generic engine over any `layer_fn(layer_params, x) -> x` whose
+parameters are depth-stacked pytrees ([depth, ...] leaves — the same
+layout the scan executor trains and checkpoints,
+`models/transformer.py` `executor="scan"`). Numerical parity with
+sequential execution (fwd AND grads) is pinned by
+`tests/test_gpipe.py` on a virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_pp_mesh(pp: int, devices=None) -> Mesh:
+    """1-axis ('pp',) mesh over the first `pp` devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    assert pp <= len(devices), f"pp={pp} > {len(devices)} devices"
+    return Mesh(np.asarray(devices[:pp]), ("pp",))
+
+
+def stage_params_sharding(mesh: Mesh, params):
+    """Shardings placing depth-stacked [P*L, ...] leaves over the pp axis
+    (leading axis split across stages)."""
+    return jax.tree.map(
+        lambda _: NamedSharding(mesh, P("pp")), params
+    )
+
+
+def pipeline_layers(
+    layer_fn: Callable,
+    stage_params,
+    microbatches: jax.Array,
+    *,
+    axis_name: str,
+    n_micro: int,
+):
+    """The inside-shard_map GPipe stage program (ring.py pattern: a pure
+    per-device function parameterized by `axis_name`, so it composes with
+    ANY caller mesh that carries a pipeline axis — alongside dp/fsdp/tp
+    axes in a pjit train step, not only the standalone mesh
+    `gpipe_apply` builds).
+
+    stage_params: THIS stage's [L, ...] layer slice
+    microbatches: [n_micro, mb, ...] (replicated; only stage 0 reads them)
+    returns       [n_micro, mb, ...] outputs — valid on the LAST stage
+                  (other stages return zeros; callers either slice the
+                  stage axis outside or mask-psum).
+    """
+    n_stages = lax.axis_size(axis_name)
+    p = lax.axis_index(axis_name)
+    fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
+    ticks = n_micro + n_stages - 1
+
+    def run_stage(h):
+        def body(h, lp):
+            return layer_fn(lp, h), None
+
+        h, _ = lax.scan(body, h, stage_params)
+        return h
+
+    zeros_mb = jnp.zeros_like(microbatches[0])
+    outs0 = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        recv, outs = carry
+        # stage 0 injects microbatch t (clipped; the tail ticks feed
+        # zeros through dead slots), later stages process what the
+        # previous stage sent last tick
+        feed = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, n_micro - 1), keepdims=False
+        )
+        feed = jnp.where(t < n_micro, feed, zeros_mb)
+        h = jnp.where(p == 0, feed, recv)
+        y = run_stage(h)
+        recv_next = lax.ppermute(y, axis_name, fwd_perm)
+        # last stage emits microbatch t-(P-1) at tick t
+        out_idx = t - (n_stages - 1)
+        valid = jnp.logical_and(out_idx >= 0, p == n_stages - 1)
+        upd = lax.dynamic_update_index_in_dim(
+            outs, y, jnp.clip(out_idx, 0, n_micro - 1), axis=0
+        )
+        outs = jnp.where(valid, upd, outs)
+        return (recv_next, outs), None
+
+    (_, outs), _ = lax.scan(tick, (zeros_mb, outs0), jnp.arange(ticks))
+    return outs
+
+
+def gpipe_apply(
+    mesh: Mesh,
+    params,
+    layer_fn: Callable,
+    x: jax.Array,
+    n_micro: int,
+):
+    """Run `depth` layers of `layer_fn` over `x`, pipelined over mesh
+    axis 'pp' (standalone-mesh convenience wrapper around
+    `pipeline_layers`).
+
+    params: pytree with [depth, ...] leaves, depth = P * layers_per_stage
+    x:      [batch, ...] activations, batch % n_micro == 0
+    returns [batch, ...] output, numerically equal to the sequential
+            lax.scan over all `depth` layers.
+    """
+    pp = mesh.shape["pp"]
+    depth = jax.tree.leaves(params)[0].shape[0]
+    assert depth % pp == 0, f"depth {depth} not divisible by pp={pp}"
+    batch = x.shape[0]
+    assert batch % n_micro == 0, f"batch {batch} % n_micro {n_micro} != 0"
+    if pp == 1:
+        def body(h, lp):
+            return layer_fn(lp, h), None
+
+        out, _ = lax.scan(body, x, params)
+        return out
+
+    # [depth, ...] -> [P, L, ...] so shard_map splits the stage axis
+    staged = jax.tree.map(
+        lambda a: a.reshape(pp, depth // pp, *a.shape[1:]), params
+    )
+    mb = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+
+    def stage_fn(params_local, mb_local):
+        # shard_map hands each device its [1, L, ...] slice
+        my_layers = jax.tree.map(lambda a: a[0], params_local)
+        outs = pipeline_layers(
+            layer_fn, my_layers, mb_local, axis_name="pp", n_micro=n_micro
+        )
+        # leading stage axis for the out_spec; caller takes the last stage
+        return outs[None]
+
+    outs = jax.shard_map(
+        stage_fn,
+        mesh=mesh,
+        in_specs=(P("pp"), P()),
+        out_specs=P("pp"),
+        check_vma=False,
+    )(staged, mb)
+    return outs[-1].reshape(batch, *x.shape[1:])
