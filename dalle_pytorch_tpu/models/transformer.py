@@ -525,24 +525,30 @@ class Transformer(nn.Module):
             depth=depth,
             remat=self.reversible,
             remat_policy=self.remat_policy,
-            block_kwargs=dict(
-                dim=dim,
-                seq_len=self.seq_len,
-                causal=self.causal,
-                heads=self.heads,
-                dim_head=self.dim_head,
-                ff_mult=self.ff_mult,
-                attn_dropout=self.attn_dropout,
-                ff_dropout=self.ff_dropout,
-                stable=self.stable,
-                sandwich_norm=self.sandwich_norm,
-                shift_tokens=self.shift_tokens,
-                text_len=self.text_len,
-                image_fmap_size=self.image_fmap_size,
-                attn_impl=self.attn_impl,
-                sp_mesh=self.sp_mesh,
-                dtype=self.dtype,
-            ),
+            block_kwargs=self._scan_block_kwargs(),
+        )
+
+    def _scan_block_kwargs(self) -> dict:
+        """_ScanBlock constructor args for this config — pure config math,
+        shared by the scan executor and `pipeline_trunk_apply` so the two
+        can never drift."""
+        return dict(
+            dim=self.dim,
+            seq_len=self.seq_len,
+            causal=self.causal,
+            heads=self.heads,
+            dim_head=self.dim_head,
+            ff_mult=self.ff_mult,
+            attn_dropout=self.attn_dropout,
+            ff_dropout=self.ff_dropout,
+            stable=self.stable,
+            sandwich_norm=self.sandwich_norm,
+            shift_tokens=self.shift_tokens,
+            text_len=self._derived_text_len(),
+            image_fmap_size=self.image_fmap_size,
+            attn_impl=self.attn_impl,
+            sp_mesh=self.sp_mesh,
+            dtype=self.dtype,
         )
 
     def _shift(self, h: jnp.ndarray, ring, pos):
@@ -826,6 +832,73 @@ def unrolled_params_to_scan(tparams: dict, depth: int) -> dict:
         "attn_scale_stack": stack("attn_scale_{}"),
         "ff_scale_stack": stack("ff_scale_{}"),
     }
+
+
+def pipeline_trunk_apply(
+    transformer: "Transformer",
+    tparams: dict,
+    mesh,
+    x: jnp.ndarray,
+    n_micro: int,
+    key_mask: Optional[jnp.ndarray] = None,
+):
+    """Run a scan-executor Transformer's trunk pipeline-parallel over a
+    'pp' mesh (parallel/gpipe.py GPipe schedule).
+
+    `tparams` is the Transformer's own parameter tree in the scan layout
+    ([depth, ...] leaves — the trained/checkpointed layout; convert
+    unrolled checkpoints with `unrolled_params_to_scan`). Numerically
+    equal to `transformer.apply` for the uncached uniform-full-attention
+    deterministic case; restrictions mirror the scan executor's
+    (`_scan_supported`) plus: no per-layer pattern masks, no reverse
+    pass, no dropout (deterministic inference/eval or an externally
+    rematerialized training forward).
+
+    The reference has no pipeline parallelism to cite; this is the
+    TPU-native depth-scaling axis on top of its reversibility story
+    (`/root/reference/dalle_pytorch/reversible.py`).
+    """
+    from dalle_pytorch_tpu.parallel.gpipe import gpipe_apply
+
+    assert transformer.executor == "scan", "pipeline runs the scan layout"
+    reason = transformer._scan_supported()
+    assert reason is None, f"unsupported config for pipelining: {reason}"
+    assert not (transformer.attn_types and any(
+        t != "full" for t in transformer.attn_types
+    )), "pipeline trunk supports uniform full attention only"
+
+    block = _ScanBlock(
+        deterministic=True, **transformer._scan_block_kwargs()
+    )
+    rotary = transformer._build_rotary_table()
+    pp_params = {
+        "block": tparams["scan_stack"]["layers"],
+        "s_attn": tparams["attn_scale_stack"],
+        "s_ff": tparams["ff_scale_stack"],
+    }
+
+    if key_mask is None:
+        def layer_fn(lp, h):
+            y, _ = block.apply(
+                {"params": lp["block"]}, h, lp["s_attn"], lp["s_ff"],
+                None, None, None, None, rotary,
+            )
+            return y
+
+        return gpipe_apply(mesh, pp_params, layer_fn, x, n_micro)
+
+    # key_mask is per-example, so it must ride the microbatch schedule
+    # (each stage masks the microbatch it is currently processing)
+    def layer_fn_masked(lp, h, km):
+        y, _ = block.apply(
+            {"params": lp["block"]}, h, lp["s_attn"], lp["s_ff"],
+            None, None, None, km, rotary,
+        )
+        return y
+
+    return gpipe_apply(
+        mesh, pp_params, layer_fn_masked, x, n_micro, aux=key_mask
+    )
 
 
 def make_decode_cache(

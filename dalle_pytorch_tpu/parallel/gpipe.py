@@ -56,6 +56,7 @@ def pipeline_layers(
     *,
     axis_name: str,
     n_micro: int,
+    aux=None,
 ):
     """The inside-shard_map GPipe stage program (ring.py pattern: a pure
     per-device function parameterized by `axis_name`, so it composes with
@@ -65,6 +66,12 @@ def pipeline_layers(
 
     stage_params: THIS stage's [L, ...] layer slice
     microbatches: [n_micro, mb, ...] (replicated; only stage 0 reads them)
+    aux:          optional pytree of per-microbatch side inputs with
+                  [n_micro, ...] leaves, replicated on every stage (e.g.
+                  a key-padding mask). Each stage indexes the slot it is
+                  CURRENTLY processing (microbatch t - stage), so aux
+                  rides the schedule without any extra permute; when
+                  given, layers are called layer_fn(lp, x, aux_slot).
     returns       [n_micro, mb, ...] outputs — valid on the LAST stage
                   (other stages return zeros; callers either slice the
                   stage axis outside or mask-psum).
@@ -74,9 +81,11 @@ def pipeline_layers(
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
     ticks = n_micro + n_stages - 1
 
-    def run_stage(h):
+    def run_stage(h, aux_slot):
         def body(h, lp):
-            return layer_fn(lp, h), None
+            if aux is None:
+                return layer_fn(lp, h), None
+            return layer_fn(lp, h, aux_slot), None
 
         h, _ = lax.scan(body, h, stage_params)
         return h
@@ -94,7 +103,15 @@ def pipeline_layers(
         )
         feed = jnp.where(t < n_micro, feed, zeros_mb)
         h = jnp.where(p == 0, feed, recv)
-        y = run_stage(h)
+        # the microbatch THIS stage processes this tick
+        mb_idx = jnp.clip(t - p, 0, n_micro - 1)
+        aux_slot = (
+            None if aux is None else jax.tree.map(
+                lambda a: lax.dynamic_index_in_dim(a, mb_idx, keepdims=False),
+                aux,
+            )
+        )
+        y = run_stage(h, aux_slot)
         recv_next = lax.ppermute(y, axis_name, fwd_perm)
         # last stage emits microbatch t-(P-1) at tick t
         out_idx = t - (n_stages - 1)
@@ -115,6 +132,7 @@ def gpipe_apply(
     layer_fn: Callable,
     x: jax.Array,
     n_micro: int,
+    aux=None,
 ):
     """Run `depth` layers of `layer_fn` over `x`, pipelined over mesh
     axis 'pp' (standalone-mesh convenience wrapper around
@@ -122,6 +140,9 @@ def gpipe_apply(
 
     params: pytree with [depth, ...] leaves, depth = P * layers_per_stage
     x:      [batch, ...] activations, batch % n_micro == 0
+    aux:    optional pytree of batch-leading side inputs ([batch, ...]
+            leaves, e.g. a key mask), microbatched alongside x and fed to
+            layer_fn(lp, x, aux_slot)
     returns [batch, ...] output, numerically equal to the sequential
             lax.scan over all `depth` layers.
     """
@@ -130,9 +151,15 @@ def gpipe_apply(
     assert depth % pp == 0, f"depth {depth} not divisible by pp={pp}"
     batch = x.shape[0]
     assert batch % n_micro == 0, f"batch {batch} % n_micro {n_micro} != 0"
+
+    def micro(a):
+        return a.reshape(n_micro, batch // n_micro, *a.shape[1:])
+
     if pp == 1:
         def body(h, lp):
-            return layer_fn(lp, h), None
+            if aux is None:
+                return layer_fn(lp, h), None
+            return layer_fn(lp, h, aux), None
 
         out, _ = lax.scan(body, x, params)
         return out
@@ -141,22 +168,35 @@ def gpipe_apply(
     staged = jax.tree.map(
         lambda a: a.reshape(pp, depth // pp, *a.shape[1:]), params
     )
-    mb = x.reshape(n_micro, batch // n_micro, *x.shape[1:])
+    mb = micro(x)
+    mb_aux = None if aux is None else jax.tree.map(micro, aux)
 
-    def stage_fn(params_local, mb_local):
+    def stage_fn(params_local, mb_local, aux_local):
         # shard_map hands each device its [1, L, ...] slice
         my_layers = jax.tree.map(lambda a: a[0], params_local)
         outs = pipeline_layers(
-            layer_fn, my_layers, mb_local, axis_name="pp", n_micro=n_micro
+            layer_fn, my_layers, mb_local, axis_name="pp",
+            n_micro=n_micro, aux=aux_local,
         )
         # leading stage axis for the out_spec; caller takes the last stage
         return outs[None]
 
-    outs = jax.shard_map(
-        stage_fn,
-        mesh=mesh,
-        in_specs=(P("pp"), P()),
-        out_specs=P("pp"),
-        check_vma=False,
-    )(staged, mb)
+    if mb_aux is None:
+        sharded = jax.shard_map(
+            lambda p_, m_: stage_fn(p_, m_, None),
+            mesh=mesh,
+            in_specs=(P("pp"), P()),
+            out_specs=P("pp"),
+            check_vma=False,
+        )
+        outs = sharded(staged, mb)
+    else:
+        sharded = jax.shard_map(
+            stage_fn,
+            mesh=mesh,
+            in_specs=(P("pp"), P(), P()),
+            out_specs=P("pp"),
+            check_vma=False,
+        )
+        outs = sharded(staged, mb, mb_aux)
     return outs[-1].reshape(batch, *x.shape[1:])

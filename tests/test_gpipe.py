@@ -79,53 +79,47 @@ def test_grads_match_sequential():
     )
 
 
-def test_pipelines_real_scan_block():
-    """The engine runs the PRODUCTION transformer block: a scan-executor
-    Transformer's depth-stacked params ([depth, ...] leaves — the same
-    checkpoint layout) are pipelined over 4 stages via _ScanBlock.apply
-    and must reproduce the Transformer's own output."""
-    import jax.numpy as jnp
-
-    from dalle_pytorch_tpu.models.transformer import Transformer, _ScanBlock
+@pytest.mark.parametrize("rotary", [False, True])
+def test_pipelines_real_transformer_trunk(rotary):
+    """pipeline_trunk_apply runs the PRODUCTION trunk: a scan-executor
+    Transformer's own param tree (the checkpoint layout) pipelined over
+    4 stages must reproduce transformer.apply — with token-shift and
+    dual-rotary embeddings on."""
+    from dalle_pytorch_tpu.models.transformer import (
+        Transformer,
+        pipeline_trunk_apply,
+    )
 
     dim, depth, heads, dim_head, fmap = 32, 4, 2, 16, 4
     seq_len = 24  # text 9 + image 16, minus the shifted-in bos slot
     tr = Transformer(
         dim=dim, depth=depth, heads=heads, dim_head=dim_head,
         seq_len=seq_len, causal=True, image_fmap_size=fmap,
-        shift_tokens=True, rotary_emb=False, attn_impl="dense",
+        shift_tokens=True, rotary_emb=rotary, attn_impl="dense",
         executor="scan",
     )
     x = jax.random.normal(jax.random.PRNGKey(0), (BATCH, seq_len, dim))
     params = tr.init(jax.random.PRNGKey(1), x)["params"]
     want = tr.apply({"params": params}, x)
 
-    block = _ScanBlock(
-        dim=dim, seq_len=seq_len, causal=True, heads=heads,
-        dim_head=dim_head, ff_mult=4.0, attn_dropout=0.0, ff_dropout=0.0,
-        stable=False, sandwich_norm=False, shift_tokens=True,
-        text_len=seq_len - fmap**2 + 1, image_fmap_size=fmap,
-        attn_impl="dense", sp_mesh=None, deterministic=True,
-        dtype=jnp.float32,
-    )
-    pp_params = {
-        "block": params["scan_stack"]["layers"],
-        "s_attn": params["attn_scale_stack"],
-        "s_ff": params["ff_scale_stack"],
-    }
-
-    def layer_fn(lp, h):
-        y, _ = block.apply(
-            {"params": lp["block"]}, h, lp["s_attn"], lp["s_ff"],
-            None, None, None, None, None,
-        )
-        return y
-
-    mesh = make_pp_mesh(4)
     got = jax.jit(
-        lambda p, x: gpipe_apply(mesh, p, layer_fn, x, n_micro=2)
-    )(pp_params, x)
+        lambda p, x: pipeline_trunk_apply(tr, p, make_pp_mesh(4), x, 2)
+    )(params, x)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+    # per-example key-padding mask rides the microbatch schedule (aux)
+    mask = jnp.arange(seq_len)[None, :] < jnp.arange(
+        seq_len - BATCH, seq_len
+    )[:, None]
+    want_m = tr.apply({"params": params}, x, key_mask=mask)
+    got_m = jax.jit(
+        lambda p, x, m: pipeline_trunk_apply(
+            tr, p, make_pp_mesh(4), x, 2, key_mask=m
+        )
+    )(params, x, mask)
+    np.testing.assert_allclose(
+        np.asarray(got_m), np.asarray(want_m), atol=1e-5
+    )
 
 
 def test_trains_with_sharded_params():
