@@ -133,17 +133,11 @@ class DALLE(nn.Module):
     def total_tokens(self) -> int:
         return self.total_text_tokens + self.num_image_tokens
 
-    def setup(self):
-        self.text_emb = nn.Embed(self.total_text_tokens, self.dim, dtype=self.dtype)
-        self.image_emb = nn.Embed(self.num_image_tokens, self.dim, dtype=self.dtype)
-
-        if not self.rotary_emb:
-            self.text_pos_emb = nn.Embed(self.text_seq_len + 1, self.dim, dtype=self.dtype)
-            self.image_pos_emb = AxialPositionalEmbedding(
-                self.dim, self.image_fmap_size, self.image_fmap_size
-            )
-
-        self.transformer = Transformer(
+    def transformer_kwargs(self) -> dict:
+        """Trunk Transformer constructor args — pure config math, usable
+        on an UNBOUND DALLE too (e.g. to rebuild the trunk module for
+        `pipeline_trunk_apply` outside this module's apply)."""
+        return dict(
             dim=self.dim,
             depth=self.depth,
             seq_len=self.total_seq_len,
@@ -168,6 +162,18 @@ class DALLE(nn.Module):
             executor=self.executor,
             dtype=self.dtype,
         )
+
+    def setup(self):
+        self.text_emb = nn.Embed(self.total_text_tokens, self.dim, dtype=self.dtype)
+        self.image_emb = nn.Embed(self.num_image_tokens, self.dim, dtype=self.dtype)
+
+        if not self.rotary_emb:
+            self.text_pos_emb = nn.Embed(self.text_seq_len + 1, self.dim, dtype=self.dtype)
+            self.image_pos_emb = AxialPositionalEmbedding(
+                self.dim, self.image_fmap_size, self.image_fmap_size
+            )
+
+        self.transformer = Transformer(**self.transformer_kwargs())
 
         if self.stable:
             self.norm_by_max = DivideMax(axis=-1)
@@ -324,11 +330,20 @@ class DALLE(nn.Module):
         reverse_model: bool = False,
         null_cond_prob: float = 0.0,
         deterministic: bool = True,
+        trunk_fn=None,
     ):
         """text: [B, text_seq_len] int ids; image: [B, <=image_seq_len] codebook ids.
 
         Raw-pixel image input is handled by the pipeline (frozen VAE encode)
         before this call — see module docstring.
+
+        `trunk_fn` (optional) substitutes the transformer trunk:
+        embeddings -> trunk_fn(tokens) -> head. Used to run the trunk
+        under a different executor from OUTSIDE the module — e.g.
+        pipeline-parallel via `transformer.make_pipeline_trunk` (build
+        the closure OUTSIDE apply; flax intercepts module construction
+        inside a parent scope) with the trunk params sharded over a pp
+        mesh (see tests/test_gpipe.py). Deterministic forward only.
         """
         text, tokens = self.embed_text(text, null_cond_prob)
 
@@ -350,9 +365,16 @@ class DALLE(nn.Module):
             alpha = 0.1
             tokens = tokens * alpha + jax.lax.stop_gradient(tokens) * (1 - alpha)
 
-        out = self.transformer(
-            tokens, reverse_model=reverse_model, deterministic=deterministic
-        )
+        if trunk_fn is not None:
+            assert not reverse_model, "trunk_fn callers own the layer order"
+            # loud, like the reverse_model assert: the pipeline block is
+            # hard-wired deterministic, so dropout would silently vanish
+            assert deterministic, "trunk_fn supports deterministic only"
+            out = trunk_fn(tokens)
+        else:
+            out = self.transformer(
+                tokens, reverse_model=reverse_model, deterministic=deterministic
+            )
 
         if return_loss and self.fused_ce and not self.is_initializing():
             # vocab-chunked CE: never materializes [B, N, V] logits

@@ -834,16 +834,10 @@ def unrolled_params_to_scan(tparams: dict, depth: int) -> dict:
     }
 
 
-def pipeline_trunk_apply(
-    transformer: "Transformer",
-    tparams: dict,
-    mesh,
-    x: jnp.ndarray,
-    n_micro: int,
-    key_mask: Optional[jnp.ndarray] = None,
-):
-    """Run a scan-executor Transformer's trunk pipeline-parallel over a
-    'pp' mesh (parallel/gpipe.py GPipe schedule).
+def make_pipeline_trunk(transformer: "Transformer", mesh, n_micro: int):
+    """Build `fn(tparams, x, key_mask=None)` running this Transformer
+    config's trunk pipeline-parallel over a 'pp' mesh
+    (parallel/gpipe.py GPipe schedule).
 
     `tparams` is the Transformer's own parameter tree in the scan layout
     ([depth, ...] leaves — the trained/checkpointed layout; convert
@@ -853,6 +847,11 @@ def pipeline_trunk_apply(
     (`_scan_supported`) plus: no per-layer pattern masks, no reverse
     pass, no dropout (deterministic inference/eval or an externally
     rematerialized training forward).
+
+    The block module is constructed HERE, at make time — flax intercepts
+    module construction inside a parent module's scope, so building the
+    returned closure outside any `apply` lets it serve as a
+    `DALLE(..., trunk_fn=...)` override inside the model's own apply.
 
     The reference has no pipeline parallelism to cite; this is the
     TPU-native depth-scaling axis on top of its reversibility story
@@ -871,33 +870,53 @@ def pipeline_trunk_apply(
         deterministic=True, **transformer._scan_block_kwargs()
     )
     rotary = transformer._build_rotary_table()
-    pp_params = {
-        "block": tparams["scan_stack"]["layers"],
-        "s_attn": tparams["attn_scale_stack"],
-        "s_ff": tparams["ff_scale_stack"],
-    }
 
-    if key_mask is None:
-        def layer_fn(lp, h):
+    def run(tparams: dict, x: jnp.ndarray,
+            key_mask: Optional[jnp.ndarray] = None):
+        pp_params = {
+            "block": tparams["scan_stack"]["layers"],
+            "s_attn": tparams["attn_scale_stack"],
+            "s_ff": tparams["ff_scale_stack"],
+        }
+
+        if key_mask is None:
+            def layer_fn(lp, h):
+                y, _ = block.apply(
+                    {"params": lp["block"]}, h, lp["s_attn"], lp["s_ff"],
+                    None, None, None, None, rotary,
+                )
+                return y
+
+            return gpipe_apply(mesh, pp_params, layer_fn, x, n_micro)
+
+        # key_mask is per-example, so it must ride the microbatch
+        # schedule (each stage masks the microbatch it is processing)
+        def layer_fn_masked(lp, h, km):
             y, _ = block.apply(
                 {"params": lp["block"]}, h, lp["s_attn"], lp["s_ff"],
-                None, None, None, None, rotary,
+                None, None, None, km, rotary,
             )
             return y
 
-        return gpipe_apply(mesh, pp_params, layer_fn, x, n_micro)
-
-    # key_mask is per-example, so it must ride the microbatch schedule
-    # (each stage masks the microbatch it is currently processing)
-    def layer_fn_masked(lp, h, km):
-        y, _ = block.apply(
-            {"params": lp["block"]}, h, lp["s_attn"], lp["s_ff"],
-            None, None, None, km, rotary,
+        return gpipe_apply(
+            mesh, pp_params, layer_fn_masked, x, n_micro, aux=key_mask
         )
-        return y
 
-    return gpipe_apply(
-        mesh, pp_params, layer_fn_masked, x, n_micro, aux=key_mask
+    return run
+
+
+def pipeline_trunk_apply(
+    transformer: "Transformer",
+    tparams: dict,
+    mesh,
+    x: jnp.ndarray,
+    n_micro: int,
+    key_mask: Optional[jnp.ndarray] = None,
+):
+    """One-shot convenience over `make_pipeline_trunk` (standalone use,
+    outside any flax module scope)."""
+    return make_pipeline_trunk(transformer, mesh, n_micro)(
+        tparams, x, key_mask
     )
 
 
