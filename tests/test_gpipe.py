@@ -122,6 +122,35 @@ def test_pipelines_real_transformer_trunk(rotary):
     )
 
 
+def test_pipelines_unrolled_checkpoint_via_converter():
+    """A trunk trained/checkpointed under the UNROLLED executor pipelines
+    after unrolled_params_to_scan: legacy layout -> scan layout ->
+    4-stage pipeline == the unrolled module's own forward."""
+    from dalle_pytorch_tpu.models.transformer import (
+        Transformer,
+        pipeline_trunk_apply,
+        unrolled_params_to_scan,
+    )
+
+    kw = dict(
+        dim=32, depth=4, heads=2, dim_head=16, seq_len=24, causal=True,
+        image_fmap_size=4, shift_tokens=True, rotary_emb=True,
+        attn_impl="dense",
+    )
+    unrolled = Transformer(**kw)
+    x = jax.random.normal(jax.random.PRNGKey(0), (BATCH, 24, 32))
+    uparams = unrolled.init(jax.random.PRNGKey(1), x)["params"]
+    want = unrolled.apply({"params": uparams}, x)
+
+    sparams = unrolled_params_to_scan(uparams, depth=4)
+    got = jax.jit(
+        lambda p, x: pipeline_trunk_apply(
+            Transformer(executor="scan", **kw), p, make_pp_mesh(4), x, 2
+        )
+    )(sparams, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
 def test_dalle_loss_with_pipelined_trunk():
     """End-to-end DALLE training loss with the trunk run pipeline-
     parallel (trunk_fn override): loss AND grads match the plain
