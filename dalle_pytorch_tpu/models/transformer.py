@@ -487,27 +487,9 @@ class Transformer(nn.Module):
         # per-layer), and a depth-stacked copy of each would cost
         # depth/n_types more device memory for no information. Builders may
         # return [S+1, S+1] or block-padded sizes; crop uniformly to [S, S].
-        attn_types = tuple(self.attn_types) if self.attn_types else ("full",)
-        type_per_layer = list(islice(cycle(attn_types), depth))
-        if any(t != "full" for t in type_per_layer):
-            S = self.seq_len
-            table, index_of, idx = [], {}, []
-            for ind, t in enumerate(type_per_layer):
-                m = _build_static_mask(t, S, self.image_fmap_size, ind)
-                if m is None:
-                    m = np.ones((S, S), dtype=bool)
-                else:
-                    m = np.asarray(m)[:S, :S]
-                key = m.tobytes()
-                if key not in index_of:
-                    index_of[key] = len(table)
-                    table.append(m)
-                idx.append(index_of[key])
-            self.scan_pattern_table = jnp.asarray(np.stack(table))
-            self.scan_pattern_idx = jnp.asarray(np.array(idx, np.int32))
-        else:
-            self.scan_pattern_table = None
-            self.scan_pattern_idx = None
+        self.scan_pattern_table, self.scan_pattern_idx = (
+            self._build_pattern_table()
+        )
 
         def stacked_scale_init(key, shape):
             del key  # deterministic depth-dependent init (layerscale_init)
@@ -526,6 +508,33 @@ class Transformer(nn.Module):
             remat=self.reversible,
             remat_policy=self.remat_policy,
             block_kwargs=self._scan_block_kwargs(),
+        )
+
+    def _build_pattern_table(self):
+        """(unique-mask table [K, S, S], per-layer index [depth]) for the
+        attn-type cycle, or (None, None) for uniform full attention.
+        Pure config math (usable unbound — the pipeline executor rebuilds
+        it outside this module's scope)."""
+        attn_types = tuple(self.attn_types) if self.attn_types else ("full",)
+        type_per_layer = list(islice(cycle(attn_types), self.depth))
+        if not any(t != "full" for t in type_per_layer):
+            return None, None
+        S = self.seq_len
+        table, index_of, idx = [], {}, []
+        for ind, t in enumerate(type_per_layer):
+            m = _build_static_mask(t, S, self.image_fmap_size, ind)
+            if m is None:
+                m = np.ones((S, S), dtype=bool)
+            else:
+                m = np.asarray(m)[:S, :S]
+            key = m.tobytes()
+            if key not in index_of:
+                index_of[key] = len(table)
+                table.append(m)
+            idx.append(index_of[key])
+        return (
+            jnp.asarray(np.stack(table)),
+            jnp.asarray(np.array(idx, np.int32)),
         )
 
     def _scan_block_kwargs(self) -> dict:
@@ -842,11 +851,12 @@ def make_pipeline_trunk(transformer: "Transformer", mesh, n_micro: int):
     `tparams` is the Transformer's own parameter tree in the scan layout
     ([depth, ...] leaves — the trained/checkpointed layout; convert
     unrolled checkpoints with `unrolled_params_to_scan`). Numerically
-    equal to `transformer.apply` for the uncached uniform-full-attention
-    deterministic case; restrictions mirror the scan executor's
-    (`_scan_supported`) plus: no per-layer pattern masks, no reverse
-    pass, no dropout (deterministic inference/eval or an externally
-    rematerialized training forward).
+    equal to `transformer.apply` for the uncached deterministic case —
+    including the attn-type cycle (per-layer pattern-mask indices ride
+    with each stage's layer slice). Restrictions mirror the scan
+    executor's (`_scan_supported`) plus: no reverse pass, no dropout
+    (deterministic inference/eval or an externally rematerialized
+    training forward).
 
     The block module is constructed HERE, at make time — flax intercepts
     module construction inside a parent module's scope, so building the
@@ -862,14 +872,15 @@ def make_pipeline_trunk(transformer: "Transformer", mesh, n_micro: int):
     assert transformer.executor == "scan", "pipeline runs the scan layout"
     reason = transformer._scan_supported()
     assert reason is None, f"unsupported config for pipelining: {reason}"
-    assert not (transformer.attn_types and any(
-        t != "full" for t in transformer.attn_types
-    )), "pipeline trunk supports uniform full attention only"
 
     block = _ScanBlock(
         deterministic=True, **transformer._scan_block_kwargs()
     )
     rotary = transformer._build_rotary_table()
+    # attn-type cycling: the per-layer index into the unique-mask table is
+    # depth-leading, so it rides WITH each stage's layer slice; the small
+    # table itself is closed over (replicated), same as the scan executor
+    pattern_table, pattern_idx = transformer._build_pattern_table()
 
     def run(tparams: dict, x: jnp.ndarray,
             key_mask: Optional[jnp.ndarray] = None):
@@ -878,28 +889,27 @@ def make_pipeline_trunk(transformer: "Transformer", mesh, n_micro: int):
             "s_attn": tparams["attn_scale_stack"],
             "s_ff": tparams["ff_scale_stack"],
         }
+        if pattern_idx is not None:
+            pp_params["pidx"] = pattern_idx
 
-        if key_mask is None:
-            def layer_fn(lp, h):
-                y, _ = block.apply(
-                    {"params": lp["block"]}, h, lp["s_attn"], lp["s_ff"],
-                    None, None, None, None, rotary,
-                )
-                return y
-
-            return gpipe_apply(mesh, pp_params, layer_fn, x, n_micro)
-
-        # key_mask is per-example, so it must ride the microbatch
-        # schedule (each stage masks the microbatch it is processing)
-        def layer_fn_masked(lp, h, km):
+        def call_block(lp, h, km):
+            pidx = lp["pidx"] if pattern_idx is not None else None
             y, _ = block.apply(
                 {"params": lp["block"]}, h, lp["s_attn"], lp["s_ff"],
-                None, None, None, km, rotary,
+                pidx, pattern_table, None, km, rotary,
             )
             return y
 
+        if key_mask is None:
+            return gpipe_apply(
+                mesh, pp_params, lambda lp, h: call_block(lp, h, None),
+                x, n_micro,
+            )
+
+        # key_mask is per-example, so it must ride the microbatch
+        # schedule (each stage masks the microbatch it is processing)
         return gpipe_apply(
-            mesh, pp_params, layer_fn_masked, x, n_micro, aux=key_mask
+            mesh, pp_params, call_block, x, n_micro, aux=key_mask
         )
 
     return run

@@ -79,12 +79,17 @@ def test_grads_match_sequential():
     )
 
 
-@pytest.mark.parametrize("rotary", [False, True])
-def test_pipelines_real_transformer_trunk(rotary):
+@pytest.mark.parametrize(
+    "rotary,attn_types",
+    [(False, None), (True, None),
+     (True, ("full", "axial_row", "axial_col", "conv_like"))],
+)
+def test_pipelines_real_transformer_trunk(rotary, attn_types):
     """pipeline_trunk_apply runs the PRODUCTION trunk: a scan-executor
     Transformer's own param tree (the checkpoint layout) pipelined over
-    4 stages must reproduce transformer.apply — with token-shift and
-    dual-rotary embeddings on."""
+    4 stages must reproduce transformer.apply — with token-shift,
+    dual-rotary embeddings, and the reference's sparse attn-type cycle
+    (per-layer pattern indices ride with each stage's layer slice)."""
     from dalle_pytorch_tpu.models.transformer import (
         Transformer,
         pipeline_trunk_apply,
@@ -96,7 +101,7 @@ def test_pipelines_real_transformer_trunk(rotary):
         dim=dim, depth=depth, heads=heads, dim_head=dim_head,
         seq_len=seq_len, causal=True, image_fmap_size=fmap,
         shift_tokens=True, rotary_emb=rotary, attn_impl="dense",
-        executor="scan",
+        attn_types=attn_types, executor="scan",
     )
     x = jax.random.normal(jax.random.PRNGKey(0), (BATCH, seq_len, dim))
     params = tr.init(jax.random.PRNGKey(1), x)["params"]
