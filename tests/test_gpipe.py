@@ -127,6 +127,46 @@ def test_pipelines_real_transformer_trunk(rotary, attn_types):
     )
 
 
+def test_composes_with_data_parallel_axis():
+    """pipeline_layers is axis-parameterized (ring.py pattern), so it
+    runs inside a 2-axis ('dp', 'pp') mesh: batch sharded over dp, each
+    dp row driving its own 4-stage pipeline — the composition
+    gpipe_apply's standalone mesh cannot express."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dalle_pytorch_tpu.parallel.gpipe import pipeline_layers
+
+    params = _params(jax.random.PRNGKey(6))
+    x = jax.random.normal(jax.random.PRNGKey(7), (BATCH, SEQ, DIM))
+    want = _sequential(params, x)
+
+    dp, pp, n_micro = 2, 4, 2
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(dp, pp), ("dp", "pp"))
+    staged = jax.tree.map(
+        lambda a: a.reshape(pp, DEPTH // pp, *a.shape[1:]), params
+    )
+    mb = x.reshape(n_micro, BATCH // n_micro, SEQ, DIM)
+
+    def stage_fn(params_local, mb_local):
+        my_layers = jax.tree.map(lambda a: a[0], params_local)
+        outs = pipeline_layers(
+            _layer, my_layers, mb_local, axis_name="pp", n_micro=n_micro
+        )
+        return outs[None]
+
+    outs = jax.jit(
+        jax.shard_map(
+            stage_fn,
+            mesh=mesh,
+            in_specs=(P("pp"), P(None, "dp")),  # batch rows over dp
+            out_specs=P("pp", None, "dp"),
+            check_vma=False,
+        )
+    )(staged, mb)
+    got = outs[-1].reshape(BATCH, SEQ, DIM)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
+
+
 def test_pipelines_unrolled_checkpoint_via_converter():
     """A trunk trained/checkpointed under the UNROLLED executor pipelines
     after unrolled_params_to_scan: legacy layout -> scan layout ->
