@@ -72,6 +72,13 @@ from dalle_pytorch_tpu.ops.shift import (
 )
 
 
+def resolve_remat_policy(name: "Optional[str]"):
+    """`jax.checkpoint_policies` member by name, or None (save nothing).
+    Single resolution point for all three executors (scan, unrolled
+    remat, pipeline) so their activation-memory behavior cannot drift."""
+    return getattr(jax.checkpoint_policies, name) if name else None
+
+
 def layerscale_init(layer_index: int) -> float:
     """LayerScale init epsilon by 1-based layer index (`transformer.py:79-84`)."""
     if layer_index <= 18:
@@ -268,13 +275,12 @@ class _ScanStack(nn.Module):
                  deterministic: bool = True):
         body = _ScanBlock
         if self.remat and cache is None:
-            policy = (
-                getattr(jax.checkpoint_policies, self.remat_policy)
-                if self.remat_policy
-                else None
-            )
             # prevent_cse=False is safe (and recommended) under scan
-            body = nn.remat(body, policy=policy, prevent_cse=False)
+            body = nn.remat(
+                body,
+                policy=resolve_remat_policy(self.remat_policy),
+                prevent_cse=False,
+            )
         # attn-type cycling: each layer picks its pattern mask from the
         # broadcast table of UNIQUE masks via a scanned [depth] index;
         # None (uniform full attention) broadcasts through. The decode
@@ -762,12 +768,9 @@ class Transformer(nn.Module):
                 def layer_fn(mdl, y, i=i):
                     return mdl._layer(i, y, key_mask, None, deterministic)[0]
 
-                policy = (
-                    getattr(jax.checkpoint_policies, self.remat_policy)
-                    if self.remat_policy
-                    else None
-                )
-                x = nn.remat(layer_fn, policy=policy)(self, x)
+                x = nn.remat(
+                    layer_fn, policy=resolve_remat_policy(self.remat_policy)
+                )(self, x)
             else:
                 x, layer_cache = self._layer(
                     i, x, key_mask, cache[f"layer_{i}"] if cache else None, deterministic
@@ -899,6 +902,16 @@ def make_pipeline_trunk(transformer: "Transformer", mesh, n_micro: int):
                 pidx, pattern_table, None, km, rotary,
             )
             return y
+
+        if transformer.reversible:
+            # honor the config's activation-memory setting: per-layer
+            # rematerialization (same policy the scan executor wraps via
+            # nn.remat) — values unchanged, backward recomputes
+            call_block = jax.checkpoint(
+                call_block,
+                policy=resolve_remat_policy(transformer.remat_policy),
+                prevent_cse=False,
+            )
 
         if key_mask is None:
             return gpipe_apply(
