@@ -9,18 +9,35 @@ re-creates that dataset as a deterministic numpy renderer (no cairo
 dependency) usable both as a pytest fixture and as a real training set for
 the integration run.
 
-Captions: "<size> <color> <shape>" over sizes {small, large},
-9 colors, shapes {circle, square, triangle}.
+Like the notebook (cell 8: 4 scales x 2 fills x 3 ditherers x 12 colors x
+8 shapes x 4 rotations = 9216 variations, one image file PER caption), the
+dataset is a full cross-product in which **the caption uniquely determines
+the image** — the property that makes "exact token-sequence accuracy 1.0
+on train" achievable at all. (The map is not injective: rotation words on
+rotation-symmetric shapes — e.g. any rotated circle — yield distinct
+captions with pixel-identical images, exactly as in the notebook's 9,216
+files; a held-out caption can therefore share its image with a training
+caption, which mildly flatters held-out exact-match, as it did in the
+reference.) Captions:
+"<size> [outline] [texture] <color> <shape> [rotation]" over 4 sizes,
+12 colors, 8 shapes, filled/outline, 3 textures, 4 rotations = 9216 combos.
+
+When ``num_samples`` exceeds the number of unique combos the dataset falls
+back to cycling combos with a small deterministic center jitter; repeated
+captions then map to several slightly different images, so exact-match is
+capped below 1.0 by construction — per-token accuracy is the cleaner
+signal in that regime.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import Tuple
 
 import numpy as np
 
-SIZES = ("small", "large")
+SIZE_RADII = {"tiny": 0.10, "small": 0.16, "large": 0.24, "huge": 0.32}
+SIZES = tuple(SIZE_RADII)
 COLORS = {
     "red": (0.9, 0.1, 0.1),
     "orange": (1.0, 0.55, 0.0),
@@ -31,8 +48,49 @@ COLORS = {
     "purple": (0.55, 0.15, 0.8),
     "pink": (0.95, 0.5, 0.7),
     "white": (0.95, 0.95, 0.95),
+    "gray": (0.55, 0.55, 0.55),
+    "brown": (0.55, 0.33, 0.12),
+    "magenta": (0.85, 0.1, 0.85),
 }
-SHAPES = ("circle", "square", "triangle")
+SHAPES = (
+    "circle", "square", "triangle", "rhombus",
+    "rectangle", "star", "hexagon", "cross",
+)
+FILLS = ("", "outline")  # "" = filled (like the notebook's unnamed default)
+TEXTURES = ("", "striped", "checker")
+ROTATIONS = ("", "rotated", "rotated twice", "rotated thrice")
+
+
+def _sdf(shape: str, dx: np.ndarray, dy: np.ndarray, r: float) -> np.ndarray:
+    """Signed distance (px) to the shape boundary; negative = inside."""
+    if shape == "circle":
+        return np.sqrt(dx**2 + dy**2) - r
+    if shape == "square":
+        return np.maximum(np.abs(dx), np.abs(dy)) - r * 0.9
+    if shape == "triangle":
+        h = r * 1.2
+        d1 = dy - h * 0.6
+        d2 = 0.866 * dx + 0.5 * dy - h * 0.6
+        d3 = -0.866 * dx + 0.5 * dy - h * 0.6
+        return np.maximum.reduce([d1, d2, d3])
+    if shape == "rhombus":  # narrow diamond (distinct from a rotated square)
+        return (np.abs(dx) * 1.6 + np.abs(dy)) * 0.75 - r
+    if shape == "rectangle":  # wide: half-width r, half-height r/2.2
+        return np.maximum(np.abs(dx), np.abs(dy) * 2.2) - r
+    if shape == "star":  # hexagram = union of up and down triangles
+        up = _sdf("triangle", dx, dy, r)
+        down = _sdf("triangle", dx, -dy, r)
+        return np.minimum(up, down)
+    if shape == "hexagon":
+        return (
+            np.maximum(0.866 * np.abs(dx) + 0.5 * np.abs(dy), np.abs(dy))
+            - r * 0.9
+        )
+    if shape == "cross":  # union of a wide and a tall bar
+        wide = np.maximum(np.abs(dx), np.abs(dy) * 2.8) - r
+        tall = np.maximum(np.abs(dx) * 2.8, np.abs(dy)) - r
+        return np.minimum(wide, tall)
+    raise ValueError(f"unknown shape {shape}")
 
 
 def render_shape(
@@ -41,41 +99,72 @@ def render_shape(
     size: str,
     image_size: int = 32,
     jitter: Tuple[float, float] = (0.0, 0.0),
+    *,
+    fill: str = "",
+    texture: str = "",
+    rotation: int = 0,
 ) -> np.ndarray:
-    """Render one anti-aliased shape on a black background. [H, W, 3] in [0,1]."""
+    """Render one anti-aliased shape on a black background. [H, W, 3] in [0,1].
+
+    ``fill="outline"`` draws only a ~2 px interior ring; ``texture`` dims
+    alternating stripes/checker cells; ``rotation`` is the number of 90°
+    turns applied to the rendered image (mirrors the notebook's np.rot90
+    post-pass, cell 7).
+    """
     n = image_size
     yy, xx = np.mgrid[0:n, 0:n].astype(np.float64) + 0.5
     cx = n / 2 + jitter[0] * n * 0.1
     cy = n / 2 + jitter[1] * n * 0.1
-    r = n * (0.18 if size == "small" else 0.34)
+    r = n * SIZE_RADII[size]
 
-    if shape == "circle":
-        dist = np.sqrt((xx - cx) ** 2 + (yy - cy) ** 2) - r
-    elif shape == "square":
-        dist = np.maximum(np.abs(xx - cx), np.abs(yy - cy)) - r
-    elif shape == "triangle":
-        # equilateral triangle pointing up: intersection of 3 half-planes
-        h = r * 1.2
-        d1 = (yy - cy) - h * 0.6  # below the base
-        d2 = 0.866 * (xx - cx) + 0.5 * (yy - cy) - h * 0.6
-        d3 = -0.866 * (xx - cx) + 0.5 * (yy - cy) - h * 0.6
-        dist = np.maximum.reduce([d1, d2, d3])
+    dist = _sdf(shape, xx - cx, yy - cy, r)
+    if fill == "outline":
+        # band centered 1 px inside the boundary, ~2 px wide
+        alpha = np.clip(0.5 - (np.abs(dist + 1.0) - 1.0), 0.0, 1.0)
     else:
-        raise ValueError(f"unknown shape {shape}")
+        alpha = np.clip(0.5 - dist, 0.0, 1.0)  # 1px anti-alias band
 
-    alpha = np.clip(0.5 - dist, 0.0, 1.0)  # 1px anti-alias band
+    if texture == "striped":
+        tex = np.where((yy.astype(np.int64) // 2) % 2 == 0, 1.0, 0.3)
+    elif texture == "checker":
+        tex = np.where(
+            ((xx.astype(np.int64) // 3) + (yy.astype(np.int64) // 3)) % 2 == 0,
+            1.0, 0.3,
+        )
+    else:
+        tex = 1.0
+
     img = np.zeros((n, n, 3))
+    shade = alpha * tex
     for c in range(3):
-        img[..., c] = alpha * color[c]
+        img[..., c] = shade * color[c]
+    if rotation:
+        img = np.rot90(img, rotation, axes=(0, 1)).copy()
     return img.astype(np.float32)
+
+
+def _all_combos():
+    return [
+        {"size": s, "fill": f, "texture": t, "color": c, "shape": sh,
+         "rotation": rot}
+        for s in SIZES
+        for f in FILLS
+        for t in TEXTURES
+        for c in COLORS
+        for sh in SHAPES
+        for rot in range(len(ROTATIONS))
+    ]
 
 
 @dataclass
 class RainbowDataset:
-    """Deterministic caption->image dataset.
+    """Deterministic caption->image dataset (caption-unique cross-product).
 
-    num_samples combinations are cycled over (size, color, shape) with a
-    small deterministic center jitter so repeated combos differ slightly.
+    Up to 9,216 unique (size, fill, texture, color, shape, rotation) combos
+    are sampled without replacement in a seed-shuffled order, so every
+    caption maps to exactly one image — the property behind the reference
+    notebook's exact-match bar. Past the combo count, combos cycle with a
+    small deterministic center jitter (caption-ambiguous; see module doc).
     """
 
     num_samples: int = 1024
@@ -84,25 +173,31 @@ class RainbowDataset:
 
     def __post_init__(self):
         rng = np.random.RandomState(self.seed)
-        combos = [
-            (s, c, sh) for s in SIZES for c in COLORS for sh in SHAPES
-        ]
-        idx = np.arange(self.num_samples) % len(combos)
-        rng.shuffle(idx)
+        combos = _all_combos()
+        order = rng.permutation(len(combos))
+        idx = order[np.arange(self.num_samples) % len(combos)]
         self._combos = [combos[i] for i in idx]
-        self._jitter = rng.uniform(-1, 1, size=(self.num_samples, 2))
+        self.unique = self.num_samples <= len(combos)
+        if self.unique:
+            self._jitter = np.zeros((self.num_samples, 2))
+        else:
+            self._jitter = rng.uniform(-1, 1, size=(self.num_samples, 2))
 
     def __len__(self) -> int:
         return self.num_samples
 
     def caption(self, i: int) -> str:
-        size, color, shape = self._combos[i]
-        return f"{size} {color} {shape}"
+        c = self._combos[i]
+        words = [c["size"], c["fill"], c["texture"], c["color"], c["shape"],
+                 ROTATIONS[c["rotation"]]]
+        return " ".join(w for w in words if w)
 
     def image(self, i: int) -> np.ndarray:
-        size, color, shape = self._combos[i]
+        c = self._combos[i]
         return render_shape(
-            shape, COLORS[color], size, self.image_size, tuple(self._jitter[i])
+            c["shape"], COLORS[c["color"]], c["size"], self.image_size,
+            tuple(self._jitter[i]), fill=c["fill"], texture=c["texture"],
+            rotation=c["rotation"],
         )
 
     def __getitem__(self, i: int):

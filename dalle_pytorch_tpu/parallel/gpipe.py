@@ -75,6 +75,14 @@ def pipeline_layers(
     returns       [n_micro, mb, ...] outputs — valid on the LAST stage
                   (other stages return zeros; callers either slice the
                   stage axis outside or mask-psum).
+
+    Memory note: the [n_micro, mb, ...] input stack, the aux pytree, and
+    the output buffer are replicated on EVERY stage (in_specs P()), and
+    dead schedule slots still execute full layer compute on zeros — so
+    per-stage activation memory scales with the whole global batch,
+    O(n_micro). This favors throughput at the current scale; if pp is
+    ever used for *memory* scaling, move injection/collection to
+    stage-local slices instead.
     """
     n_stages = lax.axis_size(axis_name)
     p = lax.axis_index(axis_name)

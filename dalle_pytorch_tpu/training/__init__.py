@@ -7,6 +7,7 @@ from dalle_pytorch_tpu.training.steps import (
     make_multi_step,
     stack_batches,
     window_iter,
+    window_keys,
     set_learning_rate,
     get_learning_rate,
 )

@@ -239,6 +239,16 @@ def make_multi_step(step_fn: Callable, n_steps: int) -> Callable:
     return multi
 
 
+def window_keys(rng, start_step: int, n: int):
+    """[n]-stacked `fold_in(rng, start_step + i)` keys — the per-global-step
+    stream `make_multi_step` prescribes. One shared helper so every
+    windowed trainer derives the identical stream: a pure function of the
+    step index, invariant to steps_per_dispatch, epoch tails, and resume."""
+    return jnp.stack(
+        [jax.random.fold_in(rng, start_step + i) for i in range(n)]
+    )
+
+
 def stack_batches(batches: list):
     """Stack a list of per-step batch pytrees into the [n_steps, ...]
     layout `make_multi_step` consumes (one host->device transfer for the

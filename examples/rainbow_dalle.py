@@ -7,10 +7,11 @@ synthetic dataset of colored shapes with compositional captions, train the
 DiscreteVAE, inspect reconstructions, train DALLE on a train split, and
 measure exact image-token-sequence accuracy on train vs. held-out captions
 (the notebook reports 1.0 train / ~0.3 test at convergence; reach it by
-raising --vae-steps/--dalle-steps). Note exact match is bounded above by
-caption ambiguity: repeated (size, color, shape) combos differ by a small
-deterministic center jitter the caption does not determine, so at larger
---num-samples per-token accuracy is the cleaner signal.
+raising --vae-steps/--dalle-steps). Like the notebook's 9,216-variation
+cross-product, the dataset is caption-unique up to 9,216 samples — each
+caption determines its image exactly, which is what makes exact-match 1.0
+reachable. Past that count combos repeat with un-captioned jitter and
+per-token accuracy becomes the cleaner signal.
 
 Run (CPU ok for small settings):
   python examples/rainbow_dalle.py --num-samples 512 --dalle-steps 300
@@ -67,14 +68,18 @@ def main():
     from dalle_pytorch_tpu.models.dalle import DALLE, generate_images_cached
     from dalle_pytorch_tpu.training.steps import (
         TrainState, make_optimizer, make_vae_train_step, make_dalle_train_step,
-        make_multi_step, stack_batches, window_iter,
+        make_multi_step, stack_batches, window_iter, window_keys,
     )
     from dalle_pytorch_tpu.utils.images import save_image_grid
 
     out_dir = Path(args.out_dir)
     out_dir.mkdir(parents=True, exist_ok=True)
     tokenizer = ByteTokenizer()
-    text_seq_len = 32
+    # captions run up to 54 bytes ("small outline striped magenta rectangle
+    # rotated thrice"); 64 keeps every caption un-truncated — truncation
+    # would collapse distinct captions onto identical token sequences and
+    # silently cap exact-match below 1.0
+    text_seq_len = 64
 
     data = RainbowDataset(num_samples=args.num_samples, image_size=args.image_size)
     n_train = int(len(data) * args.train_frac)
@@ -105,8 +110,10 @@ def main():
             epoch += 1
 
     # fold_in(step) keys, as make_multi_step prescribes: the random stream
-    # is a pure function of the step index, so results are invariant to
-    # --steps-per-dispatch (CPU spd=1 proxy vs TPU spd=16 comparable)
+    # is a pure function of the step index, so it is invariant to
+    # --steps-per-dispatch. The temperature anneal below is applied at
+    # window granularity (full-window decay up front), so temp can differ
+    # from a per-step run by up to spd-1 decay factors mid-window
     vae_rng = jax.random.PRNGKey(1)
     t0, step = time.time(), 0
     temp = 1.0
@@ -114,7 +121,7 @@ def main():
         itertools.islice(vae_stream(), args.vae_steps), spd
     ):
         prev = step
-        keys = [jax.random.fold_in(vae_rng, step + i) for i in range(len(win))]
+        keys = window_keys(vae_rng, step, len(win))
         if vstep_multi is not None and len(win) == spd:
             # per-window anneal: the product of n per-step decays applied
             # up front (`train_vae.py:278` semantics at window granularity)
@@ -122,7 +129,7 @@ def main():
             vstate, m = vstep_multi(
                 vstate,
                 jnp.asarray(stack_batches([b["images"] for b in win])),
-                jnp.stack(keys), jnp.float32(temp),
+                keys, jnp.float32(temp),
             )
             step += len(win)
         else:
@@ -192,13 +199,13 @@ def main():
         (dalle_batch(s) for s in range(1, args.dalle_steps + 1)), spd
     ):
         prev = step
-        keys = [jax.random.fold_in(dalle_rng, step + i) for i in range(len(win))]
+        keys = window_keys(dalle_rng, step, len(win))
         if dstep_multi is not None and len(win) == spd:
             stacked = stack_batches(win)
             dstate, m = dstep_multi(
                 dstate,
                 {k: jnp.asarray(v) for k, v in stacked.items()},
-                jnp.stack(keys), vstate.params,
+                keys, vstate.params,
             )
             step += len(win)
         else:

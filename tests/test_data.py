@@ -106,9 +106,10 @@ class TestRainbow:
             img = ds.image(i)
             assert img.shape == (32, 32, 3)
             assert img.min() >= 0 and img.max() <= 1
-            assert img.max() > 0.4  # shape actually drawn
-            size, color, shape = ds.caption(i).split()
-            assert color in COLORS and shape in SHAPES
+            assert img.max() > 0.25  # shape actually drawn (textures dim to 0.3)
+            words = ds.caption(i).split()
+            assert any(w in COLORS for w in words)
+            assert any(w in SHAPES for w in words)
 
     def test_batches_sharded(self):
         ds = RainbowDataset(num_samples=32)
@@ -344,4 +345,6 @@ class TestTokenDataset:
         # captions roundtrip through the tokenizer
         text = ByteTokenizer().decode(b["text"][0])
         # text_len=16 may truncate the shape word; size words survive
-        assert any(w in text for w in ("small", "medium", "large"))
+        from dalle_pytorch_tpu.data.rainbow import SIZES
+
+        assert any(text.startswith(w) for w in SIZES)

@@ -61,7 +61,7 @@ def main():
     from dalle_pytorch_tpu.training.config import TrainConfig
     from dalle_pytorch_tpu.training.steps import (
         TrainState, make_optimizer, make_clip_train_step, make_multi_step,
-        stack_batches, window_iter,
+        stack_batches, window_iter, window_keys,
     )
     from dalle_pytorch_tpu.training.pipeline import (
         build_dataset, build_tokenizer, save_clip_checkpoint,
@@ -116,20 +116,22 @@ def main():
     for epoch in range(args.epochs):
         for win in window_iter(batches(epoch), spd):
             prev_step = global_step
+            # fold_in(step) keys (make_multi_step's prescription, as in
+            # train_dalle.py): stream depends only on global_step, so runs
+            # are invariant to --steps_per_dispatch and epoch tails
             if multi_fn is not None and len(win) == spd:
-                rng, sub = jax.random.split(rng)
                 stacked = stack_batches([
                     {"text": b["text"], "images": b["images"]} for b in win
                 ])
                 state, m = multi_fn(
                     state,
                     {k: jnp.asarray(v) for k, v in stacked.items()},
-                    jax.random.split(sub, spd),
+                    window_keys(rng, global_step, spd),
                 )
                 global_step += spd
             else:
                 for batch in win:  # spd==1 or epoch tail: per-step replay
-                    rng, r = jax.random.split(rng)
+                    r = jax.random.fold_in(rng, global_step)
                     state, m = step_fn(
                         state,
                         {"text": jnp.asarray(batch["text"]),

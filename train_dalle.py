@@ -70,6 +70,7 @@ def main():
     initialize_distributed()
     from dalle_pytorch_tpu.training import (
         TrainState, make_optimizer, make_dalle_train_step, make_multi_step,
+        window_keys,
         stack_batches, window_iter, ReduceLROnPlateau, set_learning_rate,
         get_learning_rate,
     )
@@ -369,10 +370,7 @@ def main():
                 # passes the SAME per-step folded keys stacked, so
                 # steps_per_dispatch never changes the randomness
                 if multi_fn is not None and not isinstance(dev_batch, list):
-                    keys = jnp.stack([
-                        jax.random.fold_in(rng, global_step + i)
-                        for i in range(steps_per_dispatch)
-                    ])
+                    keys = window_keys(rng, global_step, steps_per_dispatch)
                     if in_step_encode:
                         state, metrics = multi_fn(state, dev_batch, keys, vae_params)
                     else:

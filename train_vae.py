@@ -59,6 +59,7 @@ def main():
     initialize_distributed()
     from dalle_pytorch_tpu.training import (
         TrainState, make_optimizer, make_vae_train_step, make_multi_step,
+        window_keys,
         stack_batches, window_iter, ExponentialDecay, set_learning_rate,
         get_learning_rate,
     )
@@ -106,9 +107,11 @@ def main():
         donate_argnums=0,
     )
     # steps_per_dispatch>1: scan T steps into one dispatch (see
-    # train_dalle.py). The gumbel temp rides as a per-dispatch constant —
-    # it only changes at 100-step crossings anyway, which align with
-    # dispatch boundaries under the crossing-based cadence below.
+    # train_dalle.py). The gumbel temp rides as a per-dispatch constant,
+    # updated per crossed 100-step boundary AFTER the window — so when
+    # steps_per_dispatch does not divide 100, up to spd-1 steps of the
+    # crossing window still run at the previous temperature/LR relative
+    # to a single-step run (window-granularity anneal).
     steps_per_dispatch = max(1, int(cfg.steps_per_dispatch))
     multi_fn = None
     if steps_per_dispatch > 1:
@@ -165,9 +168,12 @@ def main():
         try:
             for images, images_head in batch_iter:
                 prev_step = global_step
+                # fold_in(step) keys (make_multi_step's prescription, as in
+                # train_dalle.py): the stream is a pure function of the
+                # global step, so runs are reproducible across
+                # steps_per_dispatch settings and epoch tails
                 if multi_fn is not None and not isinstance(images, list):
-                    rng, sub = jax.random.split(rng)
-                    keys = jax.random.split(sub, steps_per_dispatch)
+                    keys = window_keys(rng, global_step, steps_per_dispatch)
                     state, metrics = multi_fn(state, images, keys, jnp.float32(temp))
                     r = keys[-1]  # for the recon-grid gumbel sample below
                     global_step += steps_per_dispatch
@@ -178,7 +184,7 @@ def main():
                     )
                     for img_b, head_b in singles:
                         images_head = head_b
-                        rng, r = jax.random.split(rng)
+                        r = jax.random.fold_in(rng, global_step)
                         state, metrics = step_fn(state, img_b, r, jnp.float32(temp))
                         global_step += 1
 
