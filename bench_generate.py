@@ -7,7 +7,9 @@ end-to-end latency for one batch of samples (transformer decode only; VAE
 pixel decode is a single extra forward and is reported separately).
 
 Env overrides: GEN_BATCH (default 4), GEN_FMAP (32), GEN_RUNS (5),
-GEN_COND_SCALE (1.0).
+GEN_COND_SCALE (1.0), GEN_PHASES=1 adds a per-phase breakdown (prefill
+program vs the 1024-step decode scan vs dVAE pixel decode) so the p50 can
+be attacked where the time actually is.
 """
 
 from __future__ import annotations
@@ -70,6 +72,70 @@ def main():
     times.sort()
     p50 = times[len(times) // 2]
 
+    phases = None
+    if os.environ.get("GEN_PHASES"):
+        # Phase split: time the prefill-only program separately; the decode
+        # scan is (total - prefill) — no third compile needed. Each phase
+        # is its own dispatch, so on synchronous tunnels both absolute
+        # numbers carry one dispatch RTT; the SPLIT (which phase dominates)
+        # is what this measures. dVAE pixel decode (the one extra forward
+        # `generate.py` runs after sampling) is timed on the framework's
+        # 256px/8192-token DiscreteVAE north-star geometry.
+        from dalle_pytorch_tpu.models.dalle import DALLE as _D, init_decode_cache
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        @jax.jit
+        def prefill(variables, t):
+            return model.apply(
+                variables, t, init_decode_cache(model, t.shape[0]),
+                method=_D.decode_prefill,
+            )
+
+        # mirror the e2e path's classifier-free-guidance batch doubling
+        # (generate_images_cached stacks a null-text stream when
+        # cond_scale != 1), else the split under-measures prefill
+        ptext = (
+            jnp.concatenate([text, jnp.zeros_like(text)], axis=0)
+            if cond_scale != 1.0 else text
+        )
+        row, _cache = prefill(params, ptext)
+        float(jnp.asarray(row).ravel()[0].astype(jnp.float32))  # compile
+        pf_times = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            row, _cache = prefill(params, ptext)
+            float(jnp.asarray(row).ravel()[0].astype(jnp.float32))
+            pf_times.append(time.perf_counter() - t0)
+        pf_times.sort()
+        pf50 = pf_times[len(pf_times) // 2]
+
+        vae = DiscreteVAE(
+            image_size=8 * fmap, num_layers=3, num_tokens=8192,
+            codebook_dim=512, hidden_dim=64,
+        )
+        toks0 = jnp.zeros((batch, fmap * fmap), jnp.int32)
+        vparams = jax.jit(vae.init)(
+            jax.random.PRNGKey(3), jnp.zeros((1, 8 * fmap, 8 * fmap, 3))
+        )["params"]
+        vdec = jax.jit(
+            lambda p, t: vae.apply({"params": p}, t, method=DiscreteVAE.decode)
+        )
+        float(jnp.asarray(vdec(vparams, toks0)).ravel()[0])  # compile
+        vd_times = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            float(jnp.asarray(vdec(vparams, toks0)).ravel()[0])
+            vd_times.append(time.perf_counter() - t0)
+        vd_times.sort()
+        vd50 = vd_times[len(vd_times) // 2]
+
+        phases = {
+            "prefill_s": round(pf50, 3),
+            "decode_scan_s": round(p50 - pf50, 3),
+            "per_token_ms": round((p50 - pf50) / (fmap * fmap) * 1e3, 3),
+            "vae_decode_s": round(vd50, 3),
+        }
+
     out = {
         "metric": METRIC,
         "value": round(p50, 3),
@@ -84,6 +150,8 @@ def main():
                   f"-cond{cond_scale}-bf16-cached"
                   f"{'-scan' if executor == 'scan' else ''}",
     }
+    if phases is not None:
+        out["phases"] = phases
     if jax.devices()[0].platform == "cpu":
         out["fallback"] = True  # CPU smoke record, not a perf signal
     print(json.dumps(out))

@@ -368,8 +368,15 @@ class DALLE(nn.Module):
         if trunk_fn is not None:
             assert not reverse_model, "trunk_fn callers own the layer order"
             # loud, like the reverse_model assert: the pipeline block is
-            # hard-wired deterministic, so dropout would silently vanish
-            assert deterministic, "trunk_fn supports deterministic only"
+            # hard-wired deterministic, so dropout would silently vanish.
+            # This is a DESIGN CONSTRAINT of the pp trunk (documented at
+            # make_pipeline_trunk): train with attn_dropout=ff_dropout=0
+            # under pp, or use dp/fsdp/tp for dropout training.
+            assert deterministic, (
+                "trunk_fn (pipeline parallelism) supports deterministic "
+                "execution only — set attn_dropout=ff_dropout=0, or train "
+                "under dp/fsdp/tp instead"
+            )
             out = trunk_fn(tokens)
         else:
             out = self.transformer(

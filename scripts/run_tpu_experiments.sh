@@ -70,6 +70,14 @@ run probe_step       1500 PROBE_K=8 python scripts/perf_probe.py step
 
 # 2. inference north star (scan decode A/B later in the matrix)
 run generate_p50     1500 python bench_generate.py
+# 2b. phase split (prefill vs decode scan vs dVAE pixel decode) — where
+# to attack the r4-banked 3.222s p50 (target: <=2s/batch-of-4)
+run generate_breakdown 1500 GEN_PHASES=1 python bench_generate.py --child
+# 2c. batch amortization lever: per-token decode is param-read bound
+# (~300MB of bf16 weights re-read per token); batch 16 amortizes those
+# reads 4x over batch 4 — tokens/s should scale far better than linearly
+# in wall time if the param-bound model is right
+run generate_b16     1500 GEN_BATCH=16 python bench_generate.py --child
 
 # 4. per-component costs (attn/ff/logits AI table)
 run probe_components 1200 PROBE_K=8 python scripts/perf_probe.py hbm attn ff logits
@@ -95,6 +103,12 @@ run bench_scan_axial 1200 BENCH_EXECUTOR=scan BENCH_ATTN=dense BENCH_ATTN_TYPES=
 
 # scan-native cached decode vs the unrolled decode program
 run generate_p50_scan 1200 GEN_EXECUTOR=scan python bench_generate.py --child
+
+# pipeline-parallel trunk cost check at flagship geometry: pp=1 on one
+# chip = pure schedule-machinery overhead (CPU-mesh datum: 0.95x plain,
+# i.e. free; the multi-stage schedule itself is covered by the 8-dev CPU
+# parity suite). A value near 1.0 clears pp for production use.
+run bench_pp1        1200 PP_N=1 PP_MICRO=4 PP_BATCH=16 PP_FMAP=32 PP_DIM=1024 PP_DEPTH=12 PP_TEXT=256 python scripts/pp_bench.py
 
 # 6. notebook-scale rainbow convergence (VERDICT r3 weak #8: the CPU
 # proxy is 16 samples; the reference notebook bar is 1.0 train exact at
