@@ -34,6 +34,13 @@ class MeshConfig:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    # pipeline parallelism (parallel/gpipe.py GPipe schedule over the
+    # transformer trunk). pp > 1 requires executor="scan", zero dropout
+    # (the pp trunk is deterministic by design — models/dalle.py), a mode
+    # without reversed layer order, and dp/fsdp/tp/sp all 1 (pure-pp
+    # mesh; compose dp x pp via parallel/gpipe.pipeline_layers directly)
+    pp: int = 1
+    pp_micro: int = 4  # GPipe microbatches per step (batch % pp_micro == 0)
 
 
 @dataclass

@@ -132,6 +132,7 @@ def make_dalle_train_step(
     mode: str = "forward_only",
     grad_accum: int = 1,
     null_cond_prob: float = 0.0,
+    pp_trunk: Optional[Callable] = None,
 ) -> Callable:
     """step(state, batch, rng[, vae_params]) -> (state, metrics).
 
@@ -144,8 +145,20 @@ def make_dalle_train_step(
     forward loss always except reverse_only; inverse loss added for
     forward_forward (same layer order) / forward_reverse_partial
     (reversed layer order).
+
+    `pp_trunk` (optional): the `run(tparams, x)` closure from
+    `make_pipeline_trunk` — the transformer trunk executes pipeline-
+    parallel over the mesh 'pp' axis instead of on-module. The pp trunk
+    is deterministic by design (no dropout; models/dalle.py asserts) and
+    owns the layer order, so reversed-layer modes are rejected.
     """
     assert mode in MODES, f"mode must be one of {MODES}"
+    if pp_trunk is not None:
+        assert mode != "forward_reverse_partial", (
+            "pipeline parallelism cannot run reversed layer order "
+            "(trunk_fn owns the layer order); use forward_only / "
+            "forward_forward / reverse_only"
+        )
 
     def encode(vae_params, batch):
         if vae is not None and "image_tokens" not in batch:
@@ -163,9 +176,21 @@ def make_dalle_train_step(
         tokens = encode(vae_params, batch)
         drop_rng, null_rng = jax.random.split(rng)
         rngs = {"dropout": drop_rng, "null_cond": null_rng}
+        shared = dict(
+            return_loss=True, null_cond_prob=null_cond_prob,
+            deterministic=False, rngs=rngs,
+        )
+        if pp_trunk is not None:
+            # deterministic by design: dropout layers are hard-disabled
+            # under the pp trunk (config validation requires zero dropout
+            # rates); null-cond CFG randomness still applies — it acts on
+            # the embeddings before the trunk
+            shared.update(
+                deterministic=True, rngs={"null_cond": null_rng},
+                trunk_fn=lambda h: pp_trunk(params["transformer"], h),
+            )
         apply = lambda **kw: model.apply(
-            {"params": params}, text, tokens, return_loss=True,
-            deterministic=False, null_cond_prob=null_cond_prob, rngs=rngs, **kw
+            {"params": params}, text, tokens, **shared, **kw
         )
 
         metrics = {}

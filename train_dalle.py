@@ -60,8 +60,8 @@ def main():
     from dalle_pytorch_tpu.models.dalle import generate_images
     from dalle_pytorch_tpu.models.dvae import DiscreteVAE
     from dalle_pytorch_tpu.parallel import (
-        make_mesh, batch_sharding, state_shardings, partition_params, is_root,
-        put_host_batch, gather_to_host,
+        MESH_AXES, make_mesh, batch_sharding, state_shardings,
+        partition_params, is_root, put_host_batch, gather_to_host,
     )
     from dalle_pytorch_tpu.parallel import initialize_distributed
 
@@ -74,7 +74,7 @@ def main():
         stack_batches, window_iter, ReduceLROnPlateau, set_learning_rate,
         get_learning_rate,
     )
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from dalle_pytorch_tpu.data.prefetch import Prefetcher
     from dalle_pytorch_tpu.training.config import load_config
     from dalle_pytorch_tpu.training.checkpoint import CheckpointManager
@@ -141,9 +141,61 @@ def main():
 
     # mesh before model: attn_impl="ring" (mesh.sp > 1) shards the model's
     # attention over the sp axis, so the model needs the mesh at build time
-    mesh = make_mesh(
-        dp=cfg.mesh.dp, fsdp=cfg.mesh.fsdp, tp=cfg.mesh.tp, sp=cfg.mesh.sp
-    )
+    pp = max(1, int(getattr(cfg.mesh, "pp", 1)))
+    if pp > 1:
+        # pipeline parallelism: pure-pp 5-axis mesh (dp/fsdp/tp/sp all 1,
+        # 'pp' carrying the stages) so the standard batch/state shardings
+        # (replication here) and gpipe's 'pp' ppermute share one mesh
+        if cfg.model.executor != "scan":
+            raise ValueError(
+                "mesh.pp > 1 requires model.executor=scan (the pipeline "
+                "runs the depth-stacked scan layout)"
+            )
+        if cfg.model.attn_dropout or cfg.model.ff_dropout:
+            raise ValueError(
+                "mesh.pp > 1 requires attn_dropout=ff_dropout=0: the pp "
+                "trunk is deterministic by design (models/dalle.py); use "
+                "dp/fsdp/tp for dropout training"
+            )
+        if cfg.mode == "forward_reverse_partial":
+            raise ValueError(
+                "mesh.pp > 1 cannot run forward_reverse_partial (the "
+                "pipeline owns the layer order; reversed-order execution "
+                "is a sequential-trunk feature)"
+            )
+        if cfg.model.depth % pp:
+            raise ValueError(f"model.depth={cfg.model.depth} not divisible by mesh.pp={pp}")
+        micro = max(1, int(cfg.mesh.pp_micro))
+        if (cfg.batch_size // max(1, cfg.ga_steps)) % micro:
+            raise ValueError(
+                f"mesh.pp_micro={micro} must divide the per-accum-step "
+                f"batch ({cfg.batch_size}//{cfg.ga_steps}); lower pp_micro "
+                "or raise batch_size"
+            )
+        if cfg.mesh.fsdp != 1 or cfg.mesh.tp != 1 or cfg.mesh.sp != 1 or (
+            cfg.mesh.dp not in (1, -1)
+        ):
+            raise ValueError(
+                "mesh.pp > 1 is a pure-pp mesh: set dp/fsdp/tp/sp to 1 "
+                "(compose dp x pp via parallel/gpipe.pipeline_layers)"
+            )
+        devices = jax.devices()
+        if pp > len(devices):
+            raise ValueError(f"mesh.pp={pp} > {len(devices)} devices")
+        if pp < len(devices):
+            print(
+                f"WARNING: mesh.pp={pp} uses {pp} of {len(devices)} devices"
+                " — the rest sit idle (pure-pp mesh; compose dp x pp via "
+                "parallel/gpipe.pipeline_layers for full utilization)"
+            )
+        mesh = Mesh(
+            np.asarray(devices[:pp]).reshape(1, 1, 1, 1, pp),
+            MESH_AXES + ("pp",),
+        )
+    else:
+        mesh = make_mesh(
+            dp=cfg.mesh.dp, fsdp=cfg.mesh.fsdp, tp=cfg.mesh.tp, sp=cfg.mesh.sp
+        )
     model = dalle_from_config(
         cfg,
         num_image_tokens=vae.num_tokens,
@@ -151,6 +203,19 @@ def main():
         vocab_size=max(tokenizer.vocab_size, 1),
         sp_mesh=mesh,
     )
+
+    # pipeline-parallel trunk: built OUTSIDE model.apply (flax intercepts
+    # module construction inside a parent scope); the train step feeds it
+    # the live transformer params each call
+    pp_trunk = None
+    if pp > 1:
+        from dalle_pytorch_tpu.models.transformer import (
+            Transformer, make_pipeline_trunk,
+        )
+
+        pp_trunk = make_pipeline_trunk(
+            Transformer(**model.transformer_kwargs()), mesh, n_micro=micro
+        )
 
     rng = jax.random.PRNGKey(cfg.seed)
     rng, init_rng = jax.random.split(rng)
@@ -186,7 +251,7 @@ def main():
         batch_shardings = {"text": txt_sh, "images": img_sh}
         raw_step = make_dalle_train_step(
             model, vae=vae, mode=cfg.mode, grad_accum=cfg.ga_steps,
-            null_cond_prob=cfg.null_cond_prob,
+            null_cond_prob=cfg.null_cond_prob, pp_trunk=pp_trunk,
         )
         extra_shardings = (vae_sh,)
     else:
@@ -194,7 +259,7 @@ def main():
         batch_shardings = {"text": txt_sh, "image_tokens": txt_sh}
         raw_step = make_dalle_train_step(
             model, mode=cfg.mode, grad_accum=cfg.ga_steps,
-            null_cond_prob=cfg.null_cond_prob,
+            null_cond_prob=cfg.null_cond_prob, pp_trunk=pp_trunk,
         )
         extra_shardings = ()
     step_fn = jax.jit(
