@@ -70,6 +70,20 @@ def _pad_to(x: jnp.ndarray, axis: int, mult: int) -> jnp.ndarray:
     return jnp.pad(x, widths)
 
 
+def _causal_last_live_k(qi, block_q, block_k):
+    """Last k-block index a causal q block `qi` attends to. Single source
+    of truth for BOTH the kernels' liveness predicates and the DMA-skip
+    index maps — they must stay in lockstep (a skip for a step the kernel
+    treats as live would load stale data silently)."""
+    return ((qi + 1) * block_q - 1) // block_k
+
+
+def _causal_first_live_q(ki, block_k, block_q):
+    """First q-block index that attends to causal k block `ki` (transposed
+    twin of `_causal_last_live_k`)."""
+    return (ki * block_k) // block_q
+
+
 def mask_block_layout(mask: np.ndarray, block_q: int, block_k: int):
     """(padded token mask, [nq, nk] int32 occupancy layout) for a static mask.
 
@@ -131,7 +145,7 @@ def _fwd_kernel(
 
     if causal and not has_mask:
         # block-triangle cut: k blocks strictly above the diagonal never run
-        live = ki * block_k <= (qi + 1) * bq - 1
+        live = ki <= _causal_last_live_k(qi, bq, block_k)
     elif has_mask:
         live = layout_ref[qi, ki] != 0
     else:
@@ -188,7 +202,21 @@ def _flash_forward(
         nk_blocks=nk_blocks,
     )
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
-    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    if causal and not has_mask:
+        # Causal DMA skip: k tiles strictly above the block diagonal are
+        # dead (the kernel predicates compute with `live`), but a naive
+        # j-index map still streams them in — ~2x K/V tile traffic at the
+        # diagonal-heavy DALL-E lengths. Remapping every dead step to the
+        # LAST live tile makes consecutive dead steps index the same
+        # block, and Pallas elides the copy when the block index repeats,
+        # so the dead region costs zero DMA. (min(j, ...) also keeps the
+        # index in range: the clamp target never exceeds j itself.)
+        k_idx = lambda b_, h_, i, j: (
+            b_, h_, jnp.minimum(j, _causal_last_live_k(i, block_q, block_k)), 0
+        )
+    else:
+        k_idx = lambda b_, h_, i, j: (b_, h_, j, 0)
+    kspec = pl.BlockSpec((1, 1, block_k, d), k_idx)
     in_specs = [qspec, kspec, kspec]
     operands = [q, k, v]
     if has_mask:
@@ -255,7 +283,7 @@ def _dq_kernel(
     bq = q_ref.shape[2]
     if causal and not has_mask:
         # k blocks strictly above the block triangle contribute nothing
-        live = ki * block_k <= (qi + 1) * bq - 1
+        live = ki <= _causal_last_live_k(qi, bq, block_k)
     elif has_mask:
         live = layout_ref[qi, ki] != 0
     else:
@@ -315,7 +343,7 @@ def _dkv_kernel(
     bk = k_ref.shape[2]
     if causal and not has_mask:
         # q blocks strictly below the k-block diagonal start never attend
-        live = qi >= (ki * bk) // block_q
+        live = qi >= _causal_first_live_q(ki, bk, block_q)
     elif has_mask:
         live = layout_ref[qi, ki] != 0
     else:
@@ -382,7 +410,15 @@ def _flash_backward(
 
     # dq: grid (b, h, qi, ki) — q-indexed tiles ignore ki, k-indexed use ki
     qspec = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, i, 0))
-    kspec = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, j, 0))
+    if causal and not has_mask:
+        # causal DMA skip (see _flash_forward): dead above-diagonal steps
+        # re-index the last live k tile so Pallas elides their copies
+        k_idx = lambda b_, h_, i, j: (
+            b_, h_, jnp.minimum(j, _causal_last_live_k(i, block_q, block_k)), 0
+        )
+    else:
+        k_idx = lambda b_, h_, i, j: (b_, h_, j, 0)
+    kspec = pl.BlockSpec((1, 1, block_k, d), k_idx)
     rowspec = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, i, 0))
     dq_in = [qspec, kspec, kspec, qspec, rowspec, rowspec]
     dq_ops = [q, k, v, do, lse, delta]
@@ -413,8 +449,26 @@ def _flash_backward(
 
     # dk/dv: grid (b, h, ki, qi) — k-indexed tiles ignore qi
     kspec2 = pl.BlockSpec((1, 1, block_k, d), lambda b_, h_, i, j: (b_, h_, i, 0))
-    qspec2 = pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i, j: (b_, h_, j, 0))
-    rowspec2 = pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, i, j: (b_, h_, j, 0))
+    if causal and not has_mask:
+        # causal DMA skip, transposed: for k block i the dead q tiles are
+        # the PREFIX qi < first_live; clamp re-indexes them to the first
+        # live tile so their copies are elided. The outer min keeps the
+        # index in range when n_k > n_q (a fully-dead k row's first_live
+        # would point past the last q block — the whole row is dead, so
+        # any in-range tile serves; without the min the DMA reads out of
+        # bounds)
+        q_idx = lambda b_, h_, i, j: (
+            b_, h_,
+            jnp.minimum(
+                jnp.maximum(j, _causal_first_live_q(i, block_k, block_q)),
+                nq_blocks - 1,
+            ),
+            0,
+        )
+    else:
+        q_idx = lambda b_, h_, i, j: (b_, h_, j, 0)
+    qspec2 = pl.BlockSpec((1, 1, block_q, d), q_idx)
+    rowspec2 = pl.BlockSpec((1, 1, block_q, 1), q_idx)
     dkv_in = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2]
     dkv_ops = [q, k, v, do, lse, delta]
     if has_mask:

@@ -70,6 +70,37 @@ def test_ragged_seq_padding():
     np.testing.assert_allclose(out, ref, atol=2e-5)
 
 
+def test_rectangular_causal_nk_gt_nq():
+    """n_k > n_q with causal=True: k blocks past the last q row are fully
+    dead; the DMA-skip clamp must stay in range (regression: the dk/dv
+    first-live-q index could point past the last q block, an out-of-bounds
+    tile read) and dk/dv for those rows must be exactly zero."""
+    n_q, n_k = 64, 160
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(B, H, n_q, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, H, n_k, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, H, n_k, D), jnp.float32)
+    mask = np.arange(n_q)[:, None] >= np.arange(n_k)[None, :]
+
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    ref = _dense(q, k, v, mask)
+    np.testing.assert_allclose(out, ref, atol=2e-5)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True, block_q=32, block_k=32) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dense_attention(q, k, v, mask=jnp.asarray(mask)[None, None]) ** 2).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(a, b, atol=5e-4)
+    # fully-dead k rows (beyond the last q row) get exactly zero dk/dv
+    assert np.all(np.asarray(gf[1])[:, :, n_q:, :] == 0)
+    assert np.all(np.asarray(gf[2])[:, :, n_q:, :] == 0)
+
+
 def test_gradients_match_dense_causal():
     n = 96
     q, k, v = _qkv(n, seed=3)
