@@ -92,6 +92,21 @@ class TestCheckpointFormats:
         mgr.close()
 
 
+def _assert_same_npz(a: dict, b: dict, name: str):
+    """Same keys, float entries allclose (2e-4: the separately-compiled
+    scan vs per-step programs fuse differently — same tolerance as
+    test_steps_per_dispatch_resume_parity), metadata exactly equal."""
+    assert a.keys() == b.keys(), f"{name} checkpoint keys differ"
+    for k in a:
+        if a[k].dtype.kind in "fc":
+            np.testing.assert_allclose(
+                a[k], b[k], atol=2e-4,
+                err_msg=f"{name} param {k} diverged between spd settings",
+            )
+        else:  # hparams metadata etc.
+            assert np.array_equal(a[k], b[k]), f"{name} entry {k} differs"
+
+
 def run_cli(script, *cli_args, cwd):
     env = dict(os.environ)
     env["DALLE_TPU_FORCE_PLATFORM"] = "cpu"
@@ -525,6 +540,42 @@ class TestAttnImplCli:
             "--outputs_dir", str(tmp_path / "ring_out"), cwd=tmp_path,
         )
         assert list((tmp_path / "ring_out").rglob("grid.png"))
+
+    def test_vae_and_clip_spd_invariance(self, tmp_path):
+        """ADVICE r4: train_vae/train_clip now derive RNG via
+        fold_in(global_step) (shared window_keys helper), so an 11-step
+        run (3 full spd=3 windows + a 2-step tail) must produce the SAME
+        final checkpoint as the per-step run — window size is purely an
+        execution detail."""
+        outs = {}
+        for spd in (1, 3):
+            out = tmp_path / f"vae_spd{spd}.npz"
+            run_cli(
+                "train_vae.py", "--image_folder", "rainbow:88", "--epochs",
+                "1", "--batch_size", "8", "--output", str(out),
+                "--set", f"steps_per_dispatch={spd}",
+                "--set", "vae.image_size=16", "--set", "vae.num_layers=2",
+                "--set", "vae.num_tokens=32", "--set", "vae.codebook_dim=16",
+                "--set", "vae.hidden_dim=16", "--set", "debug=true",
+                cwd=tmp_path,
+            )
+            outs[spd] = dict(np.load(out))
+        _assert_same_npz(outs[1], outs[3], "vae")
+
+        clips = {}
+        for spd in (1, 3):
+            out = tmp_path / f"clip_spd{spd}.npz"
+            run_cli(
+                "train_clip.py", "--image_text_folder", "rainbow:88",
+                "--epochs", "1", "--batch_size", "8",
+                "--output", str(out), "--steps_per_dispatch", str(spd),
+                "--image_size", "16", "--patch_size", "8", "--dim", "32",
+                "--dim_latent", "16", "--depth", "1", "--heads", "2",
+                "--text_seq_len", "64", "--debug",
+                cwd=tmp_path,
+            )
+            clips[spd] = dict(np.load(out))
+        _assert_same_npz(clips[1], clips[3], "clip")
 
     def test_train_with_pipeline_parallel(self, tmp_path):
         """mesh.pp=2 in the real trainer loop on the 8-virtual-device CPU
