@@ -117,11 +117,11 @@ class Attention(nn.Module):
         """`mask_array`: a TRACED [S, S] bool pattern mask (True = attend),
         the per-layer scanned-input analogue of the host-side `static_mask`
         attribute — used by the scan executor, where each layer's pattern
-        arrives as data rather than a compile-time constant. Dense,
-        uncached path only (a traced mask cannot drive flash's host-side
-        block-occupancy skipping)."""
+        arrives as data rather than a compile-time constant. Dense paths
+        only (a traced mask cannot drive flash's host-side block-occupancy
+        skipping); the cached path row-slices it at the decode position
+        exactly like `static_mask`."""
         if mask_array is not None:
-            assert cache is None, "mask_array is for the uncached path only"
             assert self.static_mask is None, (
                 "pass either the static_mask attribute or mask_array, not both"
             )
@@ -154,15 +154,24 @@ class Attention(nn.Module):
             # written the prefix, `attention.py:71-76,86`)
             valid = jnp.arange(max_len)[None, :] <= index + jnp.arange(n)[:, None]
             mask = valid[None, None]
-            if self.static_mask is not None:
-                sm = np.asarray(self.static_mask)
-                if sm.shape[0] < max_len:  # decode caches may be 1 longer
-                    pad = max_len - sm.shape[0]
-                    sm = np.pad(sm, ((0, pad), (0, pad)), constant_values=True)
-                rows = lax.dynamic_slice_in_dim(
-                    jnp.asarray(sm[:, :max_len]), index, n, axis=0
+            def mask_rows_at(pm):
+                # pad to max_len with True (decode caches may be 1 longer
+                # than the mask), then row-slice at the decode position —
+                # shared by the host-side static_mask and the scan
+                # executor's traced mask_array so the two paths cannot
+                # drift
+                if pm.shape[0] < max_len:
+                    pad = max_len - pm.shape[0]
+                    pm = jnp.pad(pm, ((0, pad), (0, pad)), constant_values=True)
+                return lax.dynamic_slice_in_dim(
+                    pm[:, :max_len], index, n, axis=0
                 )
+
+            if self.static_mask is not None:
+                rows = mask_rows_at(jnp.asarray(np.asarray(self.static_mask)))
                 mask = mask & rows[None, None]
+            if mask_array is not None:
+                mask = mask & mask_rows_at(mask_array)[None, None]
             out = dense_attention(q, ck, cv, mask=mask, stable=self.stable)
             new_cache = {"k": ck, "v": cv, "index": index + n}
         else:

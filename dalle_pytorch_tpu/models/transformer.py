@@ -42,8 +42,9 @@ Layer executors (orthogonal to the reversible memory modes):
     flagship program) at identical runtime math. Attn-type cycling runs
     as dense attention with per-layer pattern masks scanned over depth;
     no cross-layer sharing. KV-cached decode is native (the depth-stacked
-    cache rides the layer scan as scanned input and output); only masked
-    attn-type checkpoints need `scan_params_to_unrolled` for decode.
+    cache rides the layer scan as scanned input and output), pattern
+    masks included — each layer's traced mask row-slices at the decode
+    position like the unrolled executor's static masks.
 """
 
 from __future__ import annotations
@@ -341,8 +342,8 @@ class Transformer(nn.Module):
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
     # "unrolled" | "scan" — see module docstring. "scan" compiles one layer
     # body instead of `depth` copies; masked attn types run as dense with
-    # depth-stacked scanned pattern masks; cached decode is native
-    # (uniform full attention only). No shared ids, no revnet.
+    # depth-stacked scanned pattern masks; cached decode is native,
+    # pattern masks included. No shared ids, no revnet.
     executor: str = "unrolled"
     dtype: Any = jnp.float32
 
@@ -712,12 +713,6 @@ class Transformer(nn.Module):
         deterministic: bool = True,
     ):
         if self.executor == "scan":
-            if cache is not None and self.scan_pattern_table is not None:
-                raise ValueError(
-                    'executor="scan" cached decode supports uniform full '
-                    "attention only (pattern masks are traced scanned "
-                    "inputs; the cached path cannot row-slice them)"
-                )
             return self.scan_stack(
                 x,
                 self.attn_scales_stacked,

@@ -97,7 +97,7 @@ class DalleConfig:
     # layer executor: "unrolled" | "scan" (nn.scan over depth-stacked
     # params — ~depth× smaller program/compile; masked attn_types run as
     # dense + scanned pattern masks, no shared ids; cached decode is
-    # native for uniform full attention, masked checkpoints auto-convert)
+    # native, pattern masks included)
     executor: str = "unrolled"
 
     def attn_types_tuple(self) -> Tuple[str, ...]:

@@ -85,20 +85,9 @@ def main():
         # the mesh sp axis); KV-cached decode never runs it, so a
         # ring-trained checkpoint generates with the dense/auto kernel
         cfg.model.attn_impl = "auto"
-    if (
-        getattr(cfg.model, "executor", "unrolled") == "scan"
-        and any(t != "full" for t in cfg.model.attn_types_tuple())
-    ):
-        # scan cached decode is uniform-full-attention only (pattern masks
-        # are scanned inputs); masked checkpoints convert losslessly to
-        # the unrolled layout, whose cached path row-slices static masks
-        from dalle_pytorch_tpu.models.transformer import scan_params_to_unrolled
-
-        dalle_params = dict(dalle_params)
-        dalle_params["transformer"] = scan_params_to_unrolled(
-            dalle_params["transformer"], cfg.model.depth
-        )
-        cfg.model.executor = "unrolled"
+    # (scan checkpoints — masked attn types included — decode natively:
+    # the cached path row-slices the traced pattern masks at the decode
+    # position, parity-pinned in test_scan_executor.py)
     model = dalle_from_config(
         cfg, num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
         vocab_size=max(tokenizer.vocab_size, 1),

@@ -484,15 +484,17 @@ class TestAttnImplCli:
 
     def test_train_with_scan_executor_and_generate(self, tmp_path):
         """2 steps with --set model.executor=scan (depth-stacked nn.scan
-        params), then generate.py from that checkpoint: the scan
-        executor's native KV-cached decode runs directly on the stacked
-        params (no layout conversion)."""
+        params) AND the sparse attn-type cycle, then generate.py from that
+        checkpoint: the scan executor's native KV-cached decode runs
+        directly on the stacked params — pattern masks row-sliced at the
+        decode position, no layout conversion."""
         vae_path = _tiny_vae_ckpt(tmp_path)
         run_cli(
             "train_dalle.py", "--image_text_folder", "rainbow:16",
             "--vae_path", str(vae_path),
             "--epochs", "1", "--batch_size", "8",
             "--set", "model.executor=scan",
+            "--set", "model.attn_types=full,axial_row",
             "--set", "model.dim=64", "--set", "model.depth=2",
             "--set", "model.heads=2", "--set", "model.dim_head=16",
             "--set", "model.text_seq_len=16", "--set", "bf16=false",

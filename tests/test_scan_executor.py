@@ -271,16 +271,38 @@ class TestScanDALLE:
             np.asarray(toks_scan), np.asarray(toks_full)
         )
 
-    def test_cached_decode_rejects_pattern_masks(self):
-        ms = DALLE(
+    def test_cached_decode_with_pattern_masks_matches_unrolled(self):
+        """Scan-native cached decode WITH the attn-type cycle: the traced
+        per-layer pattern masks row-slice at the decode position exactly
+        like the unrolled executor's static masks, so both cached
+        samplers emit identical tokens from the same (converted)
+        checkpoint — generate.py needs no layout conversion for masked
+        scan checkpoints."""
+        attn_types = ("full", "axial_row", "axial_col", "conv_like")
+        kw = dict(
             dim=DIM, depth=DEPTH, heads=2, dim_head=8,
             num_image_tokens=16, image_fmap_size=FMAP,
             num_text_tokens=30, text_seq_len=4,
-            shift_tokens=True, rotary_emb=True, executor="scan",
-            attn_types=("full", "axial_row"),
+            shift_tokens=True, rotary_emb=True, attn_types=attn_types,
         )
+        ms = DALLE(executor="scan", **kw)
         text = jnp.array([[3, 5, 2, 0]], jnp.int32)
         img = jnp.arange(FMAP * FMAP, dtype=jnp.int32)[None] % 16
         vs = ms.init(jax.random.PRNGKey(0), text, img)
-        with pytest.raises(ValueError, match="uniform full attention"):
-            generate_images_cached(ms, vs, jax.random.PRNGKey(2), text)
+        toks_scan = generate_images_cached(
+            ms, vs, jax.random.PRNGKey(2), text,
+            temperature=1e-4, filter_thres=0.999,
+        )
+
+        mu = DALLE(executor="unrolled", **kw)
+        pu = dict(vs["params"])
+        pu["transformer"] = scan_params_to_unrolled(
+            vs["params"]["transformer"], DEPTH
+        )
+        toks_unrolled = generate_images_cached(
+            mu, {"params": pu}, jax.random.PRNGKey(2), text,
+            temperature=1e-4, filter_thres=0.999,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(toks_scan), np.asarray(toks_unrolled)
+        )
