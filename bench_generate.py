@@ -52,7 +52,35 @@ def main():
     tokens = jnp.zeros((batch, fmap * fmap), jnp.int32)
     params = jax.jit(model.init)(jax.random.PRNGKey(0), text, tokens)
 
+    def north_star_dvae():
+        # the framework's 256px/8192-token DiscreteVAE geometry, shared by
+        # the GEN_FUSED sampler and the GEN_PHASES vae-decode probe so the
+        # two env-gated paths can never benchmark different models
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        v = DiscreteVAE(
+            image_size=8 * fmap, num_layers=3, num_tokens=8192,
+            codebook_dim=512, hidden_dim=64,
+        )
+        vp = jax.jit(v.init)(
+            jax.random.PRNGKey(3), jnp.zeros((1, 8 * fmap, 8 * fmap, 3))
+        )["params"]
+        return v, vp
+
+    fused_vae = None
+    if os.environ.get("GEN_FUSED"):
+        # end-to-end-pixels p50: dVAE pixel decode fused into the sampler
+        # program (tokens AND pixels from one dispatch — the generate.py
+        # production path for DiscreteVAE checkpoints)
+        fused_vae, fused_vparams = north_star_dvae()
+
     def sample(rng):
+        if fused_vae is not None:
+            _, px = generate_images_cached(
+                model, params, rng, text, cond_scale=cond_scale,
+                vae=fused_vae, vae_params=fused_vparams,
+            )
+            return px
         return generate_images_cached(
             model, params, rng, text, cond_scale=cond_scale
         )
@@ -73,6 +101,13 @@ def main():
     p50 = times[len(times) // 2]
 
     phases = None
+    if os.environ.get("GEN_PHASES") and fused_vae is not None:
+        raise SystemExit(
+            "GEN_PHASES with GEN_FUSED would fold the fused vae decode "
+            "into decode_scan_s/per_token_ms (double-counted vs the "
+            "separate vae_decode_s row) — run the phase breakdown on the "
+            "unfused sampler"
+        )
     if os.environ.get("GEN_PHASES"):
         # Phase split: time the prefill-only program separately; the decode
         # scan is (total - prefill) — no third compile needed. Each phase
@@ -82,7 +117,6 @@ def main():
         # `generate.py` runs after sampling) is timed on the framework's
         # 256px/8192-token DiscreteVAE north-star geometry.
         from dalle_pytorch_tpu.models.dalle import DALLE as _D, init_decode_cache
-        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
 
         @jax.jit
         def prefill(variables, t):
@@ -109,16 +143,10 @@ def main():
         pf_times.sort()
         pf50 = pf_times[len(pf_times) // 2]
 
-        vae = DiscreteVAE(
-            image_size=8 * fmap, num_layers=3, num_tokens=8192,
-            codebook_dim=512, hidden_dim=64,
-        )
+        vae, vparams = north_star_dvae()
         toks0 = jnp.zeros((batch, fmap * fmap), jnp.int32)
-        vparams = jax.jit(vae.init)(
-            jax.random.PRNGKey(3), jnp.zeros((1, 8 * fmap, 8 * fmap, 3))
-        )["params"]
         vdec = jax.jit(
-            lambda p, t: vae.apply({"params": p}, t, method=DiscreteVAE.decode)
+            lambda p, t: vae.apply({"params": p}, t, method=type(vae).decode)
         )
         float(jnp.asarray(vdec(vparams, toks0)).ravel()[0])  # compile
         vd_times = []
@@ -148,7 +176,8 @@ def main():
         "device": jax.devices()[0].device_kind,
         "config": f"dim1024-depth12-fmap{fmap}-bs{batch}"
                   f"-cond{cond_scale}-bf16-cached"
-                  f"{'-scan' if executor == 'scan' else ''}",
+                  f"{'-scan' if executor == 'scan' else ''}"
+                  f"{'-fusedpx' if fused_vae is not None else ''}",
     }
     if phases is not None:
         out["phases"] = phases

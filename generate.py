@@ -129,6 +129,16 @@ def main():
         for start in range(0, args.num_images, args.batch_size):
             chunk = text[start : start + args.batch_size]
             rng, r = jax.random.split(rng)
+            if not args.no_cache and isinstance(vae, DiscreteVAE):
+                # fused sampler: tokens AND pixels from ONE dispatch (one
+                # tunnel round trip per batch instead of two)
+                _, imgs = generate_images_cached(
+                    model, variables, r, chunk,
+                    filter_thres=args.top_k, temperature=args.temperature,
+                    cond_scale=args.cond_scale, vae=vae, vae_params=vae_params,
+                )
+                images.append(np.asarray(imgs) * 0.5 + 0.5)  # un-normalize
+                continue
             sample_fn = generate_images if args.no_cache else generate_images_cached
             toks = sample_fn(
                 model, variables, r, chunk,

@@ -78,6 +78,9 @@ run generate_breakdown 1500 GEN_PHASES=1 python bench_generate.py --child
 # reads 4x over batch 4 — tokens/s should scale far better than linearly
 # in wall time if the param-bound model is right
 run generate_b16     1500 GEN_BATCH=16 python bench_generate.py --child
+# 2d. end-to-end-pixels: dVAE decode fused into the sampler program —
+# one dispatch for tokens AND pixels (saves a full tunnel RTT/batch)
+run generate_fused   1500 GEN_FUSED=1 python bench_generate.py --child
 
 # 4. per-component costs (attn/ff/logits AI table)
 run probe_components 1200 PROBE_K=8 python scripts/perf_probe.py hbm attn ff logits

@@ -268,6 +268,41 @@ class TestCachedDecode:
         fast = generate_images_cached(model, variables, rng, text, filter_thres=0.9)
         np.testing.assert_array_equal(np.asarray(slow), np.asarray(fast))
 
+    def test_fused_pixel_sampler_matches_two_step(self, batch):
+        """vae=/vae_params= fuses the dVAE pixel decode into the sampler
+        program: tokens identical to the unfused sampler, pixels identical
+        to decoding those tokens separately — one dispatch instead of
+        two (the generate.py production path)."""
+        from dalle_pytorch_tpu.models.dalle import generate_images_cached
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        # fmap 4 (not the suite's 3): the dVAE needs a power-of-2 image
+        # size, fmap = image_size / 2^num_layers
+        fmap = 4
+        model = make_dalle(shift_tokens=True, image_fmap_size=fmap)
+        text = batch[0]
+        image = jnp.tile(batch[1], (1, 2))[:, : fmap * fmap] % NUM_IMG
+        variables = init_vars(model, text, image)
+        vae = DiscreteVAE(
+            image_size=4 * fmap, num_layers=2, num_tokens=NUM_IMG,
+            codebook_dim=16, hidden_dim=16,
+        )
+        vparams = jax.jit(vae.init)(
+            jax.random.PRNGKey(5), jnp.zeros((1, 4 * fmap, 4 * fmap, 3))
+        )["params"]
+
+        rng = jax.random.PRNGKey(7)
+        toks = generate_images_cached(model, variables, rng, text)
+        ftoks, pixels = generate_images_cached(
+            model, variables, rng, text, vae=vae, vae_params=vparams
+        )
+        np.testing.assert_array_equal(np.asarray(toks), np.asarray(ftoks))
+        want = vae.apply({"params": vparams}, toks, method=DiscreteVAE.decode)
+        np.testing.assert_allclose(
+            np.asarray(pixels), np.asarray(want), atol=1e-6
+        )
+        assert pixels.shape == (text.shape[0], 4 * fmap, 4 * fmap, 3)
+
     def test_cached_generation_priming_and_guidance(self, batch):
         from dalle_pytorch_tpu.models.dalle import generate_images_cached
 
