@@ -33,7 +33,9 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         sleep 120
     else
         echo "[watchdog] $(date -u +%H:%M:%S) tunnel still down"
-        sleep 240
+        # short poll gap: observed tunnel windows are ~35 min and the 90s
+        # hang-probe already bounds the cost of a dead relay
+        sleep 150
     fi
 done
 echo "[watchdog] giving up at $(date -u +%H:%M:%S) (deadline reached)"
