@@ -344,6 +344,22 @@ class NativeBPETokenizer(_TokenizerBase):
         return self.bpe.decode(ids)
 
 
+# Cached default-vocabulary decision: ("native", Path) once a probe
+# succeeds. `get_tokenizer()` with no flags probes the shipped
+# default_bpe_*.model files, warning for each unusable candidate — but
+# builders construct tokenizers repeatedly (trainer, generate CLI, serving
+# engine), and re-probing a broken vocabulary re-emitted the same
+# `default_bpe_32k.model unusable` UserWarning every time. Only SUCCESS is
+# cached: the ByteTokenizer fallback keeps re-probing (so a transiently
+# unusable vocabulary — e.g. the native extension still compiling — can
+# recover later in the process) but its warnings fire once per process via
+# `_warned_default_probe`. A cached "native" decision that stops
+# constructing (toolchain vanished, monkeypatched test double) invalidates
+# itself and re-probes.
+_default_decision = None
+_warned_default_probe = False
+
+
 def get_tokenizer(
     bpe_path: Optional[str] = None,
     hug: bool = False,
@@ -373,6 +389,15 @@ def get_tokenizer(
     # by glob so any regenerated default_bpe_<N>k.model is picked up;
     # largest vocabulary wins (the CLIP-scale 32k model over the lighter 8k
     # fallback kept for fast tests).
+    global _default_decision, _warned_default_probe
+    if _default_decision is not None:
+        kind, model_path = _default_decision
+        try:
+            return NativeBPETokenizer(model_path)
+        except Exception:
+            _default_decision = None  # stale decision: re-probe (and re-warn)
+            _warned_default_probe = False
+
     def _vocab_k(p: Path) -> int:
         try:
             return int(p.stem[len("default_bpe_"):].rstrip("k"))
@@ -385,8 +410,12 @@ def get_tokenizer(
     )
     for default_model in existing:
         try:
-            return NativeBPETokenizer(default_model)
+            tok = NativeBPETokenizer(default_model)
+            _default_decision = ("native", default_model)
+            return tok
         except Exception as e:  # e.g. no C++ toolchain, corrupt model file
+            if _warned_default_probe:
+                continue
             next_step = (
                 "trying the next candidate"
                 if default_model != existing[-1]
@@ -397,7 +426,7 @@ def get_tokenizer(
                 f"({e}); {next_step}",
                 stacklevel=2,
             )
-    if not existing:
+    if not existing and not _warned_default_probe:
         warnings.warn(
             "no default BPE vocabulary "
             f"(no {Path(__file__).parent}/default_bpe_*.model — run "
@@ -406,4 +435,7 @@ def get_tokenizer(
             "byte-level models only",
             stacklevel=2,
         )
+    # fallback is NOT cached — the next call re-probes (silently), so a
+    # vocabulary that becomes usable later in the process is picked up
+    _warned_default_probe = True
     return ByteTokenizer()

@@ -657,6 +657,126 @@ def _generate_images_cached_impl(
     return img_tokens
 
 
+def generate_images_cached_batched(
+    model: DALLE,
+    variables,
+    text: jnp.ndarray,
+    seeds: jnp.ndarray,
+    temperatures: jnp.ndarray,
+    keep_k: jnp.ndarray,
+    cond_scale: float = 1.0,
+    vae=None,
+    vae_params=None,
+):
+    """KV-cached sampling with PER-SAMPLE sampling parameters.
+
+    The serving engine's decode path: one compiled program per
+    (model, batch shape, cond_scale), with each batch row carrying its own
+    traced `seeds[i]` / `temperatures[i]` / `keep_k[i]` so heterogeneous
+    requests coalesce into one fixed-shape dispatch
+    (`dalle_pytorch_tpu/serving/engine.py` pads partial batches up to the
+    nearest compiled shape and discards the padded rows).
+
+    Row i's RNG stream is derived ONLY from (seeds[i], decode step) — never
+    from batch composition or row position — so a request produces
+    identical tokens whichever micro-batch it lands in (pinned by
+    tests/test_serving_e2e.py). `keep_k` counts logits to KEEP over the
+    full vocab row (the engine converts the CLI's fractional `top_k`
+    threshold with the same `max(int((1-thres)*V), 1)` rule as
+    `top_k_filter`). Like the static-parameter sampler, pass `vae`/
+    `vae_params` to fuse pixel decode into the same program.
+    """
+    static_key = (cond_scale, vae)
+    return _jit_sample(
+        _batched_sampler_builder, model, static_key,
+        variables, text,
+        jnp.asarray(seeds, jnp.int32),
+        jnp.asarray(temperatures, jnp.float32),
+        jnp.asarray(keep_k, jnp.int32),
+        vae_params,
+    )
+
+
+def _batched_sampler_builder(model, key):
+    cond_scale, vae = key
+
+    def fn(variables, text, seeds, temperatures, keep_k, vae_params=None):
+        toks = _generate_images_cached_batched_impl(
+            model, variables, text, seeds, temperatures, keep_k,
+            cond_scale=cond_scale,
+        )
+        if vae is None:
+            return toks
+        pixels = vae.apply(
+            {"params": vae_params}, toks, method=type(vae).decode
+        )
+        return toks, pixels
+
+    return fn
+
+
+def _generate_images_cached_batched_impl(
+    model: DALLE,
+    variables,
+    text: jnp.ndarray,
+    seeds: jnp.ndarray,
+    temperatures: jnp.ndarray,
+    keep_k: jnp.ndarray,
+    cond_scale: float = 1.0,
+):
+    from dalle_pytorch_tpu.ops.sampling import (
+        top_k_filter_per_row, gumbel_sample_per_row,
+    )
+
+    b = text.shape[0]
+    image_seq_len = model.image_seq_len
+    use_null = cond_scale != 1.0
+    img_tokens = jnp.zeros((b, image_seq_len), dtype=jnp.int32)
+
+    # per-row base keys from the request seeds; the per-step key is a
+    # fold_in of (base, step) — deterministic and batch-invariant
+    base_keys = jax.vmap(
+        lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
+    )(seeds)
+
+    def blend(row):
+        if not use_null:
+            return row
+        cond, null = row[:b], row[b:]
+        return null + (cond - null) * cond_scale
+
+    if use_null:
+        text = jnp.concatenate([text, jnp.zeros_like(text)], axis=0)
+    row, cache = model.apply(
+        variables,
+        text,
+        init_decode_cache(model, text.shape[0]),
+        method=DALLE.decode_prefill,
+    )
+
+    blocked = jnp.asarray(
+        np.arange(model.total_tokens) < model.total_text_tokens
+    )[None]
+
+    def step(carry, i):
+        img_tokens, cache, row = carry
+        masked = jnp.where(blocked, NEG_MASK_VALUE, blend(row))
+        filtered = top_k_filter_per_row(masked, keep_k)
+        step_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(base_keys, i)
+        sample = gumbel_sample_per_row(step_keys, filtered, temperatures)
+        sample = (sample - model.total_text_tokens).astype(jnp.int32)
+        img_tokens = jax.lax.dynamic_update_slice(img_tokens, sample[:, None], (0, i))
+        feed = jnp.concatenate([sample, sample], axis=0) if use_null else sample
+        row, cache = model.apply(
+            variables, feed, i, cache, method=DALLE.decode_image_step
+        )
+        return (img_tokens, cache, row), None
+
+    carry = (img_tokens, cache, row)
+    (img_tokens, _, _), _ = jax.lax.scan(step, carry, jnp.arange(image_seq_len))
+    return img_tokens
+
+
 def forward_with_cond_scale(
     model: DALLE, variables, text, image, cond_scale: float = 1.0, rngs=None
 ):

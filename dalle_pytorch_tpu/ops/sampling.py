@@ -32,3 +32,32 @@ def gumbel_sample(
     """Sample token ids via the gumbel-max trick: argmax(logits/T + G)."""
     g = jax.random.gumbel(rng, logits.shape, dtype=jnp.float32)
     return jnp.argmax(logits.astype(jnp.float32) / temperature + g, axis=-1)
+
+
+def top_k_filter_per_row(logits: jnp.ndarray, keep_k: jnp.ndarray) -> jnp.ndarray:
+    """Per-row top-k: row i keeps its keep_k[i] largest logits, -inf elsewhere.
+
+    `keep_k` is a traced [B] int array, so heterogeneous requests batch into
+    one compiled program (the serving micro-batcher's requirement). Costs a
+    full per-row sort instead of `lax.top_k`'s partial selection — fine at
+    decode-vocab widths, and the batch is the point.
+    """
+    sorted_desc = -jnp.sort(-logits.astype(jnp.float32), axis=-1)
+    idx = jnp.clip(keep_k - 1, 0, logits.shape[-1] - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_desc, idx[:, None], axis=-1)
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def gumbel_sample_per_row(
+    keys: jax.Array, logits: jnp.ndarray, temperature: jnp.ndarray
+) -> jnp.ndarray:
+    """Gumbel-max with a per-row PRNG key [B, ...] and temperature [B].
+
+    Temperatures are clamped away from zero; callers wanting greedy decode
+    pass a tiny temperature (the argmax then dominates the gumbel noise).
+    """
+    g = jax.vmap(
+        lambda k, row: jax.random.gumbel(k, row.shape, dtype=jnp.float32)
+    )(keys, logits)
+    t = jnp.maximum(temperature.astype(jnp.float32), 1e-4)[:, None]
+    return jnp.argmax(logits.astype(jnp.float32) / t + g, axis=-1)

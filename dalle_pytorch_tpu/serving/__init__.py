@@ -1,0 +1,46 @@
+"""Batched text→image generation service.
+
+The serving layer the ROADMAP north star calls for: a dynamic request
+queue feeding fixed-shape compiled sampler programs.
+
+  * `engine.py`   — `GenerationEngine`: wraps the KV-cached sampler
+    (`models/dalle.py:generate_images_cached_batched`) behind a fixed set
+    of compiled batch shapes, pads partial batches, warms up compilation,
+    and optionally CLIP-reranks results.
+  * `batcher.py`  — `MicroBatcher`: bounded queue with dynamic
+    micro-batching (flush on max-batch or deadline), backpressure via
+    queue-full rejection, per-request timeout/cancellation, graceful
+    drain.
+  * `server.py`   — stdlib-only JSON HTTP API: POST /generate,
+    GET /healthz, GET /metrics (Prometheus text format).
+
+`serve.py` at the repo root is the CLI entrypoint; `generate.py` drives
+the same `GenerationEngine` for one-shot CLI batches, so the two paths
+cannot drift.
+"""
+
+from dalle_pytorch_tpu.serving.engine import (
+    GenerationEngine,
+    SampleSpec,
+    engine_from_checkpoint,
+)
+from dalle_pytorch_tpu.serving.batcher import (
+    MicroBatcher,
+    QueueFullError,
+    RequestCancelled,
+    RequestTimeout,
+    ShuttingDownError,
+)
+from dalle_pytorch_tpu.serving.server import ServingServer
+
+__all__ = [
+    "GenerationEngine",
+    "SampleSpec",
+    "engine_from_checkpoint",
+    "MicroBatcher",
+    "QueueFullError",
+    "RequestCancelled",
+    "RequestTimeout",
+    "ShuttingDownError",
+    "ServingServer",
+]

@@ -1,0 +1,358 @@
+"""Dynamic micro-batching over a bounded request queue.
+
+The throughput/latency knob of the serving layer: requests from many
+concurrent clients accumulate in a bounded queue; a single worker thread
+flushes a micro-batch to the engine when EITHER `max_batch` rows are
+waiting OR the oldest request has waited `max_delay_ms` — the classic
+deadline-or-capacity policy (cf. Vortex/TF-Serving style batchers,
+PAPERS.md). One worker means one in-flight sampler dispatch, which is the
+right shape for a single accelerator: overlapping dispatches would just
+queue inside the backend anyway.
+
+Overload handling is explicit, never implicit:
+  * queue full  -> `submit` raises `QueueFullError` immediately
+    (backpressure; the HTTP layer maps it to 503 + Retry-After);
+  * too old     -> requests that waited past their timeout are failed
+    with `RequestTimeout` when they reach the head of the queue, not
+    silently dropped;
+  * cancelled   -> client-abandoned requests are skipped without costing
+    a batch row;
+  * engine error-> every request in the failed batch gets the exception
+    (fail fast; no wedged clients), and the error is surfaced through
+    `last_error` for /healthz;
+  * shutdown    -> `shutdown(drain=True)` stops intake, flushes what is
+    queued, then joins the worker; `drain=False` fails the queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+from dalle_pytorch_tpu.serving.engine import SampleSpec
+
+
+class QueueFullError(RuntimeError):
+    """Bounded queue is at capacity — reject, don't buffer unboundedly."""
+
+
+class RequestTimeout(RuntimeError):
+    """Request spent longer than its timeout queued or in flight."""
+
+
+class RequestCancelled(RuntimeError):
+    """Request was cancelled by the client before execution."""
+
+
+class ShuttingDownError(RuntimeError):
+    """Batcher no longer accepts work."""
+
+
+class _Future:
+    """Minimal thread-safe one-shot result slot.
+
+    Deliberately NOT concurrent.futures.Future: our cancellation is
+    queue-level (`GenRequest.cancel` sets a flag; the WORKER later resolves
+    the future with `RequestCancelled` when it pops the request), and a
+    stdlib Future that has been `.cancel()`ed raises InvalidStateError on
+    that late `set_exception` — exactly our flow.
+    """
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exception: Optional[BaseException] = None
+
+    def set_result(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exception = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise RequestTimeout("timed out waiting for generation result")
+        if self._exception is not None:
+            raise self._exception
+        return self._result
+
+
+class GenRequest:
+    """One client request: `rows` batch rows that must stay together
+    (e.g. num_images samples of one prompt), flushed in a single batch so
+    the result arrives whole."""
+
+    def __init__(self, specs: Sequence[SampleSpec], timeout_s: float = 120.0):
+        assert specs, "request needs at least one sample row"
+        self.specs: List[SampleSpec] = list(specs)
+        self.timeout_s = float(timeout_s)
+        self.enqueued_at = time.monotonic()
+        self.future = _Future()
+        self._cancelled = threading.Event()
+
+    @property
+    def rows(self) -> int:
+        return len(self.specs)
+
+    def cancel(self) -> None:
+        """Best-effort: a request already handed to the engine completes."""
+        self._cancelled.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def expired(self, now: float) -> bool:
+        return now - self.enqueued_at > self.timeout_s
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        engine,
+        max_batch: Optional[int] = None,
+        max_delay_ms: float = 25.0,
+        max_queue_rows: int = 64,
+        registry=None,
+        name: str = "dalle_serving",
+    ):
+        """`engine` needs `.generate(list[SampleSpec]) -> (tokens, pixels)`
+        and (unless `max_batch` is given) a `.max_batch` attribute — the
+        tests drive a fake with exactly that surface."""
+        self.engine = engine
+        self.max_batch = int(max_batch or engine.max_batch)
+        assert self.max_batch >= 1
+        engine_cap = getattr(engine, "max_batch", None)
+        assert engine_cap is None or self.max_batch <= engine_cap, (
+            f"max_batch={self.max_batch} exceeds the engine's largest "
+            f"compiled shape {engine_cap}; every flush would fail"
+        )
+        assert int(max_queue_rows) >= self.max_batch, (
+            f"max_queue_rows={max_queue_rows} < max_batch={self.max_batch}: "
+            "a full-size request could never even enqueue"
+        )
+        self.max_delay_s = float(max_delay_ms) / 1000.0
+        self.max_queue_rows = int(max_queue_rows)
+        self._cond = threading.Condition()
+        self._pending: deque = deque()
+        self._pending_rows = 0
+        self._closed = False
+        self._drain = True
+        self.last_error: Optional[BaseException] = None
+        self._last_error_at: Optional[float] = None
+
+        if registry is None:
+            from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        p = name
+        self._m_depth = registry.gauge(
+            f"{p}_queue_depth_rows", "request rows waiting in the batcher queue"
+        )
+        self._m_rejected = registry.counter(
+            f"{p}_rejected_total", "requests rejected because the queue was full"
+        )
+        self._m_timeouts = registry.counter(
+            f"{p}_timeouts_total", "requests failed by per-request timeout"
+        )
+        self._m_cancelled = registry.counter(
+            f"{p}_cancelled_total", "requests cancelled before execution"
+        )
+        self._m_errors = registry.counter(
+            f"{p}_engine_errors_total", "batches failed by an engine exception"
+        )
+        self._m_requests = registry.counter(
+            f"{p}_requests_total", "requests accepted into the queue"
+        )
+        self._m_images = registry.counter(
+            f"{p}_images_total", "images generated (batch rows completed)"
+        )
+        self._m_batches = registry.counter(
+            f"{p}_batches_total", "micro-batches flushed to the engine"
+        )
+        # one bucket per occupancy up to a render-size cap; bigger batches
+        # land in +Inf (the _sum/_count ratio still shows mean occupancy)
+        self._m_occupancy = registry.histogram(
+            f"{p}_batch_occupancy_rows",
+            "real (unpadded) rows per flushed micro-batch",
+            buckets=tuple(float(b) for b in range(1, min(self.max_batch, 32) + 1)),
+        )
+        self._m_latency = registry.histogram(
+            f"{p}_request_latency_seconds",
+            "enqueue-to-result latency per request",
+        )
+        self._m_batch_seconds = registry.histogram(
+            f"{p}_batch_seconds", "engine wall time per flushed micro-batch"
+        )
+
+        self._worker = threading.Thread(
+            target=self._run, name=f"{name}-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # -------------------------------------------------------------- intake
+
+    def submit(
+        self, specs: Sequence[SampleSpec], timeout_s: float = 120.0
+    ) -> GenRequest:
+        """Enqueue one request; returns it (result via `req.future.result()`).
+
+        Raises `QueueFullError` (backpressure) or `ShuttingDownError`
+        immediately instead of blocking the caller.
+        """
+        req = GenRequest(specs, timeout_s=timeout_s)
+        with self._cond:
+            if self._closed:
+                raise ShuttingDownError("batcher is shutting down")
+            if req.rows > self.max_batch:
+                self._m_rejected.inc()
+                raise QueueFullError(
+                    f"request of {req.rows} rows exceeds max batch "
+                    f"{self.max_batch}"
+                )
+            if self._pending_rows + req.rows > self.max_queue_rows:
+                self._m_rejected.inc()
+                raise QueueFullError(
+                    f"queue full ({self._pending_rows}/{self.max_queue_rows} rows)"
+                )
+            self._pending.append(req)
+            self._pending_rows += req.rows
+            self._m_requests.inc()
+            self._m_depth.set(self._pending_rows)
+            self._cond.notify_all()
+        return req
+
+    @property
+    def queue_depth_rows(self) -> int:
+        return self._pending_rows
+
+    def error_age_s(self) -> Optional[float]:
+        """Seconds since the most recent failed flush; None if the last
+        flush succeeded (or none has failed yet). Lets health checks decay
+        a transient error instead of latching unhealthy — a health-gated
+        router that pulls traffic on 503 would otherwise starve the server
+        of the successful batch it needs to clear `last_error`."""
+        if self.last_error is None or self._last_error_at is None:
+            return None
+        return time.monotonic() - self._last_error_at
+
+    # -------------------------------------------------------------- worker
+
+    def _pop_ready(self, batch: List[GenRequest]) -> None:
+        """Move queued requests into `batch` (capacity permitting), failing
+        expired ones and skipping cancelled ones. Caller holds the lock."""
+        now = time.monotonic()
+        rows = sum(r.rows for r in batch)
+        while self._pending:
+            head = self._pending[0]
+            if head.cancelled:
+                self._pending.popleft()
+                self._pending_rows -= head.rows
+                self._m_cancelled.inc()
+                head.future.set_exception(RequestCancelled("cancelled"))
+                continue
+            if head.expired(now):
+                self._pending.popleft()
+                self._pending_rows -= head.rows
+                self._m_timeouts.inc()
+                head.future.set_exception(
+                    RequestTimeout(
+                        f"spent >{head.timeout_s:.1f}s queued; overloaded?"
+                    )
+                )
+                continue
+            if rows + head.rows > self.max_batch:
+                break
+            self._pending.popleft()
+            self._pending_rows -= head.rows
+            rows += head.rows
+            batch.append(head)
+        self._m_depth.set(self._pending_rows)
+
+    def _assemble(self) -> Optional[List[GenRequest]]:
+        """Block until a batch is ready (deadline-or-capacity), or None at
+        shutdown with nothing left to drain."""
+        with self._cond:
+            while not self._pending:
+                if self._closed:
+                    return None
+                self._cond.wait(timeout=0.05)
+            batch: List[GenRequest] = []
+            self._pop_ready(batch)
+            if not batch:  # everything queued was expired/cancelled
+                return []
+            # deadline anchored at the OLDEST accepted request's arrival
+            deadline = batch[0].enqueued_at + self.max_delay_s
+            while sum(r.rows for r in batch) < self.max_batch and not self._closed:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=min(remaining, 0.05))
+                self._pop_ready(batch)
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._assemble()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            self._flush(batch)
+
+    def _flush(self, batch: List[GenRequest]) -> None:
+        specs: List[SampleSpec] = []
+        for req in batch:
+            specs.extend(req.specs)
+        t0 = time.monotonic()
+        try:
+            tokens, pixels = self.engine.generate(specs)
+        except Exception as exc:  # fail fast: every waiter gets the error
+            # timestamp first: readers check last_error then error_age_s
+            self._last_error_at = time.monotonic()
+            self.last_error = exc
+            self._m_errors.inc()
+            for req in batch:
+                req.future.set_exception(exc)
+            return
+        self.last_error = None  # engine recovered: let /healthz go green again
+        # counted on success only, so batches/occupancy/images/batch_seconds
+        # stay mutually consistent (failures are engine_errors_total)
+        self._m_batches.inc()
+        self._m_occupancy.observe(len(specs))
+        self._m_batch_seconds.observe(time.monotonic() - t0)
+        offset = 0
+        now = time.monotonic()
+        for req in batch:
+            toks = tokens[offset : offset + req.rows]
+            pix = None if pixels is None else pixels[offset : offset + req.rows]
+            offset += req.rows
+            self._m_images.inc(req.rows)
+            self._m_latency.observe(now - req.enqueued_at)
+            req.future.set_result((toks, pix))
+
+    # ------------------------------------------------------------ shutdown
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop intake; `drain=True` flushes queued requests first,
+        `drain=False` fails them with `ShuttingDownError`."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._pending:
+                    req = self._pending.popleft()
+                    req.future.set_exception(
+                        ShuttingDownError("server shutting down")
+                    )
+                self._pending_rows = 0
+                self._m_depth.set(0)
+            self._cond.notify_all()
+        self._worker.join(timeout=timeout)

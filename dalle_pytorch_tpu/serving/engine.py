@@ -1,0 +1,331 @@
+"""GenerationEngine: fixed-shape compiled sampling behind a dynamic API.
+
+XLA compiles one program per input shape, so a serving layer must not let
+arbitrary request counts reach the sampler — every distinct batch size
+would trigger a fresh (expensive, possibly remote) compile. The engine
+therefore owns a small ladder of batch shapes (default {1, 4, 8}), rounds
+every micro-batch UP to the nearest rung by padding with copies of row 0,
+and slices the padding back off. Per-request sampling parameters (seed /
+temperature / top-k) ride along as traced arrays
+(`models/dalle.py:generate_images_cached_batched`), so the padded rows
+cost compute but never another compile, and a request's RNG stream is
+independent of which batch it lands in.
+
+`warmup()` runs one dummy batch per rung at startup so the first real
+request never pays compilation latency; compile-cache hits/misses are
+counted into the shared metrics registry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class SampleSpec:
+    """One batch row: a tokenized prompt plus its sampling parameters.
+
+    `top_k` follows the CLI/reference convention: the FRACTION of the
+    vocabulary to drop (0.9 keeps the top 10%).
+    """
+
+    text_ids: np.ndarray  # [text_seq_len] int32
+    seed: int = 0
+    temperature: float = 1.0
+    top_k: float = 0.9
+
+
+@dataclass
+class EngineStats:
+    compiled_shapes: Tuple[int, ...] = ()
+    batches: int = 0
+    rows_generated: int = 0
+    rows_padded: int = 0
+
+
+class GenerationEngine:
+    """Batched text→image generation over a fixed ladder of compiled shapes.
+
+    Parameters
+    ----------
+    model, variables : the DALLE module and its checkpoint params.
+    vae, vae_params : optional pixel decoder. A `DiscreteVAE` is fused into
+        the sampler program (tokens AND pixels from one dispatch); any
+        other object with a host-side `.decode(tokens)` is applied after
+        sampling; None returns tokens only.
+    batch_shapes : compiled batch sizes, ascending after dedup. Requests
+        larger than the top rung are the batcher's problem (it never
+        assembles more rows than `max_batch`).
+    cond_scale : classifier-free guidance scale, engine-wide (a per-request
+        scale would double the compiled-shape ladder; revisit if needed).
+    clip, clip_params : optional CLIP reranker (`models/clip.py:rerank`).
+    tokenizer : host-side tokenizer; required for `tokenize()` / reranking.
+    registry : MetricsRegistry for compile/warmup counters.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables,
+        vae=None,
+        vae_params=None,
+        batch_shapes: Sequence[int] = (1, 4, 8),
+        cond_scale: float = 1.0,
+        clip=None,
+        clip_params=None,
+        tokenizer=None,
+        registry=None,
+        cfg=None,
+    ):
+        assert batch_shapes, "need at least one compiled batch shape"
+        self.model = model
+        self.variables = variables
+        self.vae = vae
+        self.vae_params = vae_params
+        self.batch_shapes = tuple(sorted(set(int(b) for b in batch_shapes)))
+        assert all(b >= 1 for b in self.batch_shapes)
+        self.max_batch = self.batch_shapes[-1]
+        self.cond_scale = float(cond_scale)
+        self.clip = clip
+        self.clip_params = clip_params
+        self.tokenizer = tokenizer
+        self.cfg = cfg
+        self._warm = set()
+        self._lock = threading.Lock()  # one sampler dispatch at a time
+        self.stats = EngineStats(compiled_shapes=())
+        if registry is None:
+            from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        self._compile_miss = registry.counter(
+            "dalle_serving_engine_compile_misses_total",
+            "sampler dispatches that had to compile a new batch shape",
+        )
+        self._compile_hit = registry.counter(
+            "dalle_serving_engine_compile_hits_total",
+            "sampler dispatches served by an already-compiled batch shape",
+        )
+        self._compile_seconds = registry.histogram(
+            "dalle_serving_engine_compile_seconds",
+            "wall time of compiling (warmup) dispatches",
+        )
+
+    # ------------------------------------------------------------- shapes
+
+    def pick_shape(self, n: int) -> int:
+        """Smallest compiled rung that fits n rows."""
+        assert 1 <= n <= self.max_batch, (
+            f"batch of {n} rows exceeds the engine's max shape "
+            f"{self.max_batch}; the batcher must cap at max_batch"
+        )
+        for b in self.batch_shapes:
+            if n <= b:
+                return b
+        return self.max_batch  # unreachable given the assert
+
+    @property
+    def image_seq_len(self) -> int:
+        return self.model.image_seq_len
+
+    def _keep_k(self, top_k: float) -> int:
+        """Fractional drop threshold -> per-row keep count, matching
+        `ops/sampling.py:top_k_filter` exactly so engine results agree with
+        the static-parameter sampler's filtering rule."""
+        v = self.model.total_tokens
+        frac = min(max(float(top_k), 0.0), 1.0)
+        return max(int((1.0 - frac) * v), 1)
+
+    # ----------------------------------------------------------- generate
+
+    def tokenize(self, prompt: str) -> np.ndarray:
+        assert self.tokenizer is not None, "engine built without a tokenizer"
+        ids = self.tokenizer.tokenize(
+            prompt, self.model.text_seq_len, truncate_text=True
+        )
+        return np.asarray(ids[0], dtype=np.int32)
+
+    def warmup(self, shapes: Optional[Sequence[int]] = None) -> None:
+        """Compile every batch rung up front (one dummy batch each)."""
+        text_seq = self.model.text_seq_len
+        for b in shapes or self.batch_shapes:
+            dummy = [
+                SampleSpec(np.zeros(text_seq, np.int32), seed=i)
+                for i in range(b)
+            ]
+            self.generate(dummy)
+
+    def generate(self, specs: Sequence[SampleSpec]):
+        """Run one micro-batch. Returns (tokens [n, image_seq_len] np.int32,
+        pixels [n, H, W, 3] float in [0, 1] or None)."""
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.dalle import generate_images_cached_batched
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        n = len(specs)
+        shape = self.pick_shape(n)
+        pad = shape - n
+        rows = list(specs) + [specs[0]] * pad
+
+        text = np.stack([np.asarray(s.text_ids, np.int32) for s in rows])
+        assert text.shape == (shape, self.model.text_seq_len), (
+            f"prompt rows must be [{self.model.text_seq_len}] token ids, "
+            f"got batch {text.shape}"
+        )
+        seeds = np.asarray([int(s.seed) & 0x7FFFFFFF for s in rows], np.int32)
+        temps = np.asarray([s.temperature for s in rows], np.float32)
+        keep = np.asarray([self._keep_k(s.top_k) for s in rows], np.int32)
+
+        fused = isinstance(self.vae, DiscreteVAE)
+        with self._lock:
+            is_warm = shape in self._warm
+            (self._compile_hit if is_warm else self._compile_miss).inc()
+            t0 = time.perf_counter()
+            out = generate_images_cached_batched(
+                self.model, self.variables, jnp.asarray(text),
+                seeds, temps, keep,
+                cond_scale=self.cond_scale,
+                vae=self.vae if fused else None,
+                vae_params=self.vae_params if fused else None,
+            )
+            if fused:
+                toks, pixels = out
+                toks = np.asarray(toks)
+                pixels = np.asarray(pixels) * 0.5 + 0.5  # un-normalize
+            else:
+                toks = np.asarray(out)
+                pixels = None
+            if not is_warm:
+                self._compile_seconds.observe(time.perf_counter() - t0)
+                self._warm.add(shape)
+                self.stats.compiled_shapes = tuple(sorted(self._warm))
+            self.stats.batches += 1
+            self.stats.rows_generated += n
+            self.stats.rows_padded += pad
+
+        toks = toks[:n]
+        if pixels is None and self.vae is not None:
+            # pretrained wrappers decode host-side to [0, 1] already;
+            # decode only the real rows — padding never leaves the sampler
+            pixels = np.asarray(self.vae.decode(toks))
+        else:
+            pixels = None if pixels is None else pixels[:n]
+        if pixels is not None:
+            pixels = np.clip(pixels, 0.0, 1.0)
+        return toks, pixels
+
+    # ------------------------------------------------------------- rerank
+
+    def rerank(self, prompt: str, images: np.ndarray):
+        """Sort one request's images best-first by CLIP similarity.
+
+        Returns (sorted_images, scores, order) where `order` maps the
+        sorted position back to the original row index — callers carrying
+        parallel arrays (tokens, seeds) must apply it too. Identity with
+        zero scores when no CLIP checkpoint is loaded.
+        """
+        if self.clip is None:
+            return (
+                images,
+                np.zeros(len(images), np.float32),
+                np.arange(len(images)),
+            )
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.clip import rerank as clip_rerank
+
+        assert self.tokenizer is not None, "reranking needs a tokenizer"
+        # mismatches would fail silently (XLA gather clamps OOB indices)
+        assert images.shape[1] == self.clip.visual_image_size, (
+            f"CLIP checkpoint expects {self.clip.visual_image_size}px images "
+            f"but the VAE decodes {images.shape[1]}px"
+        )
+        assert self.tokenizer.vocab_size <= self.clip.num_text_tokens, (
+            f"tokenizer vocab {self.tokenizer.vocab_size} exceeds CLIP "
+            f"num_text_tokens {self.clip.num_text_tokens}"
+        )
+        clip_ids = self.tokenizer.tokenize(
+            prompt, self.clip.text_seq_len, truncate_text=True
+        )
+        sorted_imgs, scores, order = clip_rerank(
+            self.clip,
+            {"params": self.clip_params},
+            jnp.asarray(clip_ids),
+            jnp.asarray(images),
+            text_mask=jnp.asarray(clip_ids != 0),
+        )
+        return np.asarray(sorted_imgs), np.asarray(scores), np.asarray(order)
+
+
+def engine_from_checkpoint(
+    dalle_path: str,
+    clip_path: Optional[str] = None,
+    batch_shapes: Sequence[int] = (1, 4, 8),
+    cond_scale: float = 1.0,
+    registry=None,
+):
+    """Build a `GenerationEngine` from a single-file DALLE checkpoint.
+
+    The loading sequence (VAE reconstruction, tokenizer, ring-attention
+    downgrade for decode) was lifted from `generate.py`, which now calls
+    this instead — CLI and server share one code path by construction.
+    """
+    from pathlib import Path
+
+    from dalle_pytorch_tpu.training.pipeline import (
+        build_tokenizer, dalle_from_config, dvae_from_hparams,
+        load_dalle_checkpoint,
+    )
+
+    ckpt_path = Path(dalle_path)
+    assert ckpt_path.exists(), f"trained DALL-E {ckpt_path} must exist"
+    cfg, dalle_params, vae_params, meta, _ = load_dalle_checkpoint(str(ckpt_path))
+
+    assert meta.get("vae_class_name") == "DiscreteVAE" or vae_params is None, (
+        "checkpoint was trained with a pretrained VAE wrapper; provide it"
+    )
+    if vae_params is None:
+        from dalle_pytorch_tpu.training.pipeline import build_vae
+
+        vae, vae_params = build_vae(cfg)
+    else:
+        assert meta.get("vae_hparams"), "checkpoint missing vae_hparams"
+        vae = dvae_from_hparams(meta["vae_hparams"])
+    fmap = vae.image_size // (2 ** vae.num_layers)
+
+    tokenizer = build_tokenizer(cfg)
+    if cfg.model.attn_impl == "ring":
+        # ring attention is a training-time layout (sequence sharded over
+        # the mesh sp axis); KV-cached decode never runs it, so a
+        # ring-trained checkpoint generates with the dense/auto kernel
+        cfg.model.attn_impl = "auto"
+    model = dalle_from_config(
+        cfg, num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
+        vocab_size=max(tokenizer.vocab_size, 1),
+    )
+
+    clip = clip_params = None
+    if clip_path:
+        from dalle_pytorch_tpu.training.pipeline import load_clip_checkpoint
+
+        clip, clip_params = load_clip_checkpoint(clip_path)
+
+    return GenerationEngine(
+        model=model,
+        variables={"params": dalle_params},
+        vae=vae,
+        vae_params=vae_params,
+        batch_shapes=batch_shapes,
+        cond_scale=cond_scale,
+        clip=clip,
+        clip_params=clip_params,
+        tokenizer=tokenizer,
+        registry=registry,
+        cfg=cfg,
+    )

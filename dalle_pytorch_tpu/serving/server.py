@@ -1,0 +1,360 @@
+"""Stdlib-only JSON HTTP front end for the generation engine.
+
+Endpoints:
+  POST /generate   {"prompt": str, "num_images": int=1, "seed": int?,
+                    "temperature": float=1.0, "top_k": float=0.9,
+                    "rerank": bool=false, "timeout_s": float?}
+                -> {"tokens": [[int]], "shape": [n, H, W, 3]?,
+                    "images_png_b64": [str]?, "clip_scores": [float]?,
+                    "latency_ms": float}
+  GET  /healthz -> {"status": "ok", ...} (503 once draining or after an
+                   engine failure — fail fast, don't wedge clients)
+  GET  /metrics -> Prometheus text exposition from the shared registry
+                   (`training/metrics.py:MetricsRegistry`): queue depth,
+                   batch-occupancy histogram, request latency p50/p95,
+                   compile-cache hits, images/requests/batches totals.
+
+`ThreadingHTTPServer` gives one thread per in-flight request; they all
+funnel into the `MicroBatcher`, which is where concurrent requests
+coalesce into one padded sampler batch. Backpressure maps to HTTP:
+queue full -> 503 + Retry-After, per-request timeout -> 504 (the queued
+request is cancelled so it never costs a batch row), engine error ->
+500. Client disconnects are NOT detected mid-wait (stdlib handler
+limitation); an abandoned request still completes and is discarded.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from dalle_pytorch_tpu.serving.batcher import (
+    MicroBatcher,
+    QueueFullError,
+    RequestTimeout,
+    ShuttingDownError,
+)
+from dalle_pytorch_tpu.serving.engine import GenerationEngine, SampleSpec
+
+MAX_BODY_BYTES = 1 << 20  # prompts are tiny; reject anything bigger
+
+
+def _png_b64(img: np.ndarray) -> str:
+    from PIL import Image
+
+    from dalle_pytorch_tpu.utils.images import to_uint8
+
+    buf = io.BytesIO()
+    Image.fromarray(to_uint8(img)).save(buf, format="PNG")
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the served object is reachable as self.server (ServingHTTPServer)
+    protocol_version = "HTTP/1.1"
+    # per-connection socket timeout: bounds idle keep-alive connections and
+    # slow/partial request bodies (slowloris) so they can't pin handler
+    # threads forever — ThreadingHTTPServer spawns one thread per connection
+    timeout = 120
+
+    def log_message(self, fmt, *args):  # route access logs through the owner
+        if self.server.owner.verbose:
+            super().log_message(fmt, *args)
+
+    # ------------------------------------------------------------ helpers
+
+    def _reply(self, code: int, payload: dict, extra_headers=()) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if code >= 400:
+            # error paths may not have drained the request body; under
+            # HTTP/1.1 keep-alive the leftover bytes would be parsed as the
+            # next request line, so close instead of corrupting the stream
+            self.send_header("Connection", "close")
+            self.close_connection = True
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # -------------------------------------------------------------- GETs
+
+    def do_GET(self):
+        owner = self.server.owner
+        if self.path == "/healthz":
+            healthy, detail = owner.health()
+            self._reply(200 if healthy else 503, detail)
+        elif self.path == "/metrics":
+            text = owner.registry.render().encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            try:
+                self.wfile.write(text)
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # scraper gave up mid-scrape; not traceback-worthy
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    # -------------------------------------------------------------- POSTs
+
+    def do_POST(self):
+        owner = self.server.owner
+        if self.path != "/generate":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if not 0 < length <= MAX_BODY_BYTES:
+                raise ValueError(f"bad Content-Length {length}")
+            body = json.loads(self.rfile.read(length))
+            prompt = body["prompt"]
+            assert isinstance(prompt, str) and prompt.strip(), "empty prompt"
+            num_images = int(body.get("num_images", 1))
+            assert 1 <= num_images <= owner.engine.max_batch, (
+                f"num_images must be in [1, {owner.engine.max_batch}]"
+            )
+            temperature = float(body.get("temperature", 1.0))
+            # NaN fails every comparison, so this also rejects it (Python's
+            # json parser accepts the bare NaN literal)
+            assert 0.0 <= temperature <= 100.0, (
+                "temperature must be a finite value in [0, 100]"
+            )
+            top_k = float(body.get("top_k", 0.9))
+            assert 0.0 <= top_k <= 1.0, "top_k is a fraction in [0, 1]"
+            seed = body.get("seed")
+            if seed is not None:
+                assert not isinstance(seed, (list, dict, bool)), "seed must be an int"
+                seed = int(seed)
+            timeout_s = float(body.get("timeout_s", owner.request_timeout_s))
+            # NaN fails the comparison; cap at the server's own policy so a
+            # client can't pin handler threads/queue rows past it
+            assert 0.0 < timeout_s <= owner.request_timeout_s, (
+                f"timeout_s must be in (0, {owner.request_timeout_s}]"
+            )
+            do_rerank = bool(body.get("rerank", False))
+            assert not do_rerank or owner.engine.clip is not None, (
+                "rerank requested but no CLIP checkpoint is loaded "
+                "(start the server with --clip_path)"
+            )
+        except Exception as exc:
+            self._reply(400, {"error": f"bad request: {exc}"})
+            return
+
+        if seed is None:
+            seed = owner.next_seed(num_images)
+        t0 = time.monotonic()
+        try:
+            try:
+                text_ids = owner.engine.tokenize(prompt)
+            except Exception as exc:  # tokenizer failure is a server error
+                self._reply(500, {"error": f"tokenization failed: {exc}"})
+                return
+            specs = [
+                SampleSpec(
+                    text_ids=text_ids,
+                    seed=int(seed) + i,
+                    temperature=temperature,
+                    top_k=top_k,
+                )
+                for i in range(num_images)
+            ]
+            req = owner.batcher.submit(specs, timeout_s=timeout_s)
+        except QueueFullError as exc:
+            self._reply(503, {"error": str(exc)}, [("Retry-After", "1")])
+            return
+        except ShuttingDownError as exc:
+            self._reply(503, {"error": str(exc)})
+            return
+
+        try:
+            tokens, pixels = req.future.result(timeout=timeout_s + 5.0)
+        except RequestTimeout as exc:
+            req.cancel()
+            self._reply(504, {"error": str(exc)})
+            return
+        except Exception as exc:
+            self._reply(500, {"error": f"generation failed: {exc}"})
+            return
+
+        try:
+            tokens = np.asarray(tokens)
+            payload = {
+                "prompt": prompt,
+                "num_images": num_images,
+                "seed": int(seed),
+                "latency_ms": round((time.monotonic() - t0) * 1000.0, 2),
+            }
+            if pixels is not None:
+                clip_scores = None
+                if do_rerank:
+                    pixels, scores, order = owner.engine.rerank(prompt, pixels)
+                    tokens = tokens[order]  # keep tokens[i] paired with image i
+                    if owner.engine.clip is not None:
+                        clip_scores = np.asarray(scores).tolist()
+                payload["shape"] = list(np.asarray(pixels).shape)
+                payload["images_png_b64"] = [_png_b64(img) for img in pixels]
+                if clip_scores is not None:
+                    payload["clip_scores"] = clip_scores
+            payload["tokens"] = tokens.tolist()
+        except Exception as exc:  # rerank/PNG-encode failure: 500, not EOF
+            self._reply(500, {"error": f"response encoding failed: {exc}"})
+            return
+        self._reply(200, payload)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, owner: "ServingServer"):
+        self.owner = owner
+        super().__init__(addr, _Handler)
+
+
+class ServingServer:
+    """Engine + batcher + HTTP listener with graceful lifecycle.
+
+    `start()` binds and serves on a background thread (port 0 picks a free
+    port; read it back from `.port`). `shutdown()` stops intake, drains the
+    batcher queue, then closes the listener — in-flight clients get their
+    results, new ones get 503.
+    """
+
+    def __init__(
+        self,
+        engine: GenerationEngine,
+        host: str = "127.0.0.1",
+        port: int = 8000,
+        max_delay_ms: float = 25.0,
+        max_queue_rows: int = 64,
+        request_timeout_s: float = 120.0,
+        verbose: bool = False,
+    ):
+        self.engine = engine
+        self.registry = engine.registry
+        self.request_timeout_s = float(request_timeout_s)
+        self.verbose = verbose
+        self.batcher = MicroBatcher(
+            engine,
+            max_delay_ms=max_delay_ms,
+            max_queue_rows=max_queue_rows,
+            registry=self.registry,
+        )
+        try:
+            self._httpd = _Server((host, port), self)
+        except OSError:
+            # bind failure (port in use, bad host): don't leak the batcher
+            # worker thread the line above just started
+            self.batcher.shutdown(drain=False)
+            raise
+        self._thread: Optional[threading.Thread] = None
+        self._state_lock = threading.Lock()
+        self._serving = False
+        self._closed = False
+        self._draining = False
+        self._started_at = time.time()
+        self._seed_lock = threading.Lock()
+        self._seed_counter = int(time.time()) & 0x7FFFFFFF
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def next_seed(self, n: int) -> int:
+        """Allocate n consecutive seeds for a request that didn't pin one."""
+        with self._seed_lock:
+            s = self._seed_counter
+            self._seed_counter = (self._seed_counter + n) & 0x7FFFFFFF
+            return s
+
+    # how long a failed flush keeps /healthz at 503. Time-decayed rather
+    # than cleared-on-success only: a health-gated router pulls traffic on
+    # 503, which would starve the server of the successful batch it needs
+    # to clear the error — latching it unhealthy forever.
+    error_window_s: float = 60.0
+
+    def health(self):
+        # snapshot once: the batcher worker can set/clear the error fields
+        # concurrently with this probe
+        err = self.batcher.last_error
+        err_age = self.batcher.error_age_s()
+        erroring = err_age is not None and err_age < self.error_window_s
+        healthy = not self._draining and not erroring
+        detail = {
+            "status": "ok" if healthy else "unhealthy",
+            "uptime_s": round(time.time() - self._started_at, 1),
+            "queue_depth_rows": self.batcher.queue_depth_rows,
+            "compiled_shapes": list(self.engine.stats.compiled_shapes),
+            "batch_shapes": list(self.engine.batch_shapes),
+        }
+        if err is not None:
+            detail["last_error"] = repr(err)
+            if err_age is not None:
+                detail["last_error_age_s"] = round(err_age, 1)
+        if self._draining:
+            detail["draining"] = True
+        return healthy, detail
+
+    def start(self) -> "ServingServer":
+        assert self._thread is None, "already started"
+        with self._state_lock:
+            assert not self._closed, "server already shut down"
+            self._serving = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="dalle-serving-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Foreground variant for the CLI: blocks until shutdown().
+
+        Returns immediately if shutdown() already ran (e.g. a SIGTERM
+        delivered during startup) instead of serving a closed socket.
+        """
+        assert self._thread is None, "already started in background"
+        with self._state_lock:
+            if self._closed:
+                return
+            self._serving = True
+        self._httpd.serve_forever(poll_interval=0.05)
+
+    def shutdown(self, drain: bool = True) -> None:
+        self._draining = True
+        self.batcher.shutdown(drain=drain)
+        with self._state_lock:
+            first_close = not self._closed
+            self._closed = True
+            serving = self._serving
+        if serving:
+            # socketserver's shutdown() waits on an event only serve_forever
+            # sets; calling it on a never-served listener blocks forever.
+            # (A serve loop that committed under _state_lock but hasn't
+            # entered yet still exits promptly: its shutdown-request flag is
+            # already set when the loop starts.)
+            self._httpd.shutdown()
+            self._serving = False
+        if first_close:
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None

@@ -12,12 +12,216 @@ Covers the reference's observability surface (SURVEY.md §5.1, §5.5):
 
 from __future__ import annotations
 
+import bisect
 import json
+import threading
 import time
+from collections import deque
 from pathlib import Path
-from typing import Optional
+from typing import Dict, List, Optional, Sequence
 
 import jax
+
+
+# ------------------------------------------------- Prometheus-style registry
+#
+# Shared counter/gauge/histogram instruments for the serving layer
+# (`dalle_pytorch_tpu/serving/`) and anything else that wants scrapeable
+# process metrics. Deliberately tiny and stdlib-only: the serving HTTP
+# server renders `registry.render()` at GET /metrics in the Prometheus
+# text exposition format. All instruments are thread-safe — the serving
+# path observes from request handler threads and the batcher worker.
+
+
+def _fmt(v: float) -> str:
+    """Prometheus number formatting: integers without a trailing .0."""
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+class Counter:
+    """Monotonically increasing counter."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        assert amount >= 0, "counters only go up"
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} counter",
+            f"{self.name} {_fmt(self._value)}",
+        ]
+
+
+class Gauge:
+    """Instantaneous value (queue depth, in-flight requests, ...)."""
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def render(self) -> List[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} gauge",
+            f"{self.name} {_fmt(self._value)}",
+        ]
+
+
+# default buckets suit request latencies in seconds AND small occupancy
+# counts; instruments that care pass explicit buckets.
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram:
+    """Cumulative-bucket histogram plus a bounded reservoir for quantiles.
+
+    Prometheus proper computes quantiles server-side from the buckets; the
+    reservoir (last `reservoir_size` observations) lets /metrics also expose
+    ready-made p50/p95 gauges so a bare `curl` shows latency percentiles
+    without a Prometheus deployment.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = _DEFAULT_BUCKETS,
+        reservoir_size: int = 1024,
+    ):
+        self.name, self.help = name, help
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +inf bucket last
+        self._sum = 0.0
+        self._count = 0
+        self._recent: deque = deque(maxlen=reservoir_size)
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._counts[bisect.bisect_left(self.buckets, v)] += 1
+            self._sum += v
+            self._count += 1
+            self._recent.append(v)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def percentile(self, q: float) -> float:
+        """Nearest-rank percentile over the recent-observation reservoir
+        (0.0 when nothing has been observed yet)."""
+        with self._lock:
+            if not self._recent:
+                return 0.0
+            ordered = sorted(self._recent)
+            idx = min(len(ordered) - 1, max(0, int(q * len(ordered))))
+            return ordered[idx]
+
+    def mean(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+    def render(self) -> List[str]:
+        with self._lock:
+            lines = [
+                f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} histogram",
+            ]
+            cum = 0
+            for bound, n in zip(self.buckets, self._counts):
+                cum += n
+                lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {cum}')
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+            lines.append(f"{self.name}_sum {_fmt(self._sum)}")
+            lines.append(f"{self.name}_count {self._count}")
+        # convenience percentile gauges from the reservoir (outside the
+        # lock: percentile() re-acquires it)
+        for q, suffix in ((0.5, "p50"), (0.95, "p95")):
+            qn = f"{self.name}_{suffix}"
+            lines.append(f"# TYPE {qn} gauge")
+            lines.append(f"{qn} {_fmt(self.percentile(q))}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named instrument registry rendering Prometheus text exposition.
+
+    `counter/gauge/histogram` are get-or-create (idempotent by name), so
+    independently constructed components can share instruments.
+    """
+
+    def __init__(self):
+        self._instruments: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help, **kw)
+                self._instruments[name] = inst
+            assert isinstance(inst, cls), (
+                f"metric {name!r} already registered as {type(inst).__name__}"
+            )
+            return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "",
+        buckets: Sequence[float] = _DEFAULT_BUCKETS,
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        return self._instruments.get(name)
+
+    def render(self) -> str:
+        with self._lock:
+            instruments = sorted(self._instruments.items())
+        lines: List[str] = []
+        for _, inst in instruments:
+            lines.extend(inst.render())
+        return "\n".join(lines) + "\n"
 
 
 class MetricsLogger:

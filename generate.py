@@ -7,6 +7,12 @@ class matches (`generate.py:101`), splits prompts on '|', optionally
 completes the text first (--gentxt, `:116-118`), samples image tokens with
 top-k 0.9 + temperature, decodes through the VAE and writes PNGs per
 prompt directory (`:134-143`).
+
+Sampling runs through the serving `GenerationEngine`
+(`dalle_pytorch_tpu/serving/engine.py`) — the same padded fixed-shape
+batching + fused dVAE decode + CLIP rerank code path `serve.py` exposes
+over HTTP, so the CLI dogfoods the production path. `--no_cache` keeps the
+full-reforward sampling oracle for correctness spot checks.
 """
 
 from __future__ import annotations
@@ -53,56 +59,30 @@ def main():
         jax.config.update("jax_platforms", _os.environ["DALLE_TPU_FORCE_PLATFORM"])
     import jax.numpy as jnp
 
-    from dalle_pytorch_tpu.models.dalle import (
-        generate_images, generate_images_cached, generate_texts,
-    )
+    from dalle_pytorch_tpu.models.dalle import generate_images, generate_texts
     from dalle_pytorch_tpu.models.dvae import DiscreteVAE
-    from dalle_pytorch_tpu.training.pipeline import (
-        build_tokenizer, dalle_from_config, load_dalle_checkpoint,
-        dvae_from_hparams,
-    )
+    from dalle_pytorch_tpu.serving.engine import SampleSpec, engine_from_checkpoint
     from dalle_pytorch_tpu.utils.images import save_image_grid, to_uint8
 
-    ckpt_path = Path(args.dalle_path)
-    assert ckpt_path.exists(), f"trained DALL-E {ckpt_path} must exist"
-    cfg, dalle_params, vae_params, meta, _ = load_dalle_checkpoint(str(ckpt_path))
-
-    assert meta.get("vae_class_name") == "DiscreteVAE" or vae_params is None, (
-        "checkpoint was trained with a pretrained VAE wrapper; provide it"
+    # one compiled shape: the CLI always dispatches full --batch_size
+    # batches (the engine pads the final partial chunk)
+    engine = engine_from_checkpoint(
+        args.dalle_path,
+        clip_path=args.clip_path,
+        batch_shapes=(args.batch_size,),
+        cond_scale=args.cond_scale,
     )
-    if vae_params is None:
-        from dalle_pytorch_tpu.training.pipeline import build_vae
-
-        vae, vae_params = build_vae(cfg)
-    else:
-        assert meta.get("vae_hparams"), "checkpoint missing vae_hparams"
-        vae = dvae_from_hparams(meta["vae_hparams"])
-    fmap = vae.image_size // (2 ** vae.num_layers)
-
-    tokenizer = build_tokenizer(cfg)
-    if cfg.model.attn_impl == "ring":
-        # ring attention is a training-time layout (sequence sharded over
-        # the mesh sp axis); KV-cached decode never runs it, so a
-        # ring-trained checkpoint generates with the dense/auto kernel
-        cfg.model.attn_impl = "auto"
-    # (scan checkpoints — masked attn types included — decode natively:
-    # the cached path row-slices the traced pattern masks at the decode
-    # position, parity-pinned in test_scan_executor.py)
-    model = dalle_from_config(
-        cfg, num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
-        vocab_size=max(tokenizer.vocab_size, 1),
-    )
-    variables = {"params": dalle_params}
+    model, variables, vae = engine.model, engine.variables, engine.vae
+    tokenizer, cfg = engine.tokenizer, engine.cfg
     rng = jax.random.PRNGKey(args.seed)
 
     from PIL import Image
 
     dvae_decode = None
-    clip = clip_params = None
-    if args.clip_path:
-        from dalle_pytorch_tpu.training.pipeline import load_clip_checkpoint
-
-        clip, clip_params = load_clip_checkpoint(args.clip_path)
+    # spread the user seed so --seed N and --seed N+1 give fully disjoint
+    # per-image seed ranges (engine rows are seeded individually; plain
+    # consecutive bases would make adjacent runs share most images)
+    next_seed = (args.seed * 1_000_003) & 0x7FFFFFFF
 
     for raw_prompt in args.text.split("|"):
         prompt = raw_prompt.strip()
@@ -122,65 +102,51 @@ def main():
             )
             print(f"completed text: {prompt!r}")
 
-        ids = tokenizer.tokenize(prompt, cfg.model.text_seq_len, truncate_text=True)
-        text = jnp.asarray(np.repeat(ids, args.num_images, axis=0))
+        text_ids = engine.tokenize(prompt)
 
         images = []
         for start in range(0, args.num_images, args.batch_size):
-            chunk = text[start : start + args.batch_size]
-            rng, r = jax.random.split(rng)
-            if not args.no_cache and isinstance(vae, DiscreteVAE):
-                # fused sampler: tokens AND pixels from ONE dispatch (one
-                # tunnel round trip per batch instead of two)
-                _, imgs = generate_images_cached(
+            n = min(args.batch_size, args.num_images - start)
+            if args.no_cache:
+                # full-reforward oracle, bypassing the engine on purpose
+                chunk = jnp.asarray(np.repeat(text_ids[None], n, axis=0))
+                rng, r = jax.random.split(rng)
+                toks = generate_images(
                     model, variables, r, chunk,
                     filter_thres=args.top_k, temperature=args.temperature,
-                    cond_scale=args.cond_scale, vae=vae, vae_params=vae_params,
+                    cond_scale=args.cond_scale,
                 )
-                images.append(np.asarray(imgs) * 0.5 + 0.5)  # un-normalize
+                if isinstance(vae, DiscreteVAE):
+                    if dvae_decode is None:
+                        # jit once: eager decode dispatches per-op (slow on
+                        # remote backends); shapes are fixed across chunks
+                        dvae_decode = jax.jit(
+                            lambda p, t: vae.apply(
+                                {"params": p}, t, method=DiscreteVAE.decode
+                            )
+                        )
+                    imgs = dvae_decode(engine.vae_params, toks)
+                    images.append(np.asarray(imgs) * 0.5 + 0.5)  # un-normalize
+                else:  # pretrained wrappers decode to [0,1] already
+                    images.append(np.asarray(vae.decode(toks)))
                 continue
-            sample_fn = generate_images if args.no_cache else generate_images_cached
-            toks = sample_fn(
-                model, variables, r, chunk,
-                filter_thres=args.top_k, temperature=args.temperature,
-                cond_scale=args.cond_scale,
-            )
-            if isinstance(vae, DiscreteVAE):
-                if dvae_decode is None:
-                    # jit once: eager decode dispatches per-op (slow on
-                    # remote backends); shapes are fixed across chunks
-                    dvae_decode = jax.jit(
-                        lambda p, t: vae.apply({"params": p}, t, method=DiscreteVAE.decode)
-                    )
-                imgs = dvae_decode(vae_params, toks)
-                images.append(np.asarray(imgs) * 0.5 + 0.5)  # un-normalize
-            else:  # pretrained wrappers decode to [0,1] already
-                images.append(np.asarray(vae.decode(toks)))
+            specs = [
+                SampleSpec(
+                    text_ids=text_ids,
+                    seed=next_seed + i,
+                    temperature=args.temperature,
+                    top_k=args.top_k,
+                )
+                for i in range(n)
+            ]
+            next_seed += n
+            _, pixels = engine.generate(specs)
+            assert pixels is not None, "checkpoint has no VAE to decode pixels"
+            images.append(pixels)
         images = np.concatenate(images, axis=0)
 
-        if clip is not None:
-            from dalle_pytorch_tpu.models.clip import rerank
-
-            # mismatches would fail silently (XLA gather clamps OOB indices)
-            assert images.shape[1] == clip.visual_image_size, (
-                f"CLIP checkpoint expects {clip.visual_image_size}px images "
-                f"but the VAE decodes {images.shape[1]}px"
-            )
-            assert tokenizer.vocab_size <= clip.num_text_tokens, (
-                f"tokenizer vocab {tokenizer.vocab_size} exceeds CLIP "
-                f"num_text_tokens {clip.num_text_tokens}"
-            )
-            clip_ids = tokenizer.tokenize(
-                prompt, clip.text_seq_len, truncate_text=True
-            )
-            sorted_imgs, scores, _ = rerank(
-                clip,
-                {"params": clip_params},
-                jnp.asarray(clip_ids),
-                jnp.asarray(images),
-                text_mask=jnp.asarray(clip_ids != 0),
-            )
-            images = np.asarray(sorted_imgs)
+        if engine.clip is not None:
+            images, scores, _ = engine.rerank(prompt, images)
             print("clip scores (best first):", np.asarray(scores)[:8])
 
         safe = "".join(c if c.isalnum() or c in " -." else "" for c in prompt)

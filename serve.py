@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Serve a trained DALL-E checkpoint over HTTP with dynamic micro-batching.
+
+The production face of `generate.py`: the same `GenerationEngine` (KV-cached
+scan decode, fused dVAE pixel decode, optional CLIP rerank), fed by a
+bounded request queue that coalesces concurrent callers into fixed-shape
+compiled batches. See README "Serving" for the API and metrics reference.
+
+    python serve.py --dalle_path checkpoints/dalle.npz --port 8000
+    curl -s localhost:8000/generate -d '{"prompt": "small red circle"}'
+    curl -s localhost:8000/metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--dalle_path", type=str, required=True)
+    p.add_argument("--clip_path", type=str, default=None,
+                   help="optional CLIP checkpoint enabling rerank=true requests")
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000, help="0 picks a free port")
+    p.add_argument(
+        "--batch_shapes", type=str, default="1,4,8",
+        help="comma-separated compiled batch sizes; requests are padded up "
+        "to the nearest shape (more shapes = less padding waste, more "
+        "compiles at warmup)",
+    )
+    p.add_argument("--max_delay_ms", type=float, default=25.0,
+                   help="micro-batch flush deadline from the oldest request")
+    p.add_argument("--max_queue", type=int, default=64,
+                   help="queue bound in rows; beyond it requests get 503")
+    p.add_argument("--request_timeout_s", type=float, default=120.0)
+    p.add_argument("--cond_scale", type=float, default=1.0)
+    p.add_argument("--no_warmup", action="store_true",
+                   help="skip compiling all batch shapes at startup (first "
+                   "request per shape then pays compile latency)")
+    p.add_argument("--verbose", action="store_true", help="HTTP access logs")
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    import jax
+    import os as _os
+
+    if _os.environ.get("DALLE_TPU_FORCE_PLATFORM"):
+        jax.config.update("jax_platforms", _os.environ["DALLE_TPU_FORCE_PLATFORM"])
+
+    from dalle_pytorch_tpu.serving import ServingServer, engine_from_checkpoint
+
+    batch_shapes = tuple(int(b) for b in args.batch_shapes.split(",") if b)
+    engine = engine_from_checkpoint(
+        args.dalle_path,
+        clip_path=args.clip_path,
+        batch_shapes=batch_shapes,
+        cond_scale=args.cond_scale,
+    )
+    if not args.no_warmup:
+        print(f"[serve] warming up batch shapes {engine.batch_shapes} ...",
+              flush=True)
+        engine.warmup()
+        print(f"[serve] warmup done: {engine.stats.compiled_shapes}", flush=True)
+
+    server = ServingServer(
+        engine,
+        host=args.host,
+        port=args.port,
+        max_delay_ms=args.max_delay_ms,
+        max_queue_rows=args.max_queue,
+        request_timeout_s=args.request_timeout_s,
+        verbose=args.verbose,
+    )
+
+    import threading
+
+    stopped = threading.Event()
+
+    def _shutdown():
+        server.shutdown()  # drains the queue, then stops the listener
+        stopped.set()
+
+    stopping = threading.Event()
+
+    def _stop(signum, frame):
+        if stopping.is_set():  # second signal: drain is wedged, force quit
+            print("[serve] second signal: exiting immediately", flush=True)
+            import os
+
+            os._exit(1)
+        stopping.set()
+        print(f"[serve] signal {signum}: draining queue and shutting down",
+              flush=True)
+        # shutdown() joins the serve loop; run it off the main thread, which
+        # is blocked inside serve_forever
+        threading.Thread(target=_shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+
+    # parseable readiness line: tests and orchestrators wait for it
+    print(f"[serve] listening on http://{args.host}:{server.port} "
+          f"(shapes={engine.batch_shapes}, max_delay_ms={args.max_delay_ms}, "
+          f"max_queue={args.max_queue})", flush=True)
+    server.serve_forever()
+    stopped.wait(timeout=60)  # let the drain finish before exiting
+    print("[serve] shutdown complete", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

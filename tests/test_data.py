@@ -85,12 +85,40 @@ class TestSimpleTokenizer:
         """A missing default vocab must degrade LOUDLY, not silently."""
         import dalle_pytorch_tpu.data.tokenizer as tok
 
+        # drop the process-wide probe cache (monkeypatch restores the real
+        # decision afterwards, so later tests see the shipped vocab again)
+        monkeypatch.setattr(tok, "_default_decision", None)
+        monkeypatch.setattr(tok, "_warned_default_probe", False)
         monkeypatch.setattr(
             tok, "NativeBPETokenizer",
             type("Broken", (), {"__init__": lambda self, p: (_ for _ in ()).throw(OSError("no toolchain"))}),
         )
         with pytest.warns(UserWarning, match="ByteTokenizer"):
             assert isinstance(get_tokenizer(), ByteTokenizer)
+
+    def test_byte_fallback_warns_once_per_process(self, monkeypatch):
+        """The `default_bpe_*.model unusable` warning fires once: repeated
+        default-tokenizer construction (trainer + generate CLI + serving
+        engine in one process) reuses the cached probe decision silently."""
+        import warnings as _warnings
+
+        import dalle_pytorch_tpu.data.tokenizer as tok
+
+        real = tok.NativeBPETokenizer
+        monkeypatch.setattr(tok, "_default_decision", None)
+        monkeypatch.setattr(tok, "_warned_default_probe", False)
+        broken = type("Broken", (), {"__init__": lambda self, p: (_ for _ in ()).throw(OSError("no toolchain"))})
+        monkeypatch.setattr(tok, "NativeBPETokenizer", broken)
+        with pytest.warns(UserWarning):
+            assert isinstance(get_tokenizer(), ByteTokenizer)
+        with _warnings.catch_warnings():
+            _warnings.simplefilter("error")  # a second warning would raise
+            assert isinstance(get_tokenizer(), ByteTokenizer)
+        # the fallback is a re-probe, not a latch: once the vocabulary
+        # becomes usable the default tokenizer recovers mid-process
+        monkeypatch.setattr(tok, "NativeBPETokenizer", real)
+        recovered = get_tokenizer()
+        assert not isinstance(recovered, ByteTokenizer)
 
 
 class TestRainbow:

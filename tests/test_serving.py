@@ -1,0 +1,267 @@
+"""Serving-layer unit tests: metrics registry and micro-batcher.
+
+The batcher is driven by a FakeEngine (same `.generate`/`.max_batch`
+surface as `GenerationEngine`) so every queueing policy — deadline flush,
+max-batch flush, queue-full rejection, per-request timeout, cancellation,
+engine fail-fast, graceful drain — is pinned without compiling a model.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.serving.batcher import (
+    MicroBatcher,
+    QueueFullError,
+    RequestCancelled,
+    RequestTimeout,
+    ShuttingDownError,
+)
+from dalle_pytorch_tpu.serving.engine import SampleSpec
+from dalle_pytorch_tpu.training.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+
+# --------------------------------------------------------------- registry
+
+
+class TestMetricsRegistry:
+    def test_counter_and_gauge_render(self):
+        reg = MetricsRegistry()
+        c = reg.counter("reqs_total", "requests")
+        g = reg.gauge("depth", "queue depth")
+        c.inc()
+        c.inc(2)
+        g.set(7)
+        g.dec(3)
+        out = reg.render()
+        assert "# TYPE reqs_total counter" in out
+        assert "reqs_total 3" in out
+        assert "# TYPE depth gauge" in out
+        assert "depth 4" in out
+
+    def test_counter_monotonic(self):
+        with pytest.raises(AssertionError):
+            Counter("c").inc(-1)
+
+    def test_histogram_buckets_and_percentiles(self):
+        h = Histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+            h.observe(v)
+        lines = h.render()
+        text = "\n".join(lines)
+        assert 'lat_bucket{le="0.1"} 1' in text
+        assert 'lat_bucket{le="1"} 3' in text
+        assert 'lat_bucket{le="10"} 4' in text
+        assert 'lat_bucket{le="+Inf"} 5' in text
+        assert "lat_count 5" in text
+        assert h.count == 5
+        assert h.percentile(0.5) == pytest.approx(0.5)
+        assert h.percentile(0.95) == pytest.approx(50.0)
+        assert h.mean() == pytest.approx(sum((0.05, 0.5, 0.5, 5.0, 50.0)) / 5)
+        # boundary values land in the bucket whose bound they equal
+        h2 = Histogram("edge", buckets=(1.0,))
+        h2.observe(1.0)
+        assert 'edge_bucket{le="1"} 1' in "\n".join(h2.render())
+
+    def test_get_or_create_idempotent_and_typed(self):
+        reg = MetricsRegistry()
+        assert reg.counter("x") is reg.counter("x")
+        with pytest.raises(AssertionError):
+            reg.gauge("x")
+
+    def test_empty_histogram(self):
+        h = Histogram("empty")
+        assert h.percentile(0.5) == 0.0
+        assert h.mean() == 0.0
+
+
+# ---------------------------------------------------------------- batcher
+
+
+class FakeEngine:
+    """Same surface the batcher needs from GenerationEngine."""
+
+    def __init__(self, max_batch=4, block_event=None, fail=False):
+        self.max_batch = max_batch
+        self.batches = []  # list of row counts seen
+        self.block_event = block_event  # worker waits here if set
+        self.fail = fail
+
+    def generate(self, specs):
+        if self.block_event is not None:
+            assert self.block_event.wait(10.0), "test forgot to release engine"
+        if self.fail:
+            raise RuntimeError("XLA fell over")
+        self.batches.append(len(specs))
+        tokens = np.stack(
+            [np.full(4, s.seed, dtype=np.int32) for s in specs]
+        )
+        return tokens, None
+
+
+def spec(seed=0):
+    return SampleSpec(text_ids=np.zeros(8, np.int32), seed=seed)
+
+
+def make_batcher(engine, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return MicroBatcher(engine, **kw)
+
+
+class TestMicroBatcher:
+    def test_max_batch_flush_coalesces(self):
+        """Four requests submitted inside the deadline window run as ONE
+        padless batch of 4 — the deadline never has to expire."""
+        eng = FakeEngine(max_batch=4)
+        b = make_batcher(eng, max_delay_ms=2000)
+        t0 = time.monotonic()
+        reqs = [b.submit([spec(i)]) for i in range(4)]
+        results = [r.future.result(timeout=10) for r in reqs]
+        took = time.monotonic() - t0
+        assert eng.batches == [4]
+        assert took < 1.5, "a full batch must flush before the deadline"
+        # each request got ITS row back (seed baked into the fake tokens)
+        for i, (toks, pix) in enumerate(results):
+            assert toks.shape == (1, 4) and int(toks[0, 0]) == i
+            assert pix is None
+        occ = b.registry.get("dalle_serving_batch_occupancy_rows")
+        assert occ.count == 1 and occ.sum == 4
+        b.shutdown()
+
+    def test_deadline_flush_partial_batch(self):
+        eng = FakeEngine(max_batch=8)
+        b = make_batcher(eng, max_delay_ms=100)
+        r = b.submit([spec(7)])
+        toks, _ = r.future.result(timeout=10)
+        assert eng.batches == [1]
+        assert int(toks[0, 0]) == 7
+        b.shutdown()
+
+    def test_multi_row_requests_stay_whole(self):
+        """A num_images=3 request occupies 3 contiguous rows of one batch
+        and a second request fills alongside it."""
+        eng = FakeEngine(max_batch=4)
+        b = make_batcher(eng, max_delay_ms=500)
+        r1 = b.submit([spec(1), spec(2), spec(3)])
+        r2 = b.submit([spec(9)])
+        t1, _ = r1.future.result(timeout=10)
+        t2, _ = r2.future.result(timeout=10)
+        assert eng.batches == [4]
+        assert [int(t[0]) for t in t1] == [1, 2, 3]
+        assert int(t2[0, 0]) == 9
+        b.shutdown()
+
+    def test_oversized_request_rejected(self):
+        b = make_batcher(FakeEngine(max_batch=4))
+        with pytest.raises(QueueFullError, match="exceeds max batch"):
+            b.submit([spec(i) for i in range(5)])
+        b.shutdown()
+
+    def test_queue_full_backpressure(self):
+        gate = threading.Event()
+        eng = FakeEngine(max_batch=1, block_event=gate)
+        b = make_batcher(eng, max_delay_ms=1, max_queue_rows=2)
+        first = b.submit([spec(0)])  # grabbed by the worker, blocks in engine
+        time.sleep(0.2)  # let the worker take it off the queue
+        queued = [b.submit([spec(1)]), b.submit([spec(2)])]
+        with pytest.raises(QueueFullError, match="queue full"):
+            b.submit([spec(3)])
+        rejected = b.registry.get("dalle_serving_rejected_total")
+        assert rejected.value == 1
+        gate.set()
+        for r in [first] + queued:
+            r.future.result(timeout=10)
+        b.shutdown()
+
+    def test_per_request_timeout(self):
+        gate = threading.Event()
+        eng = FakeEngine(max_batch=1, block_event=gate)
+        b = make_batcher(eng, max_delay_ms=1)
+        first = b.submit([spec(0)])
+        time.sleep(0.1)
+        stale = b.submit([spec(1)], timeout_s=0.05)
+        time.sleep(0.2)  # stale expires while the engine is busy
+        gate.set()
+        first.future.result(timeout=10)
+        with pytest.raises(RequestTimeout):
+            stale.future.result(timeout=10)
+        assert b.registry.get("dalle_serving_timeouts_total").value == 1
+        b.shutdown()
+
+    def test_cancellation_skips_request(self):
+        gate = threading.Event()
+        eng = FakeEngine(max_batch=1, block_event=gate)
+        b = make_batcher(eng, max_delay_ms=1)
+        first = b.submit([spec(0)])
+        time.sleep(0.1)
+        doomed = b.submit([spec(1)])
+        doomed.cancel()
+        gate.set()
+        first.future.result(timeout=10)
+        with pytest.raises(RequestCancelled):
+            doomed.future.result(timeout=10)
+        # the cancelled request never cost an engine batch
+        b.shutdown()
+        assert eng.batches == [1]
+
+    def test_engine_error_fails_fast(self):
+        eng = FakeEngine(max_batch=4, fail=True)
+        b = make_batcher(eng, max_delay_ms=50)
+        r1 = b.submit([spec(0)])
+        r2 = b.submit([spec(1)])
+        for r in (r1, r2):
+            with pytest.raises(RuntimeError, match="XLA fell over"):
+                r.future.result(timeout=10)
+        assert isinstance(b.last_error, RuntimeError)
+        assert b.registry.get("dalle_serving_engine_errors_total").value >= 1
+        b.shutdown()
+
+    def test_graceful_shutdown_drains(self):
+        gate = threading.Event()
+        eng = FakeEngine(max_batch=1, block_event=gate)
+        b = make_batcher(eng, max_delay_ms=1)
+        reqs = [b.submit([spec(i)]) for i in range(3)]
+        time.sleep(0.1)
+        gate.set()
+        b.shutdown(drain=True)  # must flush everything queued
+        for i, r in enumerate(reqs):
+            toks, _ = r.future.result(timeout=1)  # already resolved
+            assert int(toks[0, 0]) == i
+        assert sum(eng.batches) == 3
+        with pytest.raises(ShuttingDownError):
+            b.submit([spec(9)])
+
+    def test_hard_shutdown_fails_pending(self):
+        gate = threading.Event()
+        eng = FakeEngine(max_batch=1, block_event=gate)
+        b = make_batcher(eng, max_delay_ms=1)
+        first = b.submit([spec(0)])
+        time.sleep(0.1)
+        pending = b.submit([spec(1)])
+        gate.set()
+        b.shutdown(drain=False)
+        first.future.result(timeout=10)  # in-flight work still completes
+        with pytest.raises(ShuttingDownError):
+            pending.future.result(timeout=1)
+
+    def test_queue_depth_metric_tracks(self):
+        gate = threading.Event()
+        eng = FakeEngine(max_batch=1, block_event=gate)
+        b = make_batcher(eng, max_delay_ms=1, max_queue_rows=8)
+        b.submit([spec(0)])
+        time.sleep(0.1)
+        b.submit([spec(1)])
+        b.submit([spec(2)])
+        assert b.queue_depth_rows == 2
+        depth = b.registry.get("dalle_serving_queue_depth_rows")
+        assert depth.value == 2
+        gate.set()
+        b.shutdown(drain=True)
+        assert b.registry.get("dalle_serving_queue_depth_rows").value == 0
