@@ -29,6 +29,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import jax.lax as lax
 import flax.linen as nn
@@ -52,8 +53,21 @@ AUTO_FLASH_MIN_SEQ = 2048
 
 def _cache_write(buf: jnp.ndarray, val: jnp.ndarray, index) -> jnp.ndarray:
     """Write val [B,H,n,D] into buf [B,H,S,D] at sequence position `index`
-    (n = 1 for single-token decode, larger for prefill chunks)."""
-    return lax.dynamic_update_slice(buf, val.astype(buf.dtype), (0, 0, index, 0))
+    (n = 1 for single-token decode, larger for prefill chunks).
+
+    `index` is either a scalar (the whole batch sits at one position — the
+    micro-batch decode scan) or a [B] vector (each row sits at its OWN
+    position — the continuous-batching slot cache, where rows were admitted
+    at different times)."""
+    if jnp.ndim(index) == 0:
+        return lax.dynamic_update_slice(
+            buf, val.astype(buf.dtype), (0, 0, index, 0)
+        )
+    return jax.vmap(
+        lambda b, v, i: lax.dynamic_update_slice(
+            b, v.astype(b.dtype), (0, i, 0)
+        )
+    )(buf, val, index)
 
 
 class Attention(nn.Module):
@@ -140,11 +154,23 @@ class Attention(nn.Module):
         new_cache = None
         if cache is not None:
             # n-token chunk (prefill or single-token decode) written into a
-            # fixed-shape cache at sequence position `index`
+            # fixed-shape cache at sequence position `index`. A scalar index
+            # means the whole batch decodes in lockstep; a [B] index means
+            # per-row positions (continuous-batching slots admitted at
+            # different times) — every index-dependent op below (rotary row
+            # slice, cache write, causal mask, pattern-mask row slice) then
+            # runs per row via vmap, at identical per-row numerics.
             index = cache["index"]
+            per_row = jnp.ndim(index) == 1
             if rotary is not None:
-                rot = lax.dynamic_slice_in_dim(rotary, index, n, axis=0)
-                rot = jnp.expand_dims(rot, (0, 1))  # [1,1,n,dr]
+                if per_row:
+                    rot = jax.vmap(
+                        lambda i: lax.dynamic_slice_in_dim(rotary, i, n, axis=0)
+                    )(index)
+                    rot = rot[:, None]  # [B,1,n,dr]
+                else:
+                    rot = lax.dynamic_slice_in_dim(rotary, index, n, axis=0)
+                    rot = jnp.expand_dims(rot, (0, 1))  # [1,1,n,dr]
                 q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
             ck = _cache_write(cache["k"], k, index)
             cv = _cache_write(cache["v"], v, index)
@@ -152,8 +178,18 @@ class Attention(nn.Module):
             # query row i sits at global position index + i: causal over the
             # written prefix (the reference instead relies on only having
             # written the prefix, `attention.py:71-76,86`)
-            valid = jnp.arange(max_len)[None, :] <= index + jnp.arange(n)[:, None]
-            mask = valid[None, None]
+            if per_row:
+                valid = (
+                    jnp.arange(max_len)[None, None, :]
+                    <= index[:, None, None] + jnp.arange(n)[None, :, None]
+                )
+                mask = valid[:, None]  # [B,1,n,max_len]
+            else:
+                valid = (
+                    jnp.arange(max_len)[None, :]
+                    <= index + jnp.arange(n)[:, None]
+                )
+                mask = valid[None, None]
             def mask_rows_at(pm):
                 # pad to max_len with True (decode caches may be 1 longer
                 # than the mask), then row-slice at the decode position —
@@ -163,15 +199,21 @@ class Attention(nn.Module):
                 if pm.shape[0] < max_len:
                     pad = max_len - pm.shape[0]
                     pm = jnp.pad(pm, ((0, pad), (0, pad)), constant_values=True)
-                return lax.dynamic_slice_in_dim(
-                    pm[:, :max_len], index, n, axis=0
-                )
+                pm = pm[:, :max_len]
+                if per_row:
+                    return jax.vmap(
+                        lambda i: lax.dynamic_slice_in_dim(pm, i, n, axis=0)
+                    )(index)[:, None]  # [B,1,n,max_len]
+                return lax.dynamic_slice_in_dim(pm, index, n, axis=0)[
+                    None, None
+                ]
 
             if self.static_mask is not None:
-                rows = mask_rows_at(jnp.asarray(np.asarray(self.static_mask)))
-                mask = mask & rows[None, None]
+                mask = mask & mask_rows_at(
+                    jnp.asarray(np.asarray(self.static_mask))
+                )
             if mask_array is not None:
-                mask = mask & mask_rows_at(mask_array)[None, None]
+                mask = mask & mask_rows_at(mask_array)
             out = dense_attention(q, ck, cv, mask=mask, stable=self.stable)
             new_cache = {"k": ck, "v": cv, "index": index + n}
         else:

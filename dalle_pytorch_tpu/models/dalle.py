@@ -440,15 +440,21 @@ class DALLE(nn.Module):
         return logits[:, 0].astype(jnp.float32), cache
 
     def decode_image_step(self, img_token: jnp.ndarray, image_pos, cache: dict):
-        """Feed one sampled image token (grid index `image_pos`, traced);
+        """Feed one sampled image token (grid index `image_pos`, traced —
+        a scalar for lockstep decode or [B] for per-row slot positions);
         returns (next-position logits [B, V], cache)."""
         emb = self.image_emb(img_token[:, None].astype(jnp.int32))
         if not self.rotary_emb:
             table = self.image_pos_emb(self.image_seq_len)
-            row = jax.lax.dynamic_slice_in_dim(
-                table, jnp.clip(image_pos, 0, self.image_seq_len - 1), 1, axis=0
-            )
-            emb = emb + row[None]
+            clipped = jnp.clip(image_pos, 0, self.image_seq_len - 1)
+            if jnp.ndim(image_pos) == 1:
+                row = jax.vmap(
+                    lambda p: jax.lax.dynamic_slice_in_dim(table, p, 1, axis=0)
+                )(clipped)  # [B, 1, dim]
+                emb = emb + row
+            else:
+                row = jax.lax.dynamic_slice_in_dim(table, clipped, 1, axis=0)
+                emb = emb + row[None]
         out, cache = self.transformer(emb, cache=cache)
         return self.to_logits(out)[:, 0].astype(jnp.float32), cache
 
@@ -499,15 +505,24 @@ def _primed_image_tokens(
     return img_tokens, primed
 
 
-@functools.lru_cache(maxsize=16)
+@functools.lru_cache(maxsize=32)
 def _jitted_sampler(fn_builder, model, static_key):
     """One compiled sampler per (entry point, model, sampling params).
 
     Without this, every `generate_images*` call dispatches its prefill and
     setup ops eagerly — one backend round trip per op, which dominates
     wall time on remote/tunneled devices (BASELINE.md measurement notes).
+
+    A builder may carry `_donate_argnums` (the continuous-batching slot
+    ops donate their state argument: the caller always replaces its state
+    with the return value, and without donation every chunk/prefill/release
+    dispatch would keep TWO copies of the whole slot KV cache alive and
+    pay a full-cache copy).
     """
-    return jax.jit(fn_builder(model, static_key))
+    return jax.jit(
+        fn_builder(model, static_key),
+        donate_argnums=getattr(fn_builder, "_donate_argnums", ()),
+    )
 
 
 _warned_eager_sampler = False
@@ -725,19 +740,13 @@ def _generate_images_cached_batched_impl(
     cond_scale: float = 1.0,
 ):
     from dalle_pytorch_tpu.ops.sampling import (
-        top_k_filter_per_row, gumbel_sample_per_row,
+        top_k_filter_per_row, gumbel_sample_per_row, per_row_step_keys,
     )
 
     b = text.shape[0]
     image_seq_len = model.image_seq_len
     use_null = cond_scale != 1.0
     img_tokens = jnp.zeros((b, image_seq_len), dtype=jnp.int32)
-
-    # per-row base keys from the request seeds; the per-step key is a
-    # fold_in of (base, step) — deterministic and batch-invariant
-    base_keys = jax.vmap(
-        lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s)
-    )(seeds)
 
     def blend(row):
         if not use_null:
@@ -762,7 +771,10 @@ def _generate_images_cached_batched_impl(
         img_tokens, cache, row = carry
         masked = jnp.where(blocked, NEG_MASK_VALUE, blend(row))
         filtered = top_k_filter_per_row(masked, keep_k)
-        step_keys = jax.vmap(jax.random.fold_in, in_axes=(0, None))(base_keys, i)
+        # (seed, image position) keyed RNG — shared derivation with the
+        # continuous-batching chunk decode (ops/sampling.py), so the two
+        # engines sample bit-identical streams per row
+        step_keys = per_row_step_keys(seeds, jnp.full((b,), i, jnp.int32))
         sample = gumbel_sample_per_row(step_keys, filtered, temperatures)
         sample = (sample - model.total_text_tokens).astype(jnp.int32)
         img_tokens = jax.lax.dynamic_update_slice(img_tokens, sample[:, None], (0, i))
@@ -775,6 +787,250 @@ def _generate_images_cached_batched_impl(
     carry = (img_tokens, cache, row)
     (img_tokens, _, _), _ = jax.lax.scan(step, carry, jnp.arange(image_seq_len))
     return img_tokens
+
+
+# ------------------------------------------------ continuous batching (slots)
+#
+# The micro-batch sampler above flushes a batch and runs the ENTIRE
+# image_seq_len decode scan before anything else can touch the device; a
+# request arriving just after a flush waits a whole pass for its first
+# token. The slot API below instead keeps ONE persistent fixed-shape decode
+# state of `max_batch` cache slots, advanced in chunks of K tokens by one
+# jitted step; a host-side allocator (serving/engine.py) admits new prompts
+# into free slots (prefill-into-slot) and retires finished rows at chunk
+# boundaries — vLLM-style token-boundary admission, with the same
+# fixed-shape-compilation discipline as the rest of the serving stack
+# (exactly two compiled programs: prefill at batch 1, chunk at max_batch).
+#
+# Per-row state threaded through the stack: per-slot cache `index`
+# (models/attention.py per-row cached path), per-slot token-shift ring
+# positions (ops/shift.py), per-slot image position / active mask /
+# seed / temperature / top-k here. RNG is keyed by (seed, image position)
+# via ops/sampling.py:per_row_step_keys — the same derivation the
+# micro-batch sampler uses — so a request's tokens are bit-identical
+# whether served alone, padded, or admitted mid-flight (pinned by
+# tests/test_continuous.py).
+
+
+def init_slot_state(model: DALLE, max_batch: int, dtype=None) -> dict:
+    """Persistent decode state for `max_batch` cache slots.
+
+    Free slots hold zeros; `prefill_into_slot` overwrites a slot wholesale
+    on admission (including every cache position, so no state leaks between
+    the consecutive occupants of a slot), and `active` gates which rows
+    advance in `decode_image_chunk`.
+    """
+    s = int(max_batch)
+    return {
+        "cache": make_decode_cache(
+            depth=model.depth,
+            batch=s,
+            max_len=model.total_seq_len + 1,
+            heads=model.heads,
+            dim_head=model.dim_head,
+            dim=model.dim,
+            image_fmap_size=model.image_fmap_size,
+            shift_tokens=model.shift_tokens,
+            dtype=model.dtype if dtype is None else dtype,
+            executor=model.executor,
+            per_row=True,
+        ),
+        # pending next-position logits per slot (what the next sample
+        # draws from; written by prefill, refreshed every decode step)
+        "row": jnp.zeros((s, model.total_tokens), jnp.float32),
+        "img_tokens": jnp.zeros((s, model.image_seq_len), jnp.int32),
+        "img_pos": jnp.zeros((s,), jnp.int32),
+        "active": jnp.zeros((s,), jnp.bool_),
+        "seeds": jnp.zeros((s,), jnp.int32),
+        "temps": jnp.ones((s,), jnp.float32),
+        "keep_k": jnp.ones((s,), jnp.int32),
+    }
+
+
+def prefill_into_slot(
+    model: DALLE,
+    variables,
+    state: dict,
+    text: jnp.ndarray,
+    slot,
+    seed,
+    temperature,
+    keep_k,
+):
+    """Admit one prompt into cache slot `slot` (traced scalar).
+
+    Runs the text prefill at batch 1 — the same `decode_prefill` the
+    micro-batch sampler runs, so per-row numerics match bit-for-bit — and
+    scatters the resulting K/V (+ token-shift rings) into the slot row of
+    the persistent state. ONE compiled program regardless of which slot is
+    filled: the slot index is traced data, never a shape.
+
+    `state` is DONATED: its buffers are invalid after the call — always
+    replace your reference with the return value (as the slot ops below
+    all do). This keeps exactly one slot cache alive instead of two.
+    """
+    return _jit_sample(
+        _prefill_slot_builder, model, (),
+        variables, state, text,
+        jnp.int32(slot), jnp.int32(seed),
+        jnp.float32(temperature), jnp.int32(keep_k),
+    )
+
+
+def _prefill_slot_builder(model, key):
+    del key
+    batch_axis = 1 if model.executor == "scan" else 0
+
+    def fn(variables, state, text, slot, seed, temperature, keep_k):
+        row, cache1 = model.apply(
+            variables,
+            text,
+            init_decode_cache(model, 1),
+            method=DALLE.decode_prefill,
+        )
+
+        def write(path, s_leaf, p_leaf):
+            # `index` leaves are not scattered: the chunk step stamps every
+            # layer's index from the per-slot `img_pos` (single source of
+            # truth for position — see set_decode_cache_index)
+            if getattr(path[-1], "key", None) == "index":
+                return s_leaf
+            return jax.lax.dynamic_update_slice_in_dim(
+                s_leaf, p_leaf.astype(s_leaf.dtype), slot, axis=batch_axis
+            )
+
+        new_cache = jax.tree_util.tree_map_with_path(
+            write, state["cache"], cache1
+        )
+        out = dict(state)
+        out["cache"] = new_cache
+        out["row"] = jax.lax.dynamic_update_slice(
+            state["row"], row.astype(state["row"].dtype), (slot, 0)
+        )
+        out["img_tokens"] = jax.lax.dynamic_update_slice(
+            state["img_tokens"],
+            jnp.zeros((1, model.image_seq_len), jnp.int32),
+            (slot, 0),
+        )
+        out["img_pos"] = state["img_pos"].at[slot].set(0)
+        out["active"] = state["active"].at[slot].set(True)
+        out["seeds"] = state["seeds"].at[slot].set(seed)
+        out["temps"] = state["temps"].at[slot].set(temperature)
+        out["keep_k"] = state["keep_k"].at[slot].set(keep_k)
+        return out
+
+    return fn
+
+
+_prefill_slot_builder._donate_argnums = (1,)  # state
+
+
+def release_slots(model: DALLE, state: dict, mask) -> dict:
+    """Deactivate the slots where `mask` is True (jitted, fixed shape;
+    `state` is donated — replace your reference with the return value)."""
+    return _jit_sample(
+        _release_builder, model, (), state, jnp.asarray(mask, jnp.bool_)
+    )
+
+
+def _release_builder(model, key):
+    del model, key
+
+    def fn(state, mask):
+        return {**state, "active": state["active"] & ~mask}
+
+    return fn
+
+
+_release_builder._donate_argnums = (0,)  # state
+
+
+def decode_image_chunk(model: DALLE, variables, state: dict, chunk: int):
+    """Advance every live slot by up to `chunk` tokens (one jitted program
+    per (model, chunk)).
+
+    Each of the `chunk` steps samples one token per live row from its
+    pending logits — per-row (seed, image-position) RNG, per-row
+    temperature/top-k — writes it at the row's own image position, and
+    feeds it back through the transformer at the row's own cache position.
+    Rows that hit `image_seq_len` mid-chunk freeze (their cache, tokens,
+    and position stop advancing) until the host retires them at the chunk
+    boundary; inactive slots compute along as padding but persist nothing.
+
+    `state` is DONATED (see `prefill_into_slot`) — replace your reference
+    with the return value.
+    """
+    return _jit_sample(
+        _chunk_builder, model, (int(chunk),), variables, state
+    )
+
+
+def _chunk_builder(model, key):
+    (chunk,) = key
+    from dalle_pytorch_tpu.models.transformer import set_decode_cache_index
+    from dalle_pytorch_tpu.ops.sampling import (
+        gumbel_sample_per_row, per_row_step_keys, top_k_filter_per_row,
+    )
+
+    text_len = model.text_seq_len + 1  # <bos> + text prefix
+    image_seq_len = model.image_seq_len
+    blocked = jnp.asarray(
+        np.arange(model.total_tokens) < model.total_text_tokens
+    )[None]
+
+    def fn(variables, state):
+        active = state["active"]
+        seeds = state["seeds"]
+        temps = state["temps"]
+        keep_k = state["keep_k"]
+
+        def step(carry, _):
+            cache, row, img_tokens, img_pos = carry
+            live = active & (img_pos < image_seq_len)
+
+            masked = jnp.where(blocked, NEG_MASK_VALUE, row)
+            filtered = top_k_filter_per_row(masked, keep_k)
+            keys = per_row_step_keys(seeds, img_pos)
+            sample = gumbel_sample_per_row(keys, filtered, temps)
+            sample = (sample - model.total_text_tokens).astype(jnp.int32)
+
+            written = jax.vmap(
+                lambda r, t, p: jax.lax.dynamic_update_slice(r, t[None], (p,))
+            )(img_tokens, sample, jnp.clip(img_pos, 0, image_seq_len - 1))
+            img_tokens = jnp.where(live[:, None], written, img_tokens)
+
+            # stamp every layer's cache index from the per-slot position,
+            # then run one decode step at per-row positions
+            cache = set_decode_cache_index(
+                cache, img_pos + text_len, model.executor
+            )
+            new_row, cache = model.apply(
+                variables, sample, img_pos, cache,
+                method=DALLE.decode_image_step,
+            )
+            row = jnp.where(live[:, None], new_row, row)
+            img_pos = jnp.where(live, img_pos + 1, img_pos)
+            return (cache, row, img_tokens, img_pos), None
+
+        carry = (
+            state["cache"], state["row"], state["img_tokens"],
+            state["img_pos"],
+        )
+        (cache, row, img_tokens, img_pos), _ = jax.lax.scan(
+            step, carry, None, length=chunk
+        )
+        return {
+            **state,
+            "cache": cache,
+            "row": row,
+            "img_tokens": img_tokens,
+            "img_pos": img_pos,
+        }
+
+    return fn
+
+
+_chunk_builder._donate_argnums = (1,)  # state
 
 
 def forward_with_cond_scale(

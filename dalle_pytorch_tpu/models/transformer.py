@@ -949,6 +949,7 @@ def make_decode_cache(
     shift_tokens: bool = False,
     dtype=jnp.float32,
     executor: str = "unrolled",
+    per_row: bool = False,
 ) -> dict:
     """Decode cache pytree for a Transformer of this geometry.
 
@@ -957,13 +958,19 @@ def make_decode_cache(
     takes per-layer dicts ("layer_{i}"); the scan executor takes the same
     leaves depth-stacked along axis 0 (they ride the layer scan as
     scanned inputs/outputs).
+
+    `per_row=True` sizes the `index` leaves [batch] (scan: [depth, batch])
+    instead of scalar, putting each batch row at its OWN sequence position —
+    the continuous-batching slot cache, where rows are admitted at token
+    boundaries rather than in lockstep (`models/dalle.py:init_slot_state`).
     """
+    idx_shape = (batch,) if per_row else ()
     if executor == "scan":
         cache = {
             "attn": {
                 "k": jnp.zeros((depth, batch, heads, max_len, dim_head), dtype),
                 "v": jnp.zeros((depth, batch, heads, max_len, dim_head), dtype),
-                "index": jnp.zeros((depth,), jnp.int32),
+                "index": jnp.zeros((depth,) + idx_shape, jnp.int32),
             }
         }
         if shift_tokens:
@@ -981,7 +988,7 @@ def make_decode_cache(
             "attn": {
                 "k": jnp.zeros((batch, heads, max_len, dim_head), dtype),
                 "v": jnp.zeros((batch, heads, max_len, dim_head), dtype),
-                "index": jnp.zeros((), jnp.int32),
+                "index": jnp.zeros(idx_shape, jnp.int32),
             }
         }
         if shift_tokens:
@@ -990,3 +997,25 @@ def make_decode_cache(
             layer["shift_ff"] = jnp.zeros((batch, image_fmap_size, dim), dtype)
         cache[f"layer_{i}"] = layer
     return cache
+
+
+def set_decode_cache_index(cache: dict, pos: jnp.ndarray, executor: str) -> dict:
+    """Overwrite every layer's cache `index` with `pos`.
+
+    Layers always advance in lockstep, so the per-layer indices are copies
+    of one logical position; the continuous-batching chunk loop keeps that
+    position as explicit per-slot state (`img_pos`) and stamps it into the
+    cache before each step — which is also how retired/inactive slots are
+    kept frozen (their position simply never advances).
+    """
+    if executor == "scan":
+        depth = cache["attn"]["index"].shape[0]
+        idx = jnp.broadcast_to(pos, (depth,) + pos.shape).astype(jnp.int32)
+        return {**cache, "attn": {**cache["attn"], "index": idx}}
+    out = {}
+    for name, layer in cache.items():
+        out[name] = {
+            **layer,
+            "attn": {**layer["attn"], "index": pos.astype(jnp.int32)},
+        }
+    return out

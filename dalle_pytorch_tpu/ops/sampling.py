@@ -48,6 +48,24 @@ def top_k_filter_per_row(logits: jnp.ndarray, keep_k: jnp.ndarray) -> jnp.ndarra
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
+def per_row_step_keys(seeds: jnp.ndarray, positions: jnp.ndarray) -> jax.Array:
+    """Per-row sampling keys for decode step(s): fold (seed, position).
+
+    Row i's stream is a pure function of (seeds[i], positions[i]) — its own
+    request seed and its own IMAGE position — never of batch composition,
+    slot index, or wall-clock step. This is the single derivation shared by
+    the micro-batch sampler (`models/dalle.py:
+    _generate_images_cached_batched_impl`, where every row sits at the same
+    position) and the continuous-batching chunk decode (where rows sit at
+    DIFFERENT positions), so a request's tokens are bit-identical whichever
+    engine — and whichever mid-flight admission point — serves it.
+    """
+    base = jax.vmap(lambda s: jax.random.fold_in(jax.random.PRNGKey(0), s))(
+        seeds
+    )
+    return jax.vmap(jax.random.fold_in)(base, positions)
+
+
 def gumbel_sample_per_row(
     keys: jax.Array, logits: jnp.ndarray, temperature: jnp.ndarray
 ) -> jnp.ndarray:

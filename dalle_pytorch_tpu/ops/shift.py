@@ -18,6 +18,7 @@ in the decode loop (see models/transformer.py cached path).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -79,31 +80,57 @@ def shift_token_step(
 ):
     """One-token token-shift against the ring buffer.
 
-    h: [B, 1, D] pre-shift value of the token at global position `pos`
-    (traced scalar). Returns (shifted [B, 1, D], updated ring).
+    h: [B, 1, D] pre-shift value of the token at global position `pos` — a
+    traced scalar (all rows at one position, the micro-batch decode scan)
+    or a traced [B] vector (per-row positions, the continuous-batching slot
+    cache). Returns (shifted [B, 1, D], updated ring).
     """
     b, _, d = h.shape
     half, q = d // 2, d // 4
     cur = h[:, 0]
 
-    prev = lax.dynamic_slice_in_dim(ring, jnp.mod(pos - 1, fmap), 1, axis=1)[:, 0]
-    up = lax.dynamic_slice_in_dim(ring, jnp.mod(pos, fmap), 1, axis=1)[:, 0]
+    if jnp.ndim(pos) == 1:
+        # per-row positions: each row reads/writes its OWN ring slots
+        prev = jax.vmap(
+            lambda r, p: lax.dynamic_slice_in_dim(
+                r, jnp.mod(p - 1, fmap), 1, axis=0
+            )
+        )(ring, pos)[:, 0]
+        up = jax.vmap(
+            lambda r, p: lax.dynamic_slice_in_dim(r, jnp.mod(p, fmap), 1, axis=0)
+        )(ring, pos)[:, 0]
+        posb = pos[:, None]  # [B,1] broadcasting against [B, channels]
+    else:
+        prev = lax.dynamic_slice_in_dim(
+            ring, jnp.mod(pos - 1, fmap), 1, axis=1
+        )[:, 0]
+        up = lax.dynamic_slice_in_dim(ring, jnp.mod(pos, fmap), 1, axis=1)[:, 0]
+        posb = pos
 
     # text position: first half of channels from the previous token
-    t_first = jnp.where(pos > 0, prev[:, :half], jnp.zeros_like(prev[:, :half]))
+    t_first = jnp.where(posb > 0, prev[:, :half], jnp.zeros_like(prev[:, :half]))
     text_shift = jnp.concatenate([t_first, cur[:, half:]], axis=-1)
 
     # image position i (row r, col c): first quarter from one row up
     # (i - fmap, valid when r > 0), second quarter from one col left
     # (i - 1, valid when c > 0); both sources are image positions whenever
     # valid, so text never leaks into the grid.
-    i = pos - text_len
+    i = posb - text_len
     top = jnp.where(i >= fmap, up[:, :q], jnp.zeros_like(up[:, :q]))
     left = jnp.where(
         jnp.mod(i, fmap) != 0, prev[:, q : 2 * q], jnp.zeros_like(prev[:, q : 2 * q])
     )
     img_shift = jnp.concatenate([top, left, cur[:, 2 * q :]], axis=-1)
 
-    out = jnp.where(pos < text_len, text_shift, img_shift)
-    ring = lax.dynamic_update_slice(ring, cur[:, None], (0, jnp.mod(pos, fmap), 0))
+    out = jnp.where(posb < text_len, text_shift, img_shift)
+    if jnp.ndim(pos) == 1:
+        ring = jax.vmap(
+            lambda r, c, p: lax.dynamic_update_slice(
+                r, c[None], (jnp.mod(p, fmap), 0)
+            )
+        )(ring, cur, pos)
+    else:
+        ring = lax.dynamic_update_slice(
+            ring, cur[:, None], (0, jnp.mod(pos, fmap), 0)
+        )
     return out[:, None], ring

@@ -6,11 +6,16 @@ queue feeding fixed-shape compiled sampler programs.
   * `engine.py`   — `GenerationEngine`: wraps the KV-cached sampler
     (`models/dalle.py:generate_images_cached_batched`) behind a fixed set
     of compiled batch shapes, pads partial batches, warms up compilation,
-    and optionally CLIP-reranks results.
+    and optionally CLIP-reranks results. `ContinuousEngine` +
+    `SlotAllocator`: continuous batching — one persistent decode state of
+    `max_batch` cache slots advanced in K-token chunks, prompts admitted
+    into free slots at token boundaries (`models/dalle.py:
+    prefill_into_slot` / `decode_image_chunk`).
   * `batcher.py`  — `MicroBatcher`: bounded queue with dynamic
     micro-batching (flush on max-batch or deadline), backpressure via
     queue-full rejection, per-request timeout/cancellation, graceful
-    drain.
+    drain. `ContinuousBatcher`: same queue surface, but an
+    admit→chunk→retire worker loop over the slot cache.
   * `server.py`   — stdlib-only JSON HTTP API: POST /generate,
     GET /healthz, GET /metrics (Prometheus text format).
 
@@ -20,11 +25,14 @@ cannot drift.
 """
 
 from dalle_pytorch_tpu.serving.engine import (
+    ContinuousEngine,
     GenerationEngine,
     SampleSpec,
+    SlotAllocator,
     engine_from_checkpoint,
 )
 from dalle_pytorch_tpu.serving.batcher import (
+    ContinuousBatcher,
     MicroBatcher,
     QueueFullError,
     RequestCancelled,
@@ -34,8 +42,11 @@ from dalle_pytorch_tpu.serving.batcher import (
 from dalle_pytorch_tpu.serving.server import ServingServer
 
 __all__ = [
+    "ContinuousBatcher",
+    "ContinuousEngine",
     "GenerationEngine",
     "SampleSpec",
+    "SlotAllocator",
     "engine_from_checkpoint",
     "MicroBatcher",
     "QueueFullError",

@@ -31,6 +31,8 @@ import time
 from collections import deque
 from typing import List, Optional, Sequence
 
+import numpy as np
+
 from dalle_pytorch_tpu.serving.engine import SampleSpec
 
 
@@ -96,6 +98,11 @@ class GenRequest:
         self.enqueued_at = time.monotonic()
         self.future = _Future()
         self._cancelled = threading.Event()
+        # when the request's FIRST token existed on the host: the chunk
+        # boundary after admission (continuous engine) or batch completion
+        # (micro-batch engine — its tokens only materialize at scan end).
+        # Benches read it for time-to-first-token percentiles.
+        self.first_token_at: Optional[float] = None
 
     @property
     def rows(self) -> int:
@@ -127,7 +134,11 @@ class MicroBatcher:
         and (unless `max_batch` is given) a `.max_batch` attribute — the
         tests drive a fake with exactly that surface."""
         self.engine = engine
-        self.max_batch = int(max_batch or engine.max_batch)
+        # explicit None check: a caller passing a misconfigured 0 should
+        # hit the assert below, not silently get the engine's cap
+        self.max_batch = int(
+            engine.max_batch if max_batch is None else max_batch
+        )
         assert self.max_batch >= 1
         engine_cap = getattr(engine, "max_batch", None)
         assert engine_cap is None or self.max_batch <= engine_cap, (
@@ -153,6 +164,7 @@ class MicroBatcher:
 
             registry = MetricsRegistry()
         self.registry = registry
+        self._name = name
         p = name
         self._m_depth = registry.gauge(
             f"{p}_queue_depth_rows", "request rows waiting in the batcher queue"
@@ -167,7 +179,9 @@ class MicroBatcher:
             f"{p}_cancelled_total", "requests cancelled before execution"
         )
         self._m_errors = registry.counter(
-            f"{p}_engine_errors_total", "batches failed by an engine exception"
+            f"{p}_engine_errors_total",
+            "generation dispatches (flushed batches / slot chunks) failed "
+            "by an engine exception",
         )
         self._m_requests = registry.counter(
             f"{p}_requests_total", "requests accepted into the queue"
@@ -175,6 +189,24 @@ class MicroBatcher:
         self._m_images = registry.counter(
             f"{p}_images_total", "images generated (batch rows completed)"
         )
+        self._m_latency = registry.histogram(
+            f"{p}_request_latency_seconds",
+            "enqueue-to-result latency per request",
+        )
+
+        self._post_init()  # batching-mode instruments + subclass state must
+        self._worker = threading.Thread(  # exist before the worker runs
+            target=self._run, name=f"{name}-batcher", daemon=True
+        )
+        self._worker.start()
+
+    def _post_init(self) -> None:
+        """Register the flush-path instruments. `ContinuousBatcher`
+        overrides this with its slot-path instruments instead, so a
+        continuous server's /metrics never exposes permanently-empty
+        micro-batch series (an occupancy dashboard reading them would see
+        'no batches ever flushed' against a busy server)."""
+        registry, p = self.registry, self._name
         self._m_batches = registry.counter(
             f"{p}_batches_total", "micro-batches flushed to the engine"
         )
@@ -185,18 +217,23 @@ class MicroBatcher:
             "real (unpadded) rows per flushed micro-batch",
             buckets=tuple(float(b) for b in range(1, min(self.max_batch, 32) + 1)),
         )
-        self._m_latency = registry.histogram(
-            f"{p}_request_latency_seconds",
-            "enqueue-to-result latency per request",
-        )
         self._m_batch_seconds = registry.histogram(
             f"{p}_batch_seconds", "engine wall time per flushed micro-batch"
         )
-
-        self._worker = threading.Thread(
-            target=self._run, name=f"{name}-batcher", daemon=True
+        # per-compiled-shape series: which rung served the batch and how
+        # long it took there — occupancy-vs-shape is the padding-waste
+        # dashboard (ROADMAP "/metrics per-shape occupancy labels")
+        self._m_occupancy_by_shape = registry.histogram_family(
+            f"{p}_batch_occupancy_rows_by_shape",
+            "real rows per flushed micro-batch, by compiled batch shape",
+            label_name="shape",
+            buckets=tuple(float(b) for b in range(1, min(self.max_batch, 32) + 1)),
         )
-        self._worker.start()
+        self._m_batch_seconds_by_shape = registry.histogram_family(
+            f"{p}_batch_seconds_by_shape",
+            "engine wall time per flushed micro-batch, by compiled batch shape",
+            label_name="shape",
+        )
 
     # -------------------------------------------------------------- intake
 
@@ -246,11 +283,12 @@ class MicroBatcher:
 
     # -------------------------------------------------------------- worker
 
-    def _pop_ready(self, batch: List[GenRequest]) -> None:
-        """Move queued requests into `batch` (capacity permitting), failing
-        expired ones and skipping cancelled ones. Caller holds the lock."""
-        now = time.monotonic()
-        rows = sum(r.rows for r in batch)
+    def _viable_head(self, now: float) -> Optional[GenRequest]:
+        """First admissible queued request, WITHOUT popping it — failing
+        expired and skipping cancelled ones from the front on the way.
+        Caller holds the lock. Shared by the micro-batch assembler and the
+        continuous admission loop so timeout/cancel bookkeeping cannot
+        drift between the two batchers."""
         while self._pending:
             head = self._pending[0]
             if head.cancelled:
@@ -269,7 +307,17 @@ class MicroBatcher:
                     )
                 )
                 continue
-            if rows + head.rows > self.max_batch:
+            return head
+        return None
+
+    def _pop_ready(self, batch: List[GenRequest]) -> None:
+        """Move queued requests into `batch` (capacity permitting), failing
+        expired ones and skipping cancelled ones. Caller holds the lock."""
+        now = time.monotonic()
+        rows = sum(r.rows for r in batch)
+        while True:
+            head = self._viable_head(now)
+            if head is None or rows + head.rows > self.max_batch:
                 break
             self._pending.popleft()
             self._pending_rows -= head.rows
@@ -284,7 +332,10 @@ class MicroBatcher:
             while not self._pending:
                 if self._closed:
                     return None
-                self._cond.wait(timeout=0.05)
+                # empty queue: park until submit/shutdown notifies — an
+                # idle server burns no CPU. The timed 0.05s waits below
+                # apply only while a flush deadline is pending.
+                self._cond.wait()
             batch: List[GenRequest] = []
             self._pop_ready(batch)
             if not batch:  # everything queued was expired/cancelled
@@ -328,7 +379,12 @@ class MicroBatcher:
         # stay mutually consistent (failures are engine_errors_total)
         self._m_batches.inc()
         self._m_occupancy.observe(len(specs))
-        self._m_batch_seconds.observe(time.monotonic() - t0)
+        batch_s = time.monotonic() - t0
+        self._m_batch_seconds.observe(batch_s)
+        pick = getattr(self.engine, "pick_shape", None)
+        shape = pick(len(specs)) if pick is not None else len(specs)
+        self._m_occupancy_by_shape.labels(shape).observe(len(specs))
+        self._m_batch_seconds_by_shape.labels(shape).observe(batch_s)
         offset = 0
         now = time.monotonic()
         for req in batch:
@@ -337,6 +393,7 @@ class MicroBatcher:
             offset += req.rows
             self._m_images.inc(req.rows)
             self._m_latency.observe(now - req.enqueued_at)
+            req.first_token_at = now
             req.future.set_result((toks, pix))
 
     # ------------------------------------------------------------ shutdown
@@ -356,3 +413,195 @@ class MicroBatcher:
                 self._m_depth.set(0)
             self._cond.notify_all()
         self._worker.join(timeout=timeout)
+
+
+class ContinuousBatcher(MicroBatcher):
+    """Token-boundary admission over a `ContinuousEngine`'s cache slots.
+
+    Same queue/backpressure surface as `MicroBatcher` (submit / timeout /
+    cancel / drain semantics, same instrument names), but the worker never
+    assembles flush batches: it runs a persistent loop of
+
+        admit   — pop queued requests into free cache slots (one prefill
+                  dispatch per row; a request's rows admit all-or-nothing
+                  so its images stay one retirement unit),
+        chunk   — advance every live slot by `engine.chunk_tokens` tokens
+                  in one fixed-shape dispatch,
+        retire  — at the chunk boundary, harvest rows that completed
+                  `image_seq_len` tokens, resolve their requests, and free
+                  the slots for the next admission
+
+    so a request arriving mid-decode waits at most one chunk for admission
+    instead of a whole `image_seq_len` scan, and batch occupancy backfills
+    while other rows are still decoding. Extra observability: per-request
+    time-to-first-token histogram, chunk wall-time histogram, and the
+    engine's `dalle_serving_slots_active` gauge.
+    """
+
+    def __init__(
+        self,
+        engine,
+        max_queue_rows: int = 64,
+        registry=None,
+        name: str = "dalle_serving",
+    ):
+        """`engine` needs the slot surface of `ContinuousEngine`
+        (`prefill_slot` / `step_chunk` / `harvest` / `release` /
+        `decode_pixels` / `image_seq_len` / `max_batch`) — the tests drive
+        a fake with exactly that surface."""
+        super().__init__(
+            engine,
+            max_queue_rows=max_queue_rows,
+            registry=registry,
+            name=name,
+        )
+
+    def _post_init(self) -> None:
+        from dalle_pytorch_tpu.serving.engine import SlotAllocator
+
+        self.allocator = SlotAllocator(self.max_batch)
+        p = self._name
+        self._m_ttft = self.registry.histogram(
+            f"{p}_ttft_seconds",
+            "enqueue-to-first-token latency per request (chunk-boundary "
+            "granularity)",
+        )
+        self._m_chunk_seconds = self.registry.histogram(
+            f"{p}_chunk_seconds", "engine wall time per decode chunk"
+        )
+        self._m_admitted = self.registry.counter(
+            f"{p}_admitted_total", "rows admitted into cache slots"
+        )
+
+    # ------------------------------------------------------------- worker
+
+    def _run(self) -> None:
+        inflight: dict = {}  # slot -> (request, row index within request)
+        partial: dict = {}  # request -> {"tokens": [rows], "remaining": n}
+        while True:
+            admitted: List = []  # (slot, spec) prefills owed this iteration
+            with self._cond:
+                while True:
+                    head = self._viable_head(time.monotonic())
+                    self._m_depth.set(self._pending_rows)
+                    if head is not None or inflight:
+                        break
+                    if self._closed:
+                        return
+                    # idle: no queued work, no live slots — park until
+                    # submit/shutdown notifies (no busy-poll)
+                    self._cond.wait()
+                # all-or-nothing admission in arrival order (no starvation:
+                # a wide request blocks later narrow ones until slots free)
+                while head is not None and self.allocator.n_free >= head.rows:
+                    self._pending.popleft()
+                    self._pending_rows -= head.rows
+                    partial[head] = {
+                        "tokens": [None] * head.rows,
+                        "remaining": head.rows,
+                    }
+                    for i, spec in enumerate(head.specs):
+                        slot = self.allocator.alloc()
+                        inflight[slot] = (head, i)
+                        admitted.append((slot, spec))
+                    self._m_admitted.inc(head.rows)
+                    head = self._viable_head(time.monotonic())
+                self._m_depth.set(self._pending_rows)
+
+            try:
+                for slot, spec in admitted:
+                    self.engine.prefill_slot(slot, spec)
+                t0 = time.monotonic()
+                img_pos, _active = self.engine.step_chunk()
+                self._m_chunk_seconds.observe(time.monotonic() - t0)
+
+                now = time.monotonic()
+                finished = []
+                for slot, (req, _idx) in inflight.items():
+                    if req.first_token_at is None and img_pos[slot] > 0:
+                        req.first_token_at = now
+                        self._m_ttft.observe(now - req.enqueued_at)
+                    if img_pos[slot] >= self.engine.image_seq_len:
+                        finished.append(slot)
+                if finished:
+                    # harvest/release are engine dispatches too — a failure
+                    # here must fail fast like the chunk path, not kill the
+                    # worker thread (which would leave the server accepting
+                    # requests nobody will ever serve)
+                    self._retire(finished, inflight, partial)
+            except Exception as exc:  # fail fast: every live request errors
+                self._fail_all(exc, inflight, partial)
+                continue
+            self._set_slots_gauge()
+
+    def _fail_all(self, exc, inflight, partial) -> None:
+        """Engine failure: error every live request, free every slot, and
+        best-effort reset the engine so the next admission starts clean."""
+        self._last_error_at = time.monotonic()
+        self.last_error = exc
+        self._m_errors.inc()
+        for req in partial:
+            req.future.set_exception(exc)
+        for slot in list(inflight):
+            self.allocator.free(slot)
+        inflight.clear()
+        partial.clear()
+        try:  # engine may be wedged; slot release is best-effort
+            self.engine.release(range(self.max_batch))
+        except Exception:
+            pass
+        self._set_slots_gauge()
+
+    def _retire(self, finished, inflight, partial) -> None:
+        """Harvest finished slots, resolve fully-collected requests, free
+        the slots for the next admission wave."""
+        tokens = self.engine.harvest(finished)
+        self.engine.release(finished)
+        done: List = []  # (request, stacked rows) completed this boundary
+        for slot, row in zip(finished, tokens):
+            req, idx = inflight.pop(slot)
+            self.allocator.free(slot)
+            info = partial[req]
+            info["tokens"][idx] = row
+            info["remaining"] -= 1
+            if info["remaining"] == 0:
+                del partial[req]
+                done.append((req, np.stack(info["tokens"])))
+        if not done:
+            return
+        # ONE pixel-decode dispatch for every request completing at this
+        # boundary (the engine pads to its fixed decode shape internally);
+        # per-request decodes would cost a dispatch each — the overhead the
+        # micro-batch engine avoids by fusing decode into the sampler
+        now = time.monotonic()
+        try:
+            all_pixels = self.engine.decode_pixels(
+                np.concatenate([toks for _, toks in done])
+            )
+        except Exception as exc:
+            # an engine dispatch failure like any other: record it so
+            # /healthz goes unhealthy and engine_errors_total moves —
+            # but only the completing requests are lost; rows still
+            # decoding are untouched
+            self._last_error_at = time.monotonic()
+            self.last_error = exc
+            self._m_errors.inc()
+            for req, _ in done:
+                req.future.set_exception(exc)
+            return
+        offset = 0
+        for req, toks in done:
+            pix = (
+                None if all_pixels is None
+                else all_pixels[offset : offset + req.rows]
+            )
+            offset += req.rows
+            self._m_images.inc(req.rows)
+            self._m_latency.observe(now - req.enqueued_at)
+            req.future.set_result((toks, pix))
+            self.last_error = None  # a full request completed: healthy
+
+    def _set_slots_gauge(self) -> None:
+        gauge = getattr(self.engine, "slots_active_gauge", None)
+        if gauge is not None:
+            gauge(self.allocator.n_active)

@@ -46,6 +46,9 @@ class EngineStats:
     batches: int = 0
     rows_generated: int = 0
     rows_padded: int = 0
+    # warmup dispatches count here ONLY (plus the compile hit/miss/seconds
+    # metrics) so traffic stats stay pure request accounting
+    warmup_batches: int = 0
 
 
 class GenerationEngine:
@@ -151,16 +154,21 @@ class GenerationEngine:
         return np.asarray(ids[0], dtype=np.int32)
 
     def warmup(self, shapes: Optional[Sequence[int]] = None) -> None:
-        """Compile every batch rung up front (one dummy batch each)."""
+        """Compile every batch rung up front (one dummy batch each).
+
+        Warmup dispatches are tagged so they count only toward the compile
+        metrics (hits/misses/seconds) and `stats.warmup_batches` — never
+        toward `batches`/`rows_generated`/`rows_padded`, which dashboards
+        read as real traffic."""
         text_seq = self.model.text_seq_len
         for b in shapes or self.batch_shapes:
             dummy = [
                 SampleSpec(np.zeros(text_seq, np.int32), seed=i)
                 for i in range(b)
             ]
-            self.generate(dummy)
+            self.generate(dummy, _warmup=True)
 
-    def generate(self, specs: Sequence[SampleSpec]):
+    def generate(self, specs: Sequence[SampleSpec], _warmup: bool = False):
         """Run one micro-batch. Returns (tokens [n, image_seq_len] np.int32,
         pixels [n, H, W, 3] float in [0, 1] or None)."""
         import jax.numpy as jnp
@@ -205,9 +213,12 @@ class GenerationEngine:
                 self._compile_seconds.observe(time.perf_counter() - t0)
                 self._warm.add(shape)
                 self.stats.compiled_shapes = tuple(sorted(self._warm))
-            self.stats.batches += 1
-            self.stats.rows_generated += n
-            self.stats.rows_padded += pad
+            if _warmup:
+                self.stats.warmup_batches += 1
+            else:
+                self.stats.batches += 1
+                self.stats.rows_generated += n
+                self.stats.rows_padded += pad
 
         toks = toks[:n]
         if pixels is None and self.vae is not None:
@@ -263,19 +274,278 @@ class GenerationEngine:
         return np.asarray(sorted_imgs), np.asarray(scores), np.asarray(order)
 
 
+class SlotAllocator:
+    """Host-side allocator for the continuous engine's fixed cache slots.
+
+    Slots are just integers [0, n_slots); the decode program's batch rows.
+    `alloc` hands out the lowest free slot (deterministic, test-friendly)
+    and never aliases: a slot stays owned until `free`d. Exhaustion returns
+    None — the batcher keeps the request queued until a retirement frees a
+    slot. Not thread-safe by itself; the batcher worker is the only caller.
+    """
+
+    def __init__(self, n_slots: int):
+        assert n_slots >= 1
+        self.n_slots = int(n_slots)
+        self._free = sorted(range(self.n_slots), reverse=True)
+        self._in_use: set = set()
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._in_use.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        assert slot in self._in_use, f"slot {slot} is not allocated"
+        self._in_use.remove(slot)
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+
+    @property
+    def n_active(self) -> int:
+        return len(self._in_use)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+
+class ContinuousEngine(GenerationEngine):
+    """Continuous-batching decode: token-boundary admission over cache slots.
+
+    Where `GenerationEngine.generate` runs a whole `image_seq_len` decode
+    scan per micro-batch (a request arriving just after a flush waits an
+    entire pass for its first token), this engine keeps ONE persistent
+    decode state of `max_batch` cache slots and advances every live slot by
+    `chunk_tokens` per jitted dispatch. The batcher admits prompts into
+    free slots (one prefill dispatch each) and retires finished rows at
+    chunk boundaries, so occupancy backfills mid-flight and time-to-first-
+    token is bounded by ~one chunk instead of up to two full passes.
+
+    Fixed-shape discipline is preserved: exactly three compiled programs —
+    prefill (batch 1, slot index traced), chunk step (batch `max_batch`),
+    pixel decode (batch `max_batch`) — regardless of load. `chunk_tokens`
+    is the latency/throughput knob: smaller chunks admit and retire sooner
+    (lower TTFT) but pay more host round trips per image.
+
+    Classifier-free guidance is engine-wide OFF here (cond_scale=1): a
+    guided continuous batch needs a paired null-stream slot per row —
+    doubling the decode program — so guided serving stays on the
+    micro-batch engine for now.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables,
+        vae=None,
+        vae_params=None,
+        max_batch: int = 8,
+        chunk_tokens: int = 4,
+        cond_scale: float = 1.0,
+        clip=None,
+        clip_params=None,
+        tokenizer=None,
+        registry=None,
+        cfg=None,
+    ):
+        assert float(cond_scale) == 1.0, (
+            "ContinuousEngine does not support classifier-free guidance yet "
+            "(a per-slot null stream would double the decode program); use "
+            "the micro-batch GenerationEngine for cond_scale != 1"
+        )
+        assert int(chunk_tokens) >= 1
+        super().__init__(
+            model=model,
+            variables=variables,
+            vae=vae,
+            vae_params=vae_params,
+            batch_shapes=(int(max_batch),),
+            cond_scale=1.0,
+            clip=clip,
+            clip_params=clip_params,
+            tokenizer=tokenizer,
+            registry=registry,
+            cfg=cfg,
+        )
+        self.chunk_tokens = int(chunk_tokens)
+        from dalle_pytorch_tpu.models.dalle import init_slot_state
+
+        self._state = init_slot_state(model, self.max_batch)
+        self._m_slots = self.registry.gauge(
+            "dalle_serving_slots_active",
+            "continuous-engine cache slots currently decoding",
+        )
+        self._m_chunks = self.registry.counter(
+            "dalle_serving_chunks_total",
+            "decode chunk dispatches by the continuous engine",
+        )
+        self._m_prefills = self.registry.counter(
+            "dalle_serving_prefills_total",
+            "prompts prefilled into cache slots",
+        )
+        self._decode_pixels_jit = None
+
+    # --------------------------------------------------------- slot ops
+    # All device work is serialized under the inherited engine lock; the
+    # continuous batcher's single worker thread is the only caller.
+
+    def _replace_state(self, op) -> None:
+        """Run one state-transforming dispatch. The slot ops DONATE the
+        state buffers (models/dalle.py), so on failure the old state is
+        unusable — rebuild a clean empty one rather than bricking the
+        engine (the batcher fails the in-flight requests either way).
+        Caller holds the lock."""
+        from dalle_pytorch_tpu.models.dalle import init_slot_state
+
+        try:
+            self._state = op(self._state)
+        except BaseException:
+            self._state = init_slot_state(self.model, self.max_batch)
+            raise
+
+    def prefill_slot(
+        self, slot: int, spec: SampleSpec, _warmup: bool = False
+    ) -> None:
+        """Admit one prompt into `slot` (one fixed-shape dispatch)."""
+        from dalle_pytorch_tpu.models.dalle import prefill_into_slot
+
+        text = np.asarray(spec.text_ids, np.int32)[None]
+        assert text.shape == (1, self.model.text_seq_len)
+        with self._lock:
+            self._replace_state(lambda s: prefill_into_slot(
+                self.model, self.variables, s, text,
+                slot, int(spec.seed) & 0x7FFFFFFF,
+                float(spec.temperature), self._keep_k(spec.top_k),
+            ))
+            if not _warmup:
+                self._m_prefills.inc()
+
+    def step_chunk(self, _warmup: bool = False):
+        """Advance all live slots by `chunk_tokens`; returns the post-chunk
+        (img_pos, active) host snapshot the batcher retires against."""
+        from dalle_pytorch_tpu.models.dalle import decode_image_chunk
+
+        with self._lock:
+            self._replace_state(lambda s: decode_image_chunk(
+                self.model, self.variables, s, self.chunk_tokens
+            ))
+            if not _warmup:
+                self._m_chunks.inc()
+                self.stats.batches += 1
+            return (
+                np.asarray(self._state["img_pos"]),
+                np.asarray(self._state["active"]),
+            )
+
+    def harvest(self, slots: Sequence[int]) -> np.ndarray:
+        """Finished slots' tokens [len(slots), image_seq_len] (host copy)."""
+        with self._lock:
+            toks = np.asarray(self._state["img_tokens"])
+            self.stats.rows_generated += len(list(slots))
+        return toks[list(slots)].astype(np.int32)
+
+    def release(self, slots: Sequence[int]) -> None:
+        """Deactivate `slots` so the chunk step stops touching them — after
+        harvest, or wholesale on an error reset (which must not count
+        toward `rows_generated`; only harvests do)."""
+        from dalle_pytorch_tpu.models.dalle import release_slots
+
+        mask = np.zeros(self.max_batch, bool)
+        mask[list(slots)] = True
+        with self._lock:
+            self._replace_state(
+                lambda s: release_slots(self.model, s, mask)
+            )
+
+    def decode_pixels(self, tokens: np.ndarray) -> Optional[np.ndarray]:
+        """Pixels [n, H, W, 3] in [0, 1] for harvested token rows, via ONE
+        compiled shape (pad to max_batch, slice) — or None without a VAE."""
+        if self.vae is None:
+            return None
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        n = len(tokens)
+        if not isinstance(self.vae, DiscreteVAE):
+            return np.clip(np.asarray(self.vae.decode(tokens)), 0.0, 1.0)
+        import jax
+        import jax.numpy as jnp
+
+        if self._decode_pixels_jit is None:
+            vae, vae_params = self.vae, self.vae_params
+            self._decode_pixels_jit = jax.jit(
+                lambda t: vae.apply(
+                    {"params": vae_params}, t, method=DiscreteVAE.decode
+                )
+            )
+        pad = self.max_batch - (n % self.max_batch or self.max_batch)
+        padded = np.concatenate(
+            [tokens, np.zeros((pad, tokens.shape[1]), np.int32)]
+        )
+        outs = []
+        with self._lock:
+            for i in range(0, len(padded), self.max_batch):
+                outs.append(
+                    np.asarray(
+                        self._decode_pixels_jit(
+                            jnp.asarray(padded[i : i + self.max_batch])
+                        )
+                    )
+                )
+        pixels = np.concatenate(outs)[:n] * 0.5 + 0.5
+        return np.clip(pixels, 0.0, 1.0)
+
+    def slots_active_gauge(self, n: int) -> None:
+        self._m_slots.set(n)
+
+    # ----------------------------------------------------------- warmup
+
+    def warmup(self, shapes: Optional[Sequence[int]] = None) -> None:
+        """Compile the three fixed-shape programs (prefill, chunk, pixel
+        decode) with dummy traffic, then reset the slot state. Counts only
+        toward compile metrics + `stats.warmup_batches` (same tagging
+        contract as the micro-batch engine)."""
+        from dalle_pytorch_tpu.models.dalle import init_slot_state
+
+        t0 = time.perf_counter()
+        dummy = SampleSpec(
+            np.zeros(self.model.text_seq_len, np.int32), seed=0
+        )
+        self._compile_miss.inc()
+        self.prefill_slot(0, dummy, _warmup=True)
+        self.step_chunk(_warmup=True)
+        self.decode_pixels(
+            np.zeros((1, self.image_seq_len), np.int32)
+        )
+        with self._lock:
+            self._state = init_slot_state(self.model, self.max_batch)
+            self.stats.warmup_batches += 1
+            self._compile_seconds.observe(time.perf_counter() - t0)
+            self._warm.add(self.max_batch)
+            self.stats.compiled_shapes = tuple(sorted(self._warm))
+
+
 def engine_from_checkpoint(
     dalle_path: str,
     clip_path: Optional[str] = None,
     batch_shapes: Sequence[int] = (1, 4, 8),
     cond_scale: float = 1.0,
     registry=None,
+    mode: str = "micro",
+    chunk_tokens: int = 4,
 ):
-    """Build a `GenerationEngine` from a single-file DALLE checkpoint.
+    """Build a serving engine from a single-file DALLE checkpoint.
 
-    The loading sequence (VAE reconstruction, tokenizer, ring-attention
-    downgrade for decode) was lifted from `generate.py`, which now calls
-    this instead — CLI and server share one code path by construction.
+    `mode="micro"` (default) returns the padded-micro-batch
+    `GenerationEngine`; `mode="continuous"` returns a `ContinuousEngine`
+    whose slot count is the largest entry of `batch_shapes`. The loading
+    sequence (VAE reconstruction, tokenizer, ring-attention downgrade for
+    decode) was lifted from `generate.py`, which now calls this instead —
+    CLI and server share one code path by construction.
     """
+    assert mode in ("micro", "continuous"), f"unknown engine mode {mode!r}"
     from pathlib import Path
 
     from dalle_pytorch_tpu.training.pipeline import (
@@ -316,12 +586,11 @@ def engine_from_checkpoint(
 
         clip, clip_params = load_clip_checkpoint(clip_path)
 
-    return GenerationEngine(
+    common = dict(
         model=model,
         variables={"params": dalle_params},
         vae=vae,
         vae_params=vae_params,
-        batch_shapes=batch_shapes,
         cond_scale=cond_scale,
         clip=clip,
         clip_params=clip_params,
@@ -329,3 +598,10 @@ def engine_from_checkpoint(
         registry=registry,
         cfg=cfg,
     )
+    if mode == "continuous":
+        return ContinuousEngine(
+            max_batch=max(int(b) for b in batch_shapes),
+            chunk_tokens=chunk_tokens,
+            **common,
+        )
+    return GenerationEngine(batch_shapes=batch_shapes, **common)

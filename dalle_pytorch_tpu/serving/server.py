@@ -36,12 +36,17 @@ from typing import Optional
 import numpy as np
 
 from dalle_pytorch_tpu.serving.batcher import (
+    ContinuousBatcher,
     MicroBatcher,
     QueueFullError,
     RequestTimeout,
     ShuttingDownError,
 )
-from dalle_pytorch_tpu.serving.engine import GenerationEngine, SampleSpec
+from dalle_pytorch_tpu.serving.engine import (
+    ContinuousEngine,
+    GenerationEngine,
+    SampleSpec,
+)
 
 MAX_BODY_BYTES = 1 << 20  # prompts are tiny; reject anything bigger
 
@@ -250,12 +255,21 @@ class ServingServer:
         self.registry = engine.registry
         self.request_timeout_s = float(request_timeout_s)
         self.verbose = verbose
-        self.batcher = MicroBatcher(
-            engine,
-            max_delay_ms=max_delay_ms,
-            max_queue_rows=max_queue_rows,
-            registry=self.registry,
-        )
+        if isinstance(engine, ContinuousEngine):
+            # token-boundary admission: max_delay_ms does not apply (there
+            # is no flush deadline; admission happens at chunk boundaries)
+            self.batcher = ContinuousBatcher(
+                engine,
+                max_queue_rows=max_queue_rows,
+                registry=self.registry,
+            )
+        else:
+            self.batcher = MicroBatcher(
+                engine,
+                max_delay_ms=max_delay_ms,
+                max_queue_rows=max_queue_rows,
+                registry=self.registry,
+            )
         try:
             self._httpd = _Server((host, port), self)
         except OSError:
@@ -303,6 +317,10 @@ class ServingServer:
             "compiled_shapes": list(self.engine.stats.compiled_shapes),
             "batch_shapes": list(self.engine.batch_shapes),
         }
+        if isinstance(self.batcher, ContinuousBatcher):
+            detail["engine"] = "continuous"
+            detail["slots_active"] = self.batcher.allocator.n_active
+            detail["chunk_tokens"] = self.engine.chunk_tokens
         if err is not None:
             detail["last_error"] = repr(err)
             if err_age is not None:

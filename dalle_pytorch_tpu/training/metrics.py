@@ -178,6 +178,70 @@ class Histogram:
         return lines
 
 
+class Family:
+    """Labeled instrument family: one metric name, one label, N children.
+
+    Minimal Prometheus label support for the serving layer (per-compiled-
+    shape occupancy/batch-seconds series): `labels(value)` get-or-creates a
+    child instrument, and `render()` emits ONE HELP/TYPE header followed by
+    every child's samples tagged `{label_name="value"}` — the exposition
+    shape scrapers expect for labeled series. Children are full instruments
+    (Counter/Gauge/Histogram), so observation is lock-protected as usual;
+    labeled histograms skip the convenience p50/p95 gauges (Prometheus
+    computes quantiles from the buckets server-side).
+    """
+
+    def __init__(self, cls, name: str, help: str, label_name: str, **kw):
+        self.cls, self.name, self.help = cls, name, help
+        self.label_name = label_name
+        self._kw = kw
+        self._children: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, value) -> object:
+        key = str(value)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self.cls(self.name, self.help, **self._kw)
+                child._label_suffix = f'{self.label_name}="{key}"'
+                self._children[key] = child
+            return child
+
+    def render(self) -> List[str]:
+        with self._lock:
+            children = sorted(self._children.items())
+        type_name = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}[
+            self.cls
+        ]
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {type_name}",
+        ]
+        for _, child in children:
+            lines.extend(_render_samples(child))
+        return lines
+
+
+def _render_samples(inst) -> List[str]:
+    """Sample lines of an instrument with its family label spliced in."""
+    label = getattr(inst, "_label_suffix", "")
+    out = []
+    for line in inst.render():
+        if line.startswith("#"):
+            continue  # family emits HELP/TYPE once
+        name, value = line.split(" ", 1)
+        if "_p50" in name or "_p95" in name:
+            continue  # reservoir quantiles stay on unlabeled instruments
+        if "{" in name:  # histogram bucket: merge labels
+            base, rest = name.split("{", 1)
+            name = f"{base}{{{label},{rest}" if label else name
+        elif label:
+            name = f"{name}{{{label}}}"
+        out.append(f"{name} {value}")
+    return out
+
+
 class MetricsRegistry:
     """Named instrument registry rendering Prometheus text exposition.
 
@@ -211,6 +275,22 @@ class MetricsRegistry:
         buckets: Sequence[float] = _DEFAULT_BUCKETS,
     ) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def histogram_family(
+        self, name: str, help: str = "", label_name: str = "shape",
+        buckets: Sequence[float] = _DEFAULT_BUCKETS,
+    ) -> Family:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = Family(
+                    Histogram, name, help, label_name, buckets=buckets
+                )
+                self._instruments[name] = inst
+            assert isinstance(inst, Family) and inst.cls is Histogram, (
+                f"metric {name!r} already registered as {type(inst).__name__}"
+            )
+            return inst
 
     def get(self, name: str):
         return self._instruments.get(name)

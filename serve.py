@@ -33,6 +33,17 @@ def parse_args(argv=None):
     )
     p.add_argument("--max_delay_ms", type=float, default=25.0,
                    help="micro-batch flush deadline from the oldest request")
+    p.add_argument(
+        "--engine", choices=("micro", "continuous"), default="micro",
+        help="micro: padded micro-batches, one full decode scan per flush; "
+        "continuous: token-boundary admission over cache slots (lower "
+        "time-to-first-token under load; slot count = max of "
+        "--batch_shapes; cond_scale must be 1)",
+    )
+    p.add_argument("--chunk_tokens", type=int, default=4,
+                   help="continuous engine: tokens decoded per chunk "
+                   "dispatch (smaller = faster admission/retirement, more "
+                   "host round trips)")
     p.add_argument("--max_queue", type=int, default=64,
                    help="queue bound in rows; beyond it requests get 503")
     p.add_argument("--request_timeout_s", type=float, default=120.0)
@@ -60,6 +71,8 @@ def main(argv=None):
         clip_path=args.clip_path,
         batch_shapes=batch_shapes,
         cond_scale=args.cond_scale,
+        mode=args.engine,
+        chunk_tokens=args.chunk_tokens,
     )
     if not args.no_warmup:
         print(f"[serve] warming up batch shapes {engine.batch_shapes} ...",
@@ -105,8 +118,9 @@ def main(argv=None):
 
     # parseable readiness line: tests and orchestrators wait for it
     print(f"[serve] listening on http://{args.host}:{server.port} "
-          f"(shapes={engine.batch_shapes}, max_delay_ms={args.max_delay_ms}, "
-          f"max_queue={args.max_queue})", flush=True)
+          f"(engine={args.engine}, shapes={engine.batch_shapes}, "
+          f"max_delay_ms={args.max_delay_ms}, max_queue={args.max_queue})",
+          flush=True)
     server.serve_forever()
     stopped.wait(timeout=60)  # let the drain finish before exiting
     print("[serve] shutdown complete", flush=True)

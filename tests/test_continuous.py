@@ -1,0 +1,472 @@
+"""Continuous batching: slot allocator, decode-composition invariance,
+admission batcher, and the HTTP front end over a `ContinuousEngine`.
+
+The load-bearing contract is DECODE-COMPOSITION INVARIANCE: a request's
+tokens are bit-identical whether served alone, inside a padded micro-batch,
+or admitted mid-flight into a running continuous batch. It holds because
+every per-row quantity — cache index, token-shift ring position, RNG key
+(seed, image position), temperature/top-k — is threaded per slot, and the
+per-row numerics of the chunked decode match the lockstep scan exactly
+(`ops/sampling.py:per_row_step_keys` is the single RNG derivation for
+both). These tests pin it for the unrolled executor with token-shift rings
+(the per-row ring path), the scan executor (depth-stacked per-row cache),
+and the non-rotary axial positional table (per-row row lookup).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.models.dalle import DALLE
+from dalle_pytorch_tpu.serving.batcher import ContinuousBatcher
+from dalle_pytorch_tpu.serving.engine import (
+    ContinuousEngine,
+    GenerationEngine,
+    SampleSpec,
+    SlotAllocator,
+)
+from dalle_pytorch_tpu.serving.server import ServingServer
+from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+TEXT_SEQ = 8
+FMAP = 4
+IMG_SEQ = FMAP * FMAP
+
+
+def _build(batch_shapes=(1, 4), max_batch=4, chunk_tokens=4, **model_kw):
+    """(micro engine, continuous engine) over ONE set of toy weights."""
+    kw = dict(
+        dim=32, depth=2, heads=2, dim_head=8,
+        num_image_tokens=32, image_fmap_size=FMAP,
+        num_text_tokens=64, text_seq_len=TEXT_SEQ,
+        shift_tokens=True, rotary_emb=True,
+    )
+    kw.update(model_kw)
+    model = DALLE(**kw)
+    text = jnp.zeros((1, TEXT_SEQ), jnp.int32)
+    toks = jnp.zeros((1, IMG_SEQ), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(42), text, toks)
+    micro = GenerationEngine(
+        model=model, variables=params, batch_shapes=batch_shapes,
+        registry=MetricsRegistry(),
+    )
+    cont = ContinuousEngine(
+        model=model, variables=params, max_batch=max_batch,
+        chunk_tokens=chunk_tokens, registry=MetricsRegistry(),
+    )
+    return micro, cont
+
+
+def spec(seed, temperature=1.0, top_k=0.9):
+    ids = np.zeros(TEXT_SEQ, np.int32)
+    ids[:3] = (5, 6, 7)
+    return SampleSpec(ids, seed=seed, temperature=temperature, top_k=top_k)
+
+
+def _drain(cont, max_chunks=32):
+    """Chunk until every active slot finishes; returns (img_pos, active)."""
+    for _ in range(max_chunks):
+        pos, act = cont.step_chunk()
+        if (pos[act] >= cont.image_seq_len).all():
+            return pos, act
+    raise AssertionError("continuous decode never finished")
+
+
+# ---------------------------------------------------------- slot allocator
+
+
+class TestSlotAllocator:
+    def test_exhaustion_returns_none(self):
+        a = SlotAllocator(2)
+        assert a.alloc() is not None
+        assert a.alloc() is not None
+        assert a.alloc() is None  # exhausted -> caller keeps request queued
+        assert a.n_free == 0 and a.n_active == 2
+
+    def test_retire_then_reuse(self):
+        a = SlotAllocator(2)
+        s0, s1 = a.alloc(), a.alloc()
+        a.free(s0)
+        assert a.n_free == 1
+        assert a.alloc() == s0  # lowest free slot comes back
+
+    def test_no_aliasing(self):
+        """A slot is never handed out twice while in use, across heavy
+        alloc/free churn."""
+        a = SlotAllocator(4)
+        live = set()
+        rng = np.random.default_rng(0)
+        for _ in range(200):
+            if live and rng.random() < 0.4:
+                s = live.pop()
+                a.free(s)
+            else:
+                s = a.alloc()
+                if s is None:
+                    assert len(live) == 4
+                    continue
+                assert s not in live, "allocator aliased a live slot"
+                live.add(s)
+        assert a.n_active == len(live)
+
+    def test_double_free_rejected(self):
+        a = SlotAllocator(1)
+        s = a.alloc()
+        a.free(s)
+        with pytest.raises(AssertionError):
+            a.free(s)
+
+
+# ------------------------------------- decode-composition invariance (core)
+
+
+@pytest.fixture(scope="module")
+def engines():
+    return _build()
+
+
+class TestDecodeCompositionInvariance:
+    def test_alone_vs_padded_vs_midflight(self, engines):
+        """The acceptance invariant: one request, three serving paths, one
+        bit pattern. Mid-flight admission happens while another slot is
+        half-way through its image."""
+        micro, cont = engines
+        alone, _ = micro.generate([spec(55)])
+        padded, _ = micro.generate([spec(99), spec(55), spec(7)])
+        np.testing.assert_array_equal(alone[0], padded[1])
+
+        cont.prefill_slot(0, spec(99))
+        cont.step_chunk()  # slot 0 is now mid-image
+        cont.prefill_slot(1, spec(55))  # admitted mid-flight
+        pos, act = _drain(cont)
+        assert act[:2].all() and (pos[:2] >= IMG_SEQ).all()
+        harvested = cont.harvest([0, 1])
+        cont.release([0, 1])
+        np.testing.assert_array_equal(harvested[1], alone[0])
+        np.testing.assert_array_equal(harvested[0], padded[0])
+
+    def test_slot_reuse_no_state_leak(self, engines):
+        """A retired slot's next occupant decodes the same tokens as a
+        fresh engine would — admission overwrites every cache position."""
+        micro, cont = engines
+        alone, _ = micro.generate([spec(123)])
+        cont.prefill_slot(2, spec(7))
+        _drain(cont)
+        cont.release([2])
+        cont.prefill_slot(2, spec(123))  # reuse the just-retired slot
+        _drain(cont)
+        toks = cont.harvest([2])
+        cont.release([2])
+        np.testing.assert_array_equal(toks[0], alone[0])
+
+    def test_per_row_params_mid_flight(self, engines):
+        """Per-slot temperature/top-k really are per slot: a greedy row
+        admitted next to a hot row reproduces the micro engine's greedy
+        output."""
+        micro, cont = engines
+        greedy = spec(3, temperature=1e-6, top_k=1.0)
+        alone, _ = micro.generate([greedy])
+        cont.prefill_slot(0, spec(9, temperature=1.0, top_k=0.0))
+        cont.step_chunk()
+        cont.prefill_slot(1, greedy)
+        _drain(cont)
+        toks = cont.harvest([0, 1])
+        cont.release([0, 1])
+        np.testing.assert_array_equal(toks[1], alone[0])
+
+
+class TestInvarianceAcrossExecutors:
+    def test_scan_executor(self):
+        """Per-row index rides the depth-stacked scan cache too."""
+        micro, cont = _build(executor="scan")
+        alone, _ = micro.generate([spec(55)])
+        cont.prefill_slot(3, spec(99))
+        cont.step_chunk()
+        cont.prefill_slot(0, spec(55))
+        _drain(cont)
+        toks = cont.harvest([0])
+        np.testing.assert_array_equal(toks[0], alone[0])
+
+    def test_non_rotary_axial_positions(self):
+        """Per-row lookup into the axial positional table."""
+        micro, cont = _build(rotary_emb=False, shift_tokens=False)
+        alone, _ = micro.generate([spec(55)])
+        cont.prefill_slot(1, spec(99))
+        cont.step_chunk()
+        cont.prefill_slot(2, spec(55))
+        _drain(cont)
+        toks = cont.harvest([2])
+        np.testing.assert_array_equal(toks[0], alone[0])
+
+
+# ------------------------------------------------------- engine-level misc
+
+
+class TestContinuousEngine:
+    def test_warmup_counts_compile_only(self):
+        _, cont = _build()
+        cont.warmup()
+        assert cont.stats.warmup_batches == 1
+        assert cont.stats.batches == 0
+        assert cont.stats.rows_generated == 0
+        assert cont.stats.compiled_shapes == (4,)
+        # post-warmup state is clean: no active slots, no positions
+        pos, act = cont.step_chunk(_warmup=True)
+        assert not act.any() and (pos == 0).all()
+
+    def test_cond_scale_rejected(self):
+        micro, _ = _build()
+        with pytest.raises(AssertionError, match="cond_scale"):
+            ContinuousEngine(
+                model=micro.model, variables=micro.variables,
+                max_batch=2, cond_scale=3.0,
+            )
+
+    def test_pixels_match_micro_engine(self):
+        """`decode_pixels` (pad-to-shape dVAE decode + un-normalize) must
+        produce the same pixels as the micro engine's fused decode for the
+        same request — including when the harvested row count does not
+        divide the decode shape."""
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        vae = DiscreteVAE(
+            image_size=16, num_layers=2, num_tokens=32,
+            codebook_dim=16, hidden_dim=16,
+        )
+        vae_params = vae.init(
+            {"params": jax.random.PRNGKey(0), "gumbel": jax.random.PRNGKey(1)},
+            jnp.zeros((1, 16, 16, 3)),
+        )["params"]
+        micro, cont = _build()
+        micro.vae = cont.vae = vae
+        micro.vae_params = cont.vae_params = vae_params
+        toks, pixels = micro.generate([spec(4), spec(5), spec(6)])
+        assert pixels.shape == (3, 16, 16, 3)
+        cont_pixels = cont.decode_pixels(toks)  # 3 rows through shape 4
+        np.testing.assert_allclose(cont_pixels, pixels, atol=1e-6)
+        # > max_batch rows: the padding loop wraps into two dispatches
+        toks6 = np.concatenate([toks, toks])
+        np.testing.assert_allclose(
+            cont.decode_pixels(toks6), np.concatenate([pixels, pixels]),
+            atol=1e-6,
+        )
+
+    def test_micro_warmup_tagged(self):
+        micro, _ = _build(batch_shapes=(1, 2))
+        micro.warmup()
+        assert micro.stats.warmup_batches == 2
+        assert micro.stats.batches == 0
+        assert micro.stats.rows_generated == 0
+        assert micro.stats.rows_padded == 0
+        micro.generate([spec(0)])
+        assert micro.stats.batches == 1
+        assert micro.stats.rows_generated == 1
+
+
+# ----------------------------------------------------- continuous batcher
+
+
+class FakeContinuousEngine:
+    """Slot-surface double for batcher policy tests: each chunk advances
+    every active slot by `chunk` positions; tokens carry the seed."""
+
+    image_seq_len = 8
+    max_batch = 4
+
+    def __init__(
+        self, chunk=4, fail_chunks=False, fail_release=False,
+        block_event=None,
+    ):
+        self.registry = MetricsRegistry()
+        self.chunk = chunk
+        self.fail_chunks = fail_chunks
+        self.fail_release = fail_release
+        self.block_event = block_event
+        self.pos = np.zeros(self.max_batch, np.int64)
+        self.active = np.zeros(self.max_batch, bool)
+        self.seeds = np.zeros(self.max_batch, np.int64)
+
+    def prefill_slot(self, slot, sp):
+        self.pos[slot] = 0
+        self.active[slot] = True
+        self.seeds[slot] = sp.seed
+
+    def step_chunk(self):
+        if self.block_event is not None:
+            assert self.block_event.wait(10.0)
+        if self.fail_chunks:
+            raise RuntimeError("XLA fell over")
+        live = self.active & (self.pos < self.image_seq_len)
+        self.pos[live] += self.chunk
+        return self.pos.copy(), self.active.copy()
+
+    def harvest(self, slots):
+        return np.stack([
+            np.full(self.image_seq_len, self.seeds[s], np.int32)
+            for s in slots
+        ])
+
+    def release(self, slots):
+        if self.fail_release:
+            raise RuntimeError("release blew up")
+        for s in slots:
+            self.active[s] = False
+
+    def decode_pixels(self, tokens):
+        return None
+
+    def slots_active_gauge(self, n):
+        self.registry.gauge("dalle_serving_slots_active").set(n)
+
+
+class TestContinuousBatcher:
+    def test_requests_complete_and_ttft_recorded(self):
+        eng = FakeContinuousEngine()
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        reqs = [b.submit([spec(i)]) for i in range(3)]
+        outs = [r.future.result(timeout=10) for r in reqs]
+        for i, (toks, pix) in enumerate(outs):
+            assert toks.shape == (1, 8) and int(toks[0, 0]) == i
+            assert pix is None
+        assert all(r.first_token_at is not None for r in reqs)
+        ttft = b.registry.get("dalle_serving_ttft_seconds")
+        assert ttft.count == 3
+        b.shutdown()
+        assert b.registry.get("dalle_serving_slots_active").value == 0
+
+    def test_multi_row_request_stays_whole(self):
+        eng = FakeContinuousEngine()
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        r = b.submit([spec(5), spec(6), spec(7)])
+        toks, _ = r.future.result(timeout=10)
+        assert [int(t[0]) for t in toks] == [5, 6, 7]
+        b.shutdown()
+
+    def test_backfill_more_requests_than_slots(self):
+        """8 single-row requests through 4 slots: retirements free slots
+        for queued requests without any flush barrier."""
+        eng = FakeContinuousEngine(chunk=2)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        reqs = [b.submit([spec(i)]) for i in range(8)]
+        for i, r in enumerate(reqs):
+            toks, _ = r.future.result(timeout=10)
+            assert int(toks[0, 0]) == i
+        assert b.registry.get("dalle_serving_admitted_total").value == 8
+        b.shutdown()
+
+    def test_engine_error_fails_fast(self):
+        eng = FakeContinuousEngine(fail_chunks=True)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        r = b.submit([spec(0)])
+        with pytest.raises(RuntimeError, match="XLA fell over"):
+            r.future.result(timeout=10)
+        assert isinstance(b.last_error, RuntimeError)
+        # recovery: the engine comes back, new requests succeed
+        eng.fail_chunks = False
+        eng.active[:] = False
+        r2 = b.submit([spec(1)])
+        toks, _ = r2.future.result(timeout=10)
+        assert int(toks[0, 0]) == 1
+        assert b.last_error is None
+        b.shutdown()
+
+    def test_retire_failure_does_not_kill_worker(self):
+        """harvest/release are engine dispatches too: a failure at the
+        retirement boundary must fail the live requests and leave the
+        worker alive (a dead worker would accept requests forever without
+        serving or timing them out)."""
+        eng = FakeContinuousEngine(fail_release=True)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        r = b.submit([spec(0)])
+        with pytest.raises(RuntimeError, match="release blew up"):
+            r.future.result(timeout=10)
+        assert isinstance(b.last_error, RuntimeError)
+        eng.fail_release = False  # transient; slot reuse re-prefills anyway
+        r2 = b.submit([spec(1)])
+        toks, _ = r2.future.result(timeout=10)
+        assert int(toks[0, 0]) == 1
+        assert b.last_error is None
+        b.shutdown()
+
+    def test_graceful_shutdown_drains(self):
+        gate = threading.Event()
+        eng = FakeContinuousEngine(block_event=gate)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        reqs = [b.submit([spec(i)]) for i in range(6)]
+        time.sleep(0.1)
+        gate.set()
+        b.shutdown(drain=True)
+        for i, r in enumerate(reqs):
+            toks, _ = r.future.result(timeout=1)
+            assert int(toks[0, 0]) == i
+
+    def test_real_engine_through_batcher_matches_alone(self, engines):
+        """End-to-end: tokens served through the admission loop equal the
+        micro engine's single-request output bit-for-bit."""
+        micro, _ = engines
+        _, cont = _build(max_batch=2, chunk_tokens=4)
+        alone, _ = micro.generate([spec(55)])
+        b = ContinuousBatcher(cont, registry=cont.registry)
+        reqs = [b.submit([spec(s)]) for s in (99, 55, 7)]
+        outs = [r.future.result(timeout=60) for r in reqs]
+        np.testing.assert_array_equal(outs[1][0][0], alone[0])
+        b.shutdown()
+
+
+# ------------------------------------------------------------- HTTP layer
+
+
+def _post(port, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestContinuousServing:
+    def test_server_over_continuous_engine(self):
+        from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+
+        _, cont = _build(max_batch=2, chunk_tokens=4)
+        cont.tokenizer = ByteTokenizer()
+        cont.warmup()
+        server = ServingServer(cont, port=0, request_timeout_s=60).start()
+        try:
+            port = server.port
+            body = {"prompt": "red circle", "seed": 77}
+            _, p1 = _post(port, body)
+            _, p2 = _post(port, body)
+            assert p1["tokens"] == p2["tokens"]
+            assert len(p1["tokens"][0]) == IMG_SEQ
+
+            status, health = _get(port, "/healthz")
+            health = json.loads(health)
+            assert status == 200 and health["status"] == "ok"
+            assert health["engine"] == "continuous"
+            assert health["slots_active"] == 0
+            assert health["chunk_tokens"] == 4
+
+            _, text = _get(port, "/metrics")
+            assert "dalle_serving_slots_active" in text
+            assert "dalle_serving_ttft_seconds_bucket" in text
+            assert "dalle_serving_chunks_total" in text
+        finally:
+            server.shutdown()

@@ -81,6 +81,28 @@ class TestMetricsRegistry:
         assert h.percentile(0.5) == 0.0
         assert h.mean() == 0.0
 
+    def test_histogram_family_labels(self):
+        """Labeled per-shape series: ONE HELP/TYPE header, child samples
+        tagged with the label, labels merged into bucket annotations."""
+        reg = MetricsRegistry()
+        fam = reg.histogram_family(
+            "occ_by_shape", "occupancy by shape", label_name="shape",
+            buckets=(1.0, 4.0),
+        )
+        fam.labels(4).observe(3)
+        fam.labels(8).observe(7)
+        fam.labels(4).observe(1)
+        out = reg.render()
+        assert out.count("# TYPE occ_by_shape histogram") == 1
+        assert 'occ_by_shape_bucket{shape="4",le="1"} 1' in out
+        assert 'occ_by_shape_bucket{shape="4",le="+Inf"} 2' in out
+        assert 'occ_by_shape_sum{shape="4"} 4' in out
+        assert 'occ_by_shape_count{shape="8"} 1' in out
+        # reservoir quantile gauges stay off labeled series
+        assert "occ_by_shape_p50" not in out
+        # same label -> same child instrument
+        assert fam.labels(4) is fam.labels("4")
+
 
 # ---------------------------------------------------------------- batcher
 
