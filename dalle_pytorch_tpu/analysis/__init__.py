@@ -1,0 +1,27 @@
+"""tracelint: JAX-aware static analysis for this package.
+
+Catches the hazard classes the serving/training stack's performance story
+depends on keeping out — recompilation (TL001), hidden host syncs (TL002),
+donated-buffer reuse (TL003), PRNG key reuse (TL004), dtype drift (TL005),
+and debugger artifacts (TL006) — before they ship. Run it with
+
+    python -m dalle_pytorch_tpu.analysis        # or: dalle-tpu-lint
+
+See analysis/README.md for the suppression syntax, the baseline workflow,
+and a guide to writing a rule.
+"""
+
+from dalle_pytorch_tpu.analysis.core import FileContext, Finding, LintResult, Rule
+from dalle_pytorch_tpu.analysis.lint import PACKAGE_DIR, lint_paths, main
+from dalle_pytorch_tpu.analysis.rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES",
+    "FileContext",
+    "Finding",
+    "LintResult",
+    "PACKAGE_DIR",
+    "Rule",
+    "lint_paths",
+    "main",
+]

@@ -1,0 +1,7 @@
+"""`python -m dalle_pytorch_tpu.analysis` entry point."""
+
+import sys
+
+from dalle_pytorch_tpu.analysis.lint import main
+
+sys.exit(main())

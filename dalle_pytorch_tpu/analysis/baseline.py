@@ -1,0 +1,84 @@
+"""Baseline handling: grandfather existing findings, fail only on NEW ones.
+
+The baseline is a checked-in JSON file of finding fingerprints
+(rule + path + normalized source line — line-number free, so edits above a
+grandfathered finding don't resurrect it). The shipped baseline is EMPTY
+(`analysis/baseline.json`): every hazard in the package is either fixed or
+carries an inline suppression with a reason. The file exists so the
+workflow generalizes — a repo adopting a new rule over a large surface can
+`--write-baseline` first and burn findings down over time without turning
+the linter off.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Dict, List, Set
+
+from dalle_pytorch_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+_FORMAT_VERSION = 1
+
+
+def load_baseline(path: Path) -> Set[str]:
+    """Fingerprints from `path`; empty set if the file doesn't exist."""
+    if not path.exists():
+        return set()
+    data = json.loads(path.read_text(encoding="utf-8"))
+    assert data.get("version") == _FORMAT_VERSION, (
+        f"baseline {path} has version {data.get('version')!r}; "
+        f"this linter reads version {_FORMAT_VERSION}"
+    )
+    return set(data.get("fingerprints", []))
+
+
+def occurrence_fingerprints(findings: List[Finding]):
+    """[(finding, fingerprint)] where duplicate (rule, path, snippet)
+    findings get an occurrence suffix (`abc123:1`, `:2`, ...) in line
+    order — so a NEW copy of an already-grandfathered line is still a new
+    finding, while pure line drift of existing ones stays matched."""
+    counts: Dict[str, int] = {}
+    out = []
+    for f in sorted(
+        findings, key=lambda f: (f.stable_path or f.path, f.line, f.rule)
+    ):
+        base = f.fingerprint()
+        k = counts.get(base, 0)
+        counts[base] = k + 1
+        out.append((f, base if k == 0 else f"{base}:{k}"))
+    return out
+
+
+def write_baseline(path: Path, findings: List[Finding]) -> None:
+    """Persist `findings` as the new grandfathered set (sorted for stable
+    diffs; `entries` is a human-readable mirror of the fingerprints)."""
+    entries = sorted(
+        (
+            {
+                "fingerprint": fp,
+                "rule": f.rule,
+                "path": f.stable_path or f.path,
+                "snippet": f.snippet.strip(),
+            }
+            for f, fp in occurrence_fingerprints(findings)
+        ),
+        key=lambda e: (e["path"], e["rule"], e["fingerprint"]),
+    )
+    payload = {
+        "version": _FORMAT_VERSION,
+        "fingerprints": [e["fingerprint"] for e in entries],
+        "entries": entries,
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+def split_baselined(findings: List[Finding], fingerprints: Set[str]):
+    """(new, grandfathered) partition of `findings`, occurrence-aware."""
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f, fp in occurrence_fingerprints(findings):
+        (old if fp in fingerprints else new).append(f)
+    return new, old

@@ -1,0 +1,229 @@
+"""tracelint core: findings, suppressions, per-file context, rule registry.
+
+The framework is deliberately stdlib-only (ast + re + dataclasses): the
+linter must be runnable in CI images and pre-commit hooks without paying a
+jax import, and must never execute the code it analyzes (the reference
+codebase's import-time-breakpoint regression, SURVEY.md §0, is exactly what
+happens when checking requires importing).
+
+Vocabulary
+----------
+Finding      one diagnosed hazard at a (path, line), carrying a rule code.
+Suppression  `# tracelint: disable=TL001[,TL002] -- <reason>` on the
+             offending line, or alone on the line directly above it. The
+             reason is MANDATORY: a suppression without one is itself a
+             finding (TL000) so silent opt-outs cannot accumulate.
+Hot loop     `# tracelint: hotloop` on (or directly above) a `def` marks a
+             host-side function as latency-critical: TL002 then treats any
+             device->host sync inside it as a finding needing justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterator, List, Optional, Tuple
+
+#: the rule code reserved for framework-level diagnoses (malformed
+#: suppressions); real rules use TL001..TL999.
+FRAMEWORK_CODE = "TL000"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tracelint:\s*disable=(?P<codes>TL\d{3}(?:\s*,\s*TL\d{3})*)"
+    r"(?:\s*--\s*(?P<reason>\S.*))?"
+)
+_HOTLOOP_RE = re.compile(r"#\s*tracelint:\s*hotloop\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # e.g. "TL001"
+    path: str  # display path (cwd-relative when possible; for humans)
+    line: int  # 1-indexed
+    message: str
+    snippet: str = ""
+    #: invocation-independent path (relative to the lint root the file was
+    #: found under) — fingerprints use THIS, so a baseline written from one
+    #: directory still matches when the linter runs from another
+    stable_path: str = ""
+
+    def fingerprint(self) -> str:
+        """Stable identity for baselining: rule + root-relative path +
+        normalized source line. No line number (edits above a grandfathered
+        finding don't resurrect it), no cwd dependence (the burn-down
+        workflow survives CI invoking from a different directory)."""
+        norm = " ".join(self.snippet.split())
+        raw = f"{self.rule}|{self.stable_path or self.path}|{norm}".encode()
+        return hashlib.sha1(raw).hexdigest()[:16]
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.snippet:
+            out += f"\n    {self.snippet.strip()}"
+        return out
+
+    def as_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "snippet": self.snippet.strip(),
+            "fingerprint": self.fingerprint(),
+        }
+
+
+@dataclass
+class Suppression:
+    line: int  # line the comment sits on
+    codes: Tuple[str, ...]
+    reason: Optional[str]
+    standalone: bool  # comment-only line: covers the NEXT line instead
+
+    @property
+    def covered_line(self) -> int:
+        return self.line + 1 if self.standalone else self.line
+
+
+class FileContext:
+    """Parsed view of one source file shared by every rule.
+
+    Parsing happens once here; rules receive the AST plus the suppression
+    and hot-loop maps, and must not re-read the file.
+    """
+
+    def __init__(
+        self,
+        path: Path,
+        display_path: str,
+        source: str,
+        stable_path: str = "",
+    ):
+        self.path = path
+        self.display_path = display_path
+        self.stable_path = stable_path or display_path
+        self.source = source
+        self.lines: List[str] = source.splitlines()
+        self.tree: ast.Module = ast.parse(source, filename=str(path))
+        self.suppressions: List[Suppression] = []
+        self.hotloop_lines: set = set()  # lines carrying a hotloop marker
+        self._scan_comments()
+
+    def _scan_comments(self) -> None:
+        # real COMMENT tokens only — a docstring describing the suppression
+        # syntax must not register as a suppression
+        import io
+        import tokenize
+
+        try:
+            tokens = list(tokenize.generate_tokens(io.StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError):
+            return  # the AST parsed, so this is unreachable in practice
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            i = tok.start[0]
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                codes = tuple(
+                    c.strip() for c in m.group("codes").split(",")
+                )
+                standalone = tok.line[: tok.start[1]].strip() == ""
+                self.suppressions.append(
+                    Suppression(i, codes, m.group("reason"), standalone)
+                )
+            if _HOTLOOP_RE.search(tok.string):
+                self.hotloop_lines.add(i)
+
+    # ------------------------------------------------------------- helpers
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule,
+            path=self.display_path,
+            line=line,
+            message=message,
+            snippet=self.snippet(line),
+            stable_path=self.stable_path,
+        )
+
+    def is_hotloop(self, func: ast.AST) -> bool:
+        """True if `func`'s def line (or the line above it / above its first
+        decorator) carries a `# tracelint: hotloop` marker."""
+        line = getattr(func, "lineno", None)
+        if line is None:
+            return False
+        candidates = {line, line - 1}
+        for dec in getattr(func, "decorator_list", []):
+            candidates.add(dec.lineno - 1)
+        return bool(candidates & self.hotloop_lines)
+
+    def suppressed(self, finding: Finding) -> Optional[Suppression]:
+        """The suppression covering `finding`, or None. Suppressions without
+        a reason never suppress — they surface as TL000 instead."""
+        for sup in self.suppressions:
+            if sup.covered_line != finding.line:
+                continue
+            if finding.rule in sup.codes and sup.reason:
+                return sup
+        return None
+
+    def malformed_suppressions(self) -> Iterator[Finding]:
+        for sup in self.suppressions:
+            if not sup.reason:
+                yield Finding(
+                    rule=FRAMEWORK_CODE,
+                    path=self.display_path,
+                    line=sup.line,
+                    message=(
+                        "suppression without a reason; write "
+                        "'# tracelint: disable=TLxxx -- <why this is safe>'"
+                    ),
+                    snippet=self.snippet(sup.line),
+                    stable_path=self.stable_path,
+                )
+
+
+class Rule:
+    """Base class for tracelint rules.
+
+    Subclasses set `code`/`name`/`description` and implement `check`,
+    yielding findings via `ctx.finding(self.code, node, message)`. Rules
+    must be pure functions of the FileContext (+ the package-wide
+    `DonationRegistry` passed by the driver): no filesystem access, no
+    imports of the analyzed code. See analysis/README.md for a worked
+    example of adding one.
+    """
+
+    code: str = ""
+    name: str = ""
+    description: str = ""
+    #: False makes the rule's findings immune to inline suppressions —
+    #: for gates with no legitimate exception (TL006: a debugger artifact
+    #: is never justified in shipped code; the old regex scan it replaced
+    #: had no opt-out either, and neither does this)
+    suppressible: bool = True
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[Finding, Suppression]] = field(default_factory=list)
+    baselined: List[Finding] = field(default_factory=list)
+    files_checked: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings

@@ -1,0 +1,397 @@
+"""JAX-awareness for tracelint: which functions run under tracing, which
+of their parameters are traced (vs static), and which callables donate
+which arguments.
+
+Everything here is a HEURISTIC over the AST — intraprocedural by design
+(ISSUE: arg-flow, not whole-program dataflow). The detectors cover the
+idioms this codebase actually uses:
+
+  traced functions
+    * `@jax.jit` / `@jit` / `@pjit` decorators, plain or via
+      `@functools.partial(jax.jit, static_argnums=..., static_argnames=...)`
+    * `g = jax.jit(f, ...)` rebinding a local def
+    * bodies passed to `jax.lax.scan` / `lax.scan` (first positional arg);
+      every parameter of a scan body is traced
+
+  donated callables (for TL003)
+    * `jax.jit(f, donate_argnums=(k,))` and the partial-decorator form
+    * this repo's jit-cache idiom: a builder function tagged with a
+      module-level `builder._donate_argnums = (k,)` assignment, dispatched
+      through `_jit_sample(builder, model, static_key, *args)` — donated
+      positional index among *args is k, i.e. call-site index 3 + k. A
+      public wrapper whose body just returns such a `_jit_sample` call
+      donates its own parameter at the matching position, so call sites in
+      OTHER files (the serving engine) inherit the donation contract.
+
+False-negative bias: when a construct is not recognized, the function is
+simply not traced/donating and rules stay silent — a lint must earn trust
+before it earns strictness.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+#: attribute accesses that are static under tracing even on a tracer
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+#: calls that are static under tracing regardless of their argument
+STATIC_CALLS = {
+    "len", "isinstance", "hasattr", "type", "getattr", "id", "repr",
+    "ndim", "shape", "result_type", "issubdtype", "format",
+}
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """`jax.lax.scan` -> "scan", `jit` -> "jit", else None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`jax.lax.scan` -> "jax.lax.scan" (None when any link isn't a name)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _int_elements(node: ast.AST) -> Tuple[int, ...]:
+    """Constant int / tuple-or-list of constant ints -> values; else ()."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+            else:
+                return ()
+        return tuple(out)
+    return ()
+
+
+def _str_elements(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+            else:
+                return ()
+        return tuple(out)
+    return ()
+
+
+def param_names(func: ast.AST) -> List[str]:
+    a = func.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+@dataclass
+class TracedInfo:
+    func: ast.AST  # FunctionDef / Lambda
+    kind: str  # "jit" | "scan"
+    static_params: FrozenSet[str] = frozenset()
+
+    def traced_params(self) -> Set[str]:
+        names = set(param_names(self.func))
+        if self.kind == "jit":
+            # `self`-style first params of decorated methods stay module
+            # references, not tracers
+            names.discard("self")
+        return names - set(self.static_params)
+
+
+def _statics_from_jit_call(call: ast.Call, func: ast.AST) -> FrozenSet[str]:
+    """static_argnums/static_argnames of a jit(...) call -> param names."""
+    names = param_names(func)
+    statics: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for i in _int_elements(kw.value):
+                if 0 <= i < len(names):
+                    statics.add(names[i])
+        elif kw.arg == "static_argnames":
+            statics.update(_str_elements(kw.value))
+    return frozenset(statics)
+
+
+def _donate_from_jit_call(
+    call: ast.Call, func: Optional[ast.AST] = None
+) -> Tuple[int, ...]:
+    """Donated positional indices of a jit(...) call. `donate_argnames`
+    resolves through `func`'s parameter list when the wrapped def/lambda is
+    known; without it names cannot map to positions and are dropped."""
+    out: List[int] = []
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            out.extend(_int_elements(kw.value))
+        elif kw.arg == "donate_argnames" and func is not None:
+            names = param_names(func)
+            out.extend(
+                names.index(n) for n in _str_elements(kw.value) if n in names
+            )
+    return tuple(out)
+
+
+class JaxIndex:
+    """Per-file index of traced functions, built once by the driver."""
+
+    def __init__(self, tree: ast.Module):
+        self.tree = tree
+        self.traced: Dict[ast.AST, TracedInfo] = {}
+        self._defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, FunctionNode):
+                # last def wins on name collision; fine for an index that
+                # only resolves scan bodies / jit rebinding heuristically
+                self._defs[node.name] = node
+        self._find_decorated()
+        self._find_rebound()
+        self._find_scan_bodies()
+
+    # ------------------------------------------------------------ detection
+
+    def _mark(self, func: ast.AST, kind: str, statics: FrozenSet[str] = frozenset()):
+        prev = self.traced.get(func)
+        if prev is None or (prev.kind == "scan" and kind == "jit"):
+            self.traced[func] = TracedInfo(func, kind, statics)
+
+    def _find_decorated(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, FunctionNode):
+                continue
+            for dec in node.decorator_list:
+                if terminal_name(dec) in _JIT_NAMES:
+                    self._mark(node, "jit")
+                elif isinstance(dec, ast.Call):
+                    if terminal_name(dec.func) in _JIT_NAMES:
+                        self._mark(node, "jit", _statics_from_jit_call(dec, node))
+                    elif terminal_name(dec.func) == "partial" and dec.args:
+                        if terminal_name(dec.args[0]) in _JIT_NAMES:
+                            self._mark(
+                                node, "jit", _statics_from_jit_call(dec, node)
+                            )
+
+    def _find_rebound(self) -> None:
+        """`g = jax.jit(f, ...)`: mark f's def as traced."""
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) not in _JIT_NAMES or not node.args:
+                continue
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                self._mark(target, "jit")
+            else:
+                name = terminal_name(target)
+                if name and name in self._defs:
+                    self._mark(
+                        self._defs[name], "jit",
+                        _statics_from_jit_call(node, self._defs[name]),
+                    )
+
+    def _find_scan_bodies(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if terminal_name(node.func) != "scan" or not node.args:
+                continue
+            dotted = dotted_name(node.func) or ""
+            if not (dotted.endswith("lax.scan") or dotted == "scan"):
+                continue
+            body = node.args[0]
+            if isinstance(body, ast.Lambda):
+                self._mark(body, "scan")
+            else:
+                name = terminal_name(body)
+                if name and name in self._defs:
+                    self._mark(self._defs[name], "scan")
+
+
+# --------------------------------------------------------------- arg flow
+
+
+def mentions_traced(node: ast.AST, traced: Set[str]) -> bool:
+    """Does evaluating `node` read a traced value (vs only static facts
+    like .shape / len() / isinstance())?"""
+    if isinstance(node, ast.Name):
+        return node.id in traced
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        return mentions_traced(node.value, traced)
+    if isinstance(node, ast.Call):
+        if terminal_name(node.func) in STATIC_CALLS:
+            return False
+        parts = [node.func] + list(node.args) + [kw.value for kw in node.keywords]
+        return any(mentions_traced(p, traced) for p in parts)
+    if isinstance(node, ast.Constant):
+        return False
+    return any(
+        mentions_traced(child, traced) for child in ast.iter_child_nodes(node)
+    )
+
+
+def _assign_targets(node: ast.AST) -> Iterator[ast.Name]:
+    """Flat Name targets of an assignment target (tuples unpacked)."""
+    if isinstance(node, ast.Name):
+        yield node
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for el in node.elts:
+            yield from _assign_targets(el)
+    elif isinstance(node, ast.Starred):
+        yield from _assign_targets(node.value)
+
+
+def propagate_traced(func: ast.AST, traced: Set[str]) -> Set[str]:
+    """One linear pass over the function body: a name assigned from an
+    expression that mentions a traced value becomes traced itself
+    (`a, b = carry`; `x = img_pos + 1`). Conservative: names are never
+    un-tainted (no CFG)."""
+    taint = set(traced)
+    body = func.body if isinstance(func.body, list) else []
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign):
+                if mentions_traced(node.value, taint):
+                    for t in node.targets:
+                        taint.update(n.id for n in _assign_targets(t))
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name) and mentions_traced(
+                    node.value, taint
+                ):
+                    taint.add(node.target.id)
+            elif isinstance(node, (ast.AnnAssign, ast.NamedExpr)):
+                target = node.target
+                if node.value is not None and mentions_traced(node.value, taint):
+                    taint.update(n.id for n in _assign_targets(target))
+    return taint
+
+
+# ------------------------------------------------------- donation registry
+
+
+@dataclass
+class DonationRegistry:
+    """Package-wide map of donating callables: bare name -> donated
+    positional arg indices at the CALL SITE."""
+
+    donors: Dict[str, FrozenSet[int]] = field(default_factory=dict)
+    #: builder name -> donated index within the built fn's params
+    builders: Dict[str, FrozenSet[int]] = field(default_factory=dict)
+
+    @classmethod
+    def build(cls, trees: Sequence[ast.Module]) -> "DonationRegistry":
+        """Two passes: builder tags / direct jit donations first, THEN the
+        wrapper inference — a wrapper in one file may dispatch a builder
+        defined in another."""
+        reg = cls()
+        for tree in trees:
+            reg._collect_jit_donations(tree)
+            reg._collect_builder_tags(tree)
+        for tree in trees:
+            reg._collect_wrappers(tree)
+        return reg
+
+    def _collect_jit_donations(self, tree: ast.Module) -> None:
+        defs: Dict[str, ast.AST] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, FunctionNode):
+                defs[node.name] = node
+        for node in ast.walk(tree):
+            # g = jax.jit(f, donate_argnums=...) — resolve f for argnames
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                call = node.value
+                if terminal_name(call.func) in _JIT_NAMES:
+                    wrapped = call.args[0] if call.args else None
+                    func = (
+                        wrapped
+                        if isinstance(wrapped, ast.Lambda)
+                        else defs.get(terminal_name(wrapped) or "")
+                    )
+                    idx = _donate_from_jit_call(call, func)
+                    if idx:
+                        for t in node.targets:
+                            name = terminal_name(t)
+                            if name:
+                                self.donors[name] = frozenset(idx)
+            # @partial(jax.jit, donate_argnums=...) / @jax.jit(...) decorator
+            if isinstance(node, FunctionNode):
+                for dec in node.decorator_list:
+                    if not isinstance(dec, ast.Call):
+                        continue
+                    is_jit = terminal_name(dec.func) in _JIT_NAMES or (
+                        terminal_name(dec.func) == "partial"
+                        and dec.args
+                        and terminal_name(dec.args[0]) in _JIT_NAMES
+                    )
+                    if is_jit:
+                        idx = _donate_from_jit_call(dec, node)
+                        if idx:
+                            self.donors[node.name] = frozenset(idx)
+
+    def _collect_builder_tags(self, tree: ast.Module) -> None:
+        """`builder._donate_argnums = (k,)` module-level assignments."""
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "_donate_argnums"
+                    and isinstance(t.value, ast.Name)
+                ):
+                    idx = _int_elements(node.value)
+                    if idx:
+                        self.builders[t.value.id] = frozenset(idx)
+
+    def _collect_wrappers(self, tree: ast.Module) -> None:
+        """A function whose body returns `_jit_sample(builder, model, key,
+        *args)` donates its own parameter standing at args position 3+k."""
+        for node in ast.walk(tree):
+            if not isinstance(node, FunctionNode):
+                continue
+            names = param_names(node)
+            for ret in ast.walk(node):
+                if not (isinstance(ret, ast.Return) and isinstance(ret.value, ast.Call)):
+                    continue
+                donated = self.call_donated_indices(ret.value)
+                wrapper_idx = set()
+                for i in donated:
+                    if i < len(ret.value.args):
+                        arg = ret.value.args[i]
+                        if isinstance(arg, ast.Name) and arg.id in names:
+                            wrapper_idx.add(names.index(arg.id))
+                if wrapper_idx:
+                    self.donors[node.name] = frozenset(wrapper_idx)
+
+    # -------------------------------------------------------------- queries
+
+    def call_donated_indices(self, call: ast.Call) -> FrozenSet[int]:
+        """Positional arg indices of `call` whose buffers are donated."""
+        fname = terminal_name(call.func)
+        if fname is None:
+            return frozenset()
+        if fname in ("_jit_sample", "_jitted_sampler") and call.args:
+            builder = terminal_name(call.args[0])
+            if builder in self.builders:
+                # _jit_sample(builder, model, static_key, *fn_args)
+                return frozenset(3 + k for k in self.builders[builder])
+        if fname in self.donors:
+            return self.donors[fname]
+        return frozenset()

@@ -1,0 +1,266 @@
+"""tracelint driver: walk files, run rules, apply suppressions + baseline.
+
+Usage (CLI is also installed as `dalle-tpu-lint`):
+
+    python -m dalle_pytorch_tpu.analysis                      # lint the package
+    python -m dalle_pytorch_tpu.analysis path/ other.py       # explicit paths
+    python -m dalle_pytorch_tpu.analysis --format json
+    python -m dalle_pytorch_tpu.analysis --select TL003,TL006
+    python -m dalle_pytorch_tpu.analysis --write-baseline     # grandfather
+
+Exit codes: 0 clean, 1 new findings, 2 usage/internal error.
+
+The driver builds the package-wide `DonationRegistry` over EVERY file it
+was pointed at before running per-file rules, so TL003 sees donation
+contracts across module boundaries (the serving engine donates state to
+dispatchers defined in models/dalle.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional, Sequence, Set, Tuple
+
+from dalle_pytorch_tpu.analysis.baseline import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from dalle_pytorch_tpu.analysis.core import FileContext, Finding, LintResult
+from dalle_pytorch_tpu.analysis.jaxctx import DonationRegistry
+from dalle_pytorch_tpu.analysis.rules import ALL_RULES
+
+PACKAGE_DIR = Path(__file__).resolve().parents[1]
+
+#: directories never descended into
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def iter_python_files(paths: Sequence[Path]) -> List[Tuple[Path, str]]:
+    """Expand `paths` to [(file, stable_path)]. `stable_path` is the file
+    relative to the lint root it was found under (dir roots) or its name
+    (file roots) — invocation-directory-independent, so baselines written
+    anywhere keep matching. Raises FileNotFoundError on a path that
+    doesn't exist: a typo'd CI path must be a loud usage error, not a
+    permanently-green '0 findings over 0 files' run."""
+    files: List[Tuple[Path, str]] = []
+    for p in paths:
+        if p.is_dir():
+            for sub in sorted(p.rglob("*.py")):
+                if not _SKIP_DIRS & set(part for part in sub.parts):
+                    files.append((sub, sub.relative_to(p).as_posix()))
+        elif p.is_file():
+            if p.suffix == ".py":
+                files.append((p, p.name))
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    return files
+
+
+def _display_path(path: Path) -> str:
+    try:
+        return path.resolve().relative_to(Path.cwd()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def lint_paths(
+    paths: Sequence[Path],
+    select: Optional[Set[str]] = None,
+    baseline_fingerprints: Optional[Set[str]] = None,
+) -> LintResult:
+    """Run the rule pack over `paths` (files or directories).
+
+    `select` restricts to a set of rule codes (TL000 framework findings
+    are only emitted when unrestricted or explicitly selected).
+    """
+    rules = [
+        r for r in ALL_RULES if select is None or r.code in select
+    ]
+    # TL000 and opt-out-free rules (TL006) ignore suppression comments
+    unsuppressible = {"TL000"} | {
+        r.code for r in ALL_RULES if not r.suppressible
+    }
+    files = iter_python_files([Path(p) for p in paths])
+
+    contexts: List[FileContext] = []
+    result = LintResult()
+    for path, stable in files:
+        try:
+            source = path.read_text(encoding="utf-8")
+            contexts.append(
+                FileContext(path, _display_path(path), source, stable)
+            )
+        except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+            result.findings.append(
+                Finding(
+                    rule="TL000",
+                    path=_display_path(path),
+                    line=getattr(exc, "lineno", 1) or 1,
+                    message=f"file could not be parsed: {exc.__class__.__name__}",
+                    stable_path=stable,
+                )
+            )
+    result.files_checked = len(contexts)
+
+    registry = DonationRegistry.build([c.tree for c in contexts])
+
+    raw: List[Finding] = []
+    for ctx in contexts:
+        for rule in rules:
+            raw.extend(rule.check(ctx, registry))
+        if select is None or "TL000" in select:
+            raw.extend(ctx.malformed_suppressions())
+
+        # apply suppressions for this file's findings
+        mine = [f for f in raw if f.path == ctx.display_path]
+        raw = [f for f in raw if f.path != ctx.display_path]
+        for f in mine:
+            sup = None if f.rule in unsuppressible else ctx.suppressed(f)
+            if sup is not None:
+                result.suppressed.append((f, sup))
+            else:
+                result.findings.append(f)
+
+    result.findings.extend(raw)  # findings for unparsed paths, if any
+
+    if baseline_fingerprints:
+        new, old = split_baselined(result.findings, baseline_fingerprints)
+        result.findings = new
+        result.baselined = old
+
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result
+
+
+# ------------------------------------------------------------------ output
+
+
+def _render_text(result: LintResult) -> str:
+    out: List[str] = []
+    for f in result.findings:
+        out.append(f.render())
+    summary = (
+        f"tracelint: {len(result.findings)} finding(s) over "
+        f"{result.files_checked} file(s)"
+    )
+    extras = []
+    if result.suppressed:
+        extras.append(f"{len(result.suppressed)} suppressed")
+    if result.baselined:
+        extras.append(f"{len(result.baselined)} baselined")
+    if extras:
+        summary += f" ({', '.join(extras)})"
+    out.append(summary)
+    return "\n".join(out)
+
+
+def _render_json(result: LintResult) -> str:
+    return json.dumps(
+        {
+            "findings": [f.as_json() for f in result.findings],
+            "suppressed": [
+                {**f.as_json(), "reason": sup.reason}
+                for f, sup in result.suppressed
+            ],
+            "baselined": [f.as_json() for f in result.baselined],
+            "files_checked": result.files_checked,
+        },
+        indent=2,
+    )
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dalle-tpu-lint",
+        description=(
+            "tracelint: JAX-aware static analysis for recompilation, "
+            "donation, host-sync, and RNG-reuse hazards"
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help=f"files/dirs to lint (default: the installed package, {PACKAGE_DIR})",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text"
+    )
+    parser.add_argument(
+        "--select", default=None, metavar="TLxxx[,TLxxx...]",
+        help="run only these rule codes",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help=f"baseline file (default: {DEFAULT_BASELINE} when linting the package)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline file (zero-baseline run)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="grandfather current findings into the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule pack and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.code} {rule.name}: {rule.description}")
+        return 0
+
+    paths = args.paths or [PACKAGE_DIR]
+    select = None
+    if args.select:
+        select = {c.strip() for c in args.select.split(",") if c.strip()}
+        unknown = select - {r.code for r in ALL_RULES} - {"TL000"}
+        if unknown:
+            print(f"unknown rule code(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.paths:
+        baseline_path = DEFAULT_BASELINE  # package lint uses the shipped file
+
+    fingerprints: Set[str] = set()
+    if baseline_path is not None and not args.no_baseline and not args.write_baseline:
+        fingerprints = load_baseline(baseline_path)
+
+    try:
+        result = lint_paths(
+            paths, select=select, baseline_fingerprints=fingerprints
+        )
+    except FileNotFoundError as exc:
+        print(f"tracelint: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        if baseline_path is None:
+            # explicit paths + no --baseline: refusing to guess would
+            # silently overwrite the shipped package baseline with
+            # fingerprints for unrelated files
+            print(
+                "tracelint: --write-baseline with explicit paths requires "
+                "--baseline <file>",
+                file=sys.stderr,
+            )
+            return 2
+        write_baseline(baseline_path, result.findings)
+        print(
+            f"tracelint: wrote {len(result.findings)} fingerprint(s) "
+            f"to {baseline_path}"
+        )
+        return 0
+
+    print(_render_text(result) if args.format == "text" else _render_json(result))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

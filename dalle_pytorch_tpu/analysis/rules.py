@@ -1,0 +1,516 @@
+"""tracelint rule pack: the JAX failure modes this codebase actually has.
+
+Each rule targets one hazard class the serving/training stack depends on
+keeping out (see ISSUE/ROADMAP and the fixed-shape compilation discipline
+of pjit-style stacks, arXiv:2204.06514):
+
+TL001  Python `if`/`while`/`assert` on a traced parameter of a jit/pjit/
+       scan-wrapped function. Branching on a tracer either raises a
+       ConcretizationTypeError or — with static_argnums misapplied —
+       silently recompiles per value, destroying the compiled-shape ladder.
+TL002  device->host syncs (`.item()`, `float()/int()/bool()` on arrays,
+       `np.asarray`, `jax.device_get`, `.block_until_ready()`) inside
+       traced functions, or on engine state inside functions marked
+       `# tracelint: hotloop` (the serving admit/chunk/retire loops):
+       every unplanned sync stalls the dispatch pipeline.
+TL003  a donated argument read after the donating dispatch: donation
+       invalidates the buffer, so the read returns garbage or raises —
+       the exact bug class the slot-state donation of PR 2 made possible.
+TL004  one PRNG key consumed by two `jax.random.*` draws with no
+       `split`/`fold_in` between: correlated randomness, silently.
+TL005  dtype-less `jnp.array`/`jnp.zeros`/`jnp.ones` in `models/` and
+       `ops/`: default-dtype drift (x64 flags, platform defaults) breaks
+       checkpoint compatibility and the bit-exactness contracts the
+       decode-composition tests pin.
+TL006  debugger artifacts (`import ipdb`, `breakpoint()`, `st()`,
+       `.set_trace()`): the reference codebase shipped an import-time
+       breakpoint (SURVEY.md §0); any import became a hung process.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from dalle_pytorch_tpu.analysis.core import FileContext, Finding, Rule
+from dalle_pytorch_tpu.analysis.jaxctx import (
+    FunctionNode,
+    JaxIndex,
+    dotted_name,
+    mentions_traced,
+    propagate_traced,
+    terminal_name,
+    _assign_targets,
+)
+
+_ALL_FUNCS = FunctionNode + (ast.Lambda,)
+
+
+def _jax_index(ctx: FileContext) -> JaxIndex:
+    """One traced-function index per file, shared by every rule that
+    needs it (memoized on the FileContext)."""
+    idx = getattr(ctx, "_jax_index", None)
+    if idx is None:
+        idx = JaxIndex(ctx.tree)
+        ctx._jax_index = idx
+    return idx
+
+
+def _functions(tree: ast.Module) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, _ALL_FUNCS):
+            yield node
+
+
+def _walk_shallow(func: ast.AST) -> Iterator[ast.AST]:
+    """Pre-order, source-ordered walk of a function body WITHOUT descending
+    into nested function defs (they get their own analysis pass)."""
+
+    def rec(node: ast.AST) -> Iterator[ast.AST]:
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if not isinstance(child, _ALL_FUNCS):
+                yield from rec(child)
+
+    return rec(func)
+
+
+class TracerBranchRule(Rule):
+    code = "TL001"
+    name = "tracer-branch"
+    description = (
+        "Python if/while/assert on a traced parameter of a jit/pjit/scan-"
+        "wrapped function (recompilation / ConcretizationTypeError hazard)"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        index = _jax_index(ctx)
+        for func, info in index.traced.items():
+            traced = propagate_traced(func, info.traced_params())
+            if not traced:
+                continue
+            for node in _walk_shallow(func):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                elif isinstance(node, ast.Assert):
+                    test = node.test
+                    kind = "assert"
+                else:
+                    continue
+                if mentions_traced(test, traced):
+                    names = sorted(
+                        n.id
+                        for n in ast.walk(test)
+                        if isinstance(n, ast.Name) and n.id in traced
+                    )
+                    yield ctx.finding(
+                        self.code,
+                        node,
+                        f"`{kind}` on traced value(s) {', '.join(names)} "
+                        f"inside a {info.kind}-traced function — use "
+                        "jnp.where/lax.cond, or mark the argument static",
+                    )
+
+
+#: call names that ALWAYS force a device->host sync
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+def _is_np_call(call: ast.Call, names: Tuple[str, ...]) -> bool:
+    dotted = dotted_name(call.func) or ""
+    return any(
+        dotted == f"{mod}.{n}"
+        for mod in ("np", "numpy")
+        for n in names
+    )
+
+
+def _mentions_self_state(node: ast.AST, derived: Set[str]) -> bool:
+    """Does `node` reach engine/device state: an attribute rooted at
+    `self`, or a local name derived from one?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute):
+            root = sub
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id == "self":
+                return True
+        if isinstance(sub, ast.Name) and sub.id in derived:
+            return True
+    return False
+
+
+class HostSyncRule(Rule):
+    code = "TL002"
+    name = "host-sync"
+    description = (
+        "device->host synchronization inside a traced function or a "
+        "`# tracelint: hotloop`-marked serving loop"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        index = _jax_index(ctx)
+        for func, info in index.traced.items():
+            traced = propagate_traced(func, info.traced_params())
+            yield from self._check_traced(ctx, func, traced)
+        for func in _functions(ctx.tree):
+            if not isinstance(func, ast.Lambda) and ctx.is_hotloop(func):
+                yield from self._check_hotloop(ctx, func)
+
+    def _check_traced(self, ctx, func, traced) -> Iterator[Finding]:
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = terminal_name(node.func)
+            if fname in _SYNC_METHODS and isinstance(node.func, ast.Attribute):
+                yield ctx.finding(
+                    self.code, node,
+                    f"`.{fname}()` forces a host sync under tracing",
+                )
+            elif _is_np_call(node, ("asarray", "array")) or (
+                dotted_name(node.func) or ""
+            ).endswith("jax.device_get"):
+                yield ctx.finding(
+                    self.code, node,
+                    "host-side numpy/device_get inside a traced function "
+                    "— the value is pulled off-device at every call",
+                )
+            elif (
+                fname in _CAST_BUILTINS
+                and isinstance(node.func, ast.Name)
+                and node.args
+                and mentions_traced(node.args[0], traced)
+            ):
+                yield ctx.finding(
+                    self.code, node,
+                    f"`{fname}()` on a traced value concretizes it "
+                    "(host sync / ConcretizationTypeError)",
+                )
+
+    def _check_hotloop(self, ctx, func) -> Iterator[Finding]:
+        # arg-flow: names assigned from self-rooted expressions count as
+        # engine state too (`state = self._state` then `np.asarray(state)`)
+        derived: Set[str] = set()
+        for node in _walk_shallow(func):
+            if isinstance(node, ast.Assign) and _mentions_self_state(
+                node.value, derived
+            ):
+                for t in node.targets:
+                    derived.update(n.id for n in _assign_targets(t))
+        for node in _walk_shallow(func):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = terminal_name(node.func)
+            dotted = dotted_name(node.func) or ""
+            if fname in _SYNC_METHODS and isinstance(node.func, ast.Attribute):
+                yield ctx.finding(
+                    self.code, node,
+                    f"`.{fname}()` in a hot loop stalls the dispatch "
+                    "pipeline — move the sync to a chunk boundary or "
+                    "justify it with a suppression",
+                )
+            elif dotted.endswith("jax.device_get") or dotted.endswith(
+                "jax.block_until_ready"
+            ):
+                yield ctx.finding(
+                    self.code, node,
+                    f"`{dotted}` in a hot loop — every call is a "
+                    "device round trip; batch transfers at the boundary "
+                    "or justify with a suppression",
+                )
+            elif _is_np_call(node, ("asarray", "array")) and node.args and (
+                _mentions_self_state(node.args[0], derived)
+            ):
+                yield ctx.finding(
+                    self.code, node,
+                    "np.asarray on engine state in a hot loop is an "
+                    "implicit device->host sync — make it explicit "
+                    "(jax.device_get at the designed boundary) or justify "
+                    "with a suppression",
+                )
+
+
+class DonatedReuseRule(Rule):
+    code = "TL003"
+    name = "donated-reuse"
+    description = (
+        "read of a donated argument after the donating dispatch — donation "
+        "invalidates the buffer (one cache copy alive, not two)"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        if package is None:
+            return
+        for func in _functions(ctx.tree):
+            if isinstance(func, ast.Lambda):
+                continue
+            yield from self._check_function(ctx, func, package)
+
+    def _check_function(self, ctx, func, package) -> Iterator[Finding]:
+        # poisoned name -> (donating callable, line of the dispatch)
+        poisoned: Dict[str, Tuple[str, int]] = {}
+
+        def shallow_nodes(node) -> Iterator[ast.AST]:
+            yield node
+            if isinstance(node, _ALL_FUNCS):
+                return
+            for child in ast.iter_child_nodes(node):
+                yield from shallow_nodes(child)
+
+        def scan_exprs(exprs: List[ast.AST], stmt) -> Iterator[Finding]:
+            """Per-statement ordering: reads flagged first (exempting the
+            donated args themselves), then donations poison, then
+            assignment targets clear — so `state = f(state)` ends clean
+            while `new = f(state); state[...]` flags the later read."""
+            nodes: List[ast.AST] = []
+            for e in exprs:
+                nodes.extend(shallow_nodes(e))
+            exempt = set()
+            donations: List[Tuple[str, str, int]] = []
+            for node in nodes:
+                if isinstance(node, ast.Call):
+                    for i in package.call_donated_indices(node):
+                        if i < len(node.args) and isinstance(
+                            node.args[i], ast.Name
+                        ):
+                            exempt.add(id(node.args[i]))
+                            donations.append((
+                                node.args[i].id,
+                                terminal_name(node.func) or "<dispatch>",
+                                node.lineno,
+                            ))
+            for node in nodes:
+                if (
+                    isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in poisoned
+                    and id(node) not in exempt
+                ):
+                    donor, line = poisoned[node.id]
+                    yield ctx.finding(
+                        "TL003", node,
+                        f"`{node.id}` was donated to `{donor}` on line "
+                        f"{line}; its buffer is invalid — use the "
+                        "dispatch's return value instead",
+                    )
+            for name, donor, line in donations:
+                poisoned[name] = (donor, line)
+            for node in nodes:
+                if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, (ast.Store, ast.Del)
+                ):
+                    poisoned.pop(node.id, None)
+
+        def walk_block(body: List[ast.AST]) -> Iterator[Finding]:
+            # linear approximation of control flow: branches analyzed in
+            # order with shared state (conservative for reads, forgiving
+            # for rebinds — fixtures pin both directions)
+            for stmt in body:
+                if isinstance(stmt, _ALL_FUNCS):
+                    continue
+                exprs, blocks = [], []
+                for _field, value in ast.iter_fields(stmt):
+                    if isinstance(value, list) and value and isinstance(
+                        value[0], ast.stmt
+                    ):
+                        blocks.append(value)
+                    elif isinstance(value, list):
+                        exprs.extend(
+                            v for v in value if isinstance(v, ast.AST)
+                        )
+                    elif isinstance(value, ast.AST):
+                        exprs.append(value)
+                yield from scan_exprs(exprs, stmt)
+                for block in blocks:
+                    yield from walk_block(block)
+
+        yield from walk_block(func.body)
+
+
+#: jax.random callables that DERIVE keys rather than consuming them
+_KEY_DERIVERS = {
+    "PRNGKey", "split", "fold_in", "key", "key_data", "wrap_key_data",
+    "clone",
+}
+
+
+class KeyReuseRule(Rule):
+    code = "TL004"
+    name = "rng-key-reuse"
+    description = (
+        "one PRNG key consumed by two jax.random draws with no split/"
+        "fold_in between — correlated randomness"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        roots, aliases = self._jax_random_bindings(ctx.tree)
+        for func in _functions(ctx.tree):
+            yield from self._check_function(ctx, func, roots, aliases)
+
+    @staticmethod
+    def _jax_random_bindings(tree: ast.Module):
+        """(names bound to the jax module, names bound to jax.random) —
+        so `np.random.normal` / stdlib `random.choice` never register as
+        key draws (they take no key; flagging them is pure noise)."""
+        roots = set()
+        aliases = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax":
+                        roots.add(a.asname or "jax")
+                    elif a.name == "jax.random":
+                        if a.asname:  # import jax.random as jr
+                            aliases.add(a.asname)
+                        else:  # bare `import jax.random` binds the name jax
+                            roots.add("jax")
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        aliases.add(a.asname or "random")
+        return roots, aliases
+
+    @staticmethod
+    def _is_random_call(call: ast.Call, roots, aliases) -> Optional[str]:
+        dotted = dotted_name(call.func) or ""
+        parts = dotted.split(".")
+        if len(parts) >= 3 and parts[-2] == "random" and parts[0] in roots:
+            return parts[-1]  # jax.random.X
+        if len(parts) == 2 and parts[0] in aliases:
+            return parts[-1]  # from jax import random; random.X
+        return None
+
+    def _check_function(self, ctx, func, roots, aliases) -> Iterator[Finding]:
+        consumed: Dict[str, int] = {}  # key name -> line first consumed
+
+        def refresh(target) -> None:
+            for n in _assign_targets(target):
+                consumed.pop(n.id, None)
+
+        for node in _walk_shallow(func):
+            # any rebinding refreshes the name (split/fold_in results are
+            # fresh keys; so is a brand-new PRNGKey) — including loop and
+            # with-as targets: `for key in keys:` binds a fresh key each
+            # iteration, the standard iterate-over-split-keys idiom
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    refresh(t)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                refresh(node.target)
+            elif isinstance(node, ast.comprehension):
+                refresh(node.target)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                refresh(node.optional_vars)
+            if not isinstance(node, ast.Call):
+                continue
+            fname = self._is_random_call(node, roots, aliases)
+            if fname is None or fname in _KEY_DERIVERS:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            key = node.args[0].id
+            if key in consumed:
+                yield ctx.finding(
+                    self.code, node,
+                    f"key `{key}` already consumed by a jax.random "
+                    f"draw on line {consumed[key]} — split or fold_in "
+                    "before drawing again",
+                )
+            else:
+                consumed[key] = node.lineno
+
+
+class DtypeDriftRule(Rule):
+    code = "TL005"
+    name = "dtype-drift"
+    description = (
+        "dtype-less jnp.array/jnp.zeros/jnp.ones in models/ or ops/ — "
+        "default-dtype drift breaks checkpoint and bit-exactness contracts"
+    )
+
+    #: path fragments this rule applies to (precision-discipline dirs)
+    SCOPED_DIRS = ("models", "ops")
+
+    def _in_scope(self, ctx: FileContext) -> bool:
+        parts = ctx.path.parts
+        return any(d in parts for d in self.SCOPED_DIRS)
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func) or ""
+            if dotted not in ("jnp.array", "jnp.zeros", "jnp.ones"):
+                continue
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords) or (
+                len(node.args) >= 2  # positional dtype: jnp.zeros(shape, jnp.f32)
+            )
+            if not has_dtype:
+                yield ctx.finding(
+                    self.code, node,
+                    f"`{dotted}` without an explicit dtype — the default "
+                    "drifts with x64 flags and platform; pin it",
+                )
+
+
+class DebuggerArtifactRule(Rule):
+    code = "TL006"
+    name = "debugger-artifact"
+    description = (
+        "debugger artifact in shipped code — the reference repo's import-"
+        "time-breakpoint regression (SURVEY.md §0)"
+    )
+    # the regex scan this rule replaced had no opt-out; neither does this —
+    # a suppression comment must not let a breakpoint ship
+    suppressible = False
+
+    _MSG = (
+        "debugger artifact in shipped code (the reference repo's "
+        "import-time-breakpoint regression, SURVEY.md §0)"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] == "ipdb":
+                        yield ctx.finding(
+                            self.code, node, f"`import ipdb`: {self._MSG}"
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "ipdb":
+                    yield ctx.finding(
+                        self.code, node, f"`from ipdb import`: {self._MSG}"
+                    )
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name):
+                    if node.func.id == "breakpoint":
+                        yield ctx.finding(
+                            self.code, node, f"`breakpoint()`: {self._MSG}"
+                        )
+                    elif node.func.id == "st" and not node.args and not node.keywords:
+                        yield ctx.finding(
+                            self.code, node,
+                            f"`st()` debugger alias: {self._MSG}",
+                        )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "set_trace"
+                ):
+                    yield ctx.finding(
+                        self.code, node, f"`.set_trace()`: {self._MSG}"
+                    )
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    TracerBranchRule(),
+    HostSyncRule(),
+    DonatedReuseRule(),
+    KeyReuseRule(),
+    DtypeDriftRule(),
+    DebuggerArtifactRule(),
+)

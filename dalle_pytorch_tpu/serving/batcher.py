@@ -359,7 +359,7 @@ class MicroBatcher:
                 continue
             self._flush(batch)
 
-    def _flush(self, batch: List[GenRequest]) -> None:
+    def _flush(self, batch: List[GenRequest]) -> None:  # tracelint: hotloop
         specs: List[SampleSpec] = []
         for req in batch:
             specs.extend(req.specs)
@@ -475,7 +475,7 @@ class ContinuousBatcher(MicroBatcher):
 
     # ------------------------------------------------------------- worker
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # tracelint: hotloop
         inflight: dict = {}  # slot -> (request, row index within request)
         partial: dict = {}  # request -> {"tokens": [rows], "remaining": n}
         while True:
@@ -552,7 +552,7 @@ class ContinuousBatcher(MicroBatcher):
             pass
         self._set_slots_gauge()
 
-    def _retire(self, finished, inflight, partial) -> None:
+    def _retire(self, finished, inflight, partial) -> None:  # tracelint: hotloop
         """Harvest finished slots, resolve fully-collected requests, free
         the slots for the next admission wave."""
         tokens = self.engine.harvest(finished)

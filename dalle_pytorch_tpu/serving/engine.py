@@ -406,7 +406,7 @@ class ContinuousEngine(GenerationEngine):
             self._state = init_slot_state(self.model, self.max_batch)
             raise
 
-    def prefill_slot(
+    def prefill_slot(  # tracelint: hotloop
         self, slot: int, spec: SampleSpec, _warmup: bool = False
     ) -> None:
         """Admit one prompt into `slot` (one fixed-shape dispatch)."""
@@ -423,9 +423,11 @@ class ContinuousEngine(GenerationEngine):
             if not _warmup:
                 self._m_prefills.inc()
 
-    def step_chunk(self, _warmup: bool = False):
+    def step_chunk(self, _warmup: bool = False):  # tracelint: hotloop
         """Advance all live slots by `chunk_tokens`; returns the post-chunk
         (img_pos, active) host snapshot the batcher retires against."""
+        import jax
+
         from dalle_pytorch_tpu.models.dalle import decode_image_chunk
 
         with self._lock:
@@ -435,19 +437,28 @@ class ContinuousEngine(GenerationEngine):
             if not _warmup:
                 self._m_chunks.inc()
                 self.stats.batches += 1
-            return (
-                np.asarray(self._state["img_pos"]),
-                np.asarray(self._state["active"]),
+            # the chunk boundary IS the designed sync point: retirement
+            # decisions need the positions on the host, and fusing both
+            # small arrays into one transfer keeps it to a single round trip
+            return jax.device_get(  # tracelint: disable=TL002 -- chunk-boundary snapshot is the one designed sync of the decode loop (single fused transfer)
+                (self._state["img_pos"], self._state["active"])
             )
 
-    def harvest(self, slots: Sequence[int]) -> np.ndarray:
+    def harvest(self, slots: Sequence[int]) -> np.ndarray:  # tracelint: hotloop
         """Finished slots' tokens [len(slots), image_seq_len] (host copy)."""
+        import jax
+
         with self._lock:
-            toks = np.asarray(self._state["img_tokens"])
+            # one explicit fixed-shape transfer of the whole token buffer,
+            # sliced on the host: a device-side gather of just the finished
+            # rows would compile one program PER finished-count (1..max_batch)
+            # and break the exactly-the-warmup-set compile discipline that
+            # tests/test_continuous.py pins with assert_no_recompiles
+            toks = jax.device_get(self._state["img_tokens"])  # tracelint: disable=TL002 -- retirement harvest is a designed sync; fixed-shape transfer beats a per-count compiled gather
             self.stats.rows_generated += len(list(slots))
         return toks[list(slots)].astype(np.int32)
 
-    def release(self, slots: Sequence[int]) -> None:
+    def release(self, slots: Sequence[int]) -> None:  # tracelint: hotloop
         """Deactivate `slots` so the chunk step stops touching them — after
         harvest, or wholesale on an error reset (which must not count
         toward `rows_generated`; only harvests do)."""
@@ -460,7 +471,7 @@ class ContinuousEngine(GenerationEngine):
                 lambda s: release_slots(self.model, s, mask)
             )
 
-    def decode_pixels(self, tokens: np.ndarray) -> Optional[np.ndarray]:
+    def decode_pixels(self, tokens: np.ndarray) -> Optional[np.ndarray]:  # tracelint: hotloop
         """Pixels [n, H, W, 3] in [0, 1] for harvested token rows, via ONE
         compiled shape (pad to max_batch, slice) — or None without a VAE."""
         if self.vae is None:
@@ -469,6 +480,7 @@ class ContinuousEngine(GenerationEngine):
 
         n = len(tokens)
         if not isinstance(self.vae, DiscreteVAE):
+            # tracelint: disable=TL002 -- pretrained-wrapper decode is host-side by contract; its output leaves the device here by design
             return np.clip(np.asarray(self.vae.decode(tokens)), 0.0, 1.0)
         import jax
         import jax.numpy as jnp
@@ -488,7 +500,7 @@ class ContinuousEngine(GenerationEngine):
         with self._lock:
             for i in range(0, len(padded), self.max_batch):
                 outs.append(
-                    np.asarray(
+                    np.asarray(  # tracelint: disable=TL002 -- pixel harvest is the terminal sync of the retire path; rows leave the device here by design
                         self._decode_pixels_jit(
                             jnp.asarray(padded[i : i + self.max_batch])
                         )
@@ -503,10 +515,13 @@ class ContinuousEngine(GenerationEngine):
     # ----------------------------------------------------------- warmup
 
     def warmup(self, shapes: Optional[Sequence[int]] = None) -> None:
-        """Compile the three fixed-shape programs (prefill, chunk, pixel
-        decode) with dummy traffic, then reset the slot state. Counts only
-        toward compile metrics + `stats.warmup_batches` (same tagging
-        contract as the micro-batch engine)."""
+        """Compile the full fixed-shape program set (prefill, chunk, slot
+        release, pixel decode) with dummy traffic, then reset the slot
+        state. Counts only toward compile metrics + `stats.warmup_batches`
+        (same tagging contract as the micro-batch engine). Warming ALL of
+        the steady-state programs — release included — is load-bearing:
+        tests/test_continuous.py pins with `assert_no_recompiles` that a
+        post-warmup serve cycle compiles nothing."""
         from dalle_pytorch_tpu.models.dalle import init_slot_state
 
         t0 = time.perf_counter()
@@ -516,6 +531,7 @@ class ContinuousEngine(GenerationEngine):
         self._compile_miss.inc()
         self.prefill_slot(0, dummy, _warmup=True)
         self.step_chunk(_warmup=True)
+        self.release([0])
         self.decode_pixels(
             np.zeros((1, self.image_seq_len), np.int32)
         )

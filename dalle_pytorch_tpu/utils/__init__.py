@@ -1,2 +1,8 @@
+from dalle_pytorch_tpu.utils.compile_guard import (
+    RecompileError,
+    assert_no_recompiles,
+    compile_count,
+    track_compiles,
+)
 from dalle_pytorch_tpu.utils.images import save_image_grid, to_uint8
 from dalle_pytorch_tpu.utils.trees import param_count, tree_bytes

@@ -1,0 +1,113 @@
+"""Compile-count guard: pin that a code region compiles NOTHING new.
+
+The serving stack's latency story rests on a fixed ladder of compiled
+shapes (engine warmup compiles every program the steady state will ever
+dispatch). A stray recompile — a drifting shape, a new dtype, an eager op
+with a data-dependent shape — silently turns a ~ms dispatch into a
+~seconds compile, exactly the hazard class tracelint's TL001 hunts
+statically. `assert_no_recompiles` is the RUNTIME end of that contract:
+
+    engine.warmup()
+    with assert_no_recompiles():
+        ...steady-state serve cycle...   # raises if anything compiles
+
+Counting is based on `jax.monitoring`'s backend-compile duration events
+(one per XLA compilation, cache hits emit nothing), which covers jit,
+pjit, AND first-execution compiles of eager ops. The listener is installed
+once per process and counts into a module global; the context manager
+snapshots the counter around the block, so guards nest safely.
+
+CAVEAT — attribution is process-wide, not per-thread: jax.monitoring
+events carry no thread identity, so a compilation triggered on ANY thread
+during the block (another engine warming up in a parallel fixture, a lazy
+jit on a server thread) counts against the guard and fails it. Guard
+regions while no other thread is dispatching to JAX; the failure message
+lists the observed events so a cross-thread culprit is identifiable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Iterator, List
+
+#: the event jax.monitoring emits once per backend (XLA) compilation
+_COMPILE_EVENT_SUFFIX = "backend_compile"
+
+_lock = threading.Lock()
+_installed = False
+_compile_count = 0
+#: recent event names only (error-message context) — a bare counter plus a
+#: bounded deque keeps a long-lived process from accumulating one string
+#: per compilation forever
+_recent_events: Deque[str] = deque(maxlen=32)
+
+
+def _install_listener() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax
+
+        def _on_event(name: str, duration: float, **kwargs) -> None:
+            # '/jax/core/compile/backend_compile_duration' et al.
+            if _COMPILE_EVENT_SUFFIX in name:
+                global _compile_count
+                _compile_count += 1
+                _recent_events.append(name)
+
+        jax.monitoring.register_event_duration_secs_listener(_on_event)
+        _installed = True
+
+
+def compile_count() -> int:
+    """Backend compilations observed so far this process (after the first
+    guard/`track_compiles` use installed the listener)."""
+    return _compile_count
+
+
+class RecompileError(AssertionError):
+    """A guarded region compiled something new."""
+
+
+@dataclass
+class CompileTally:
+    """Live view of compilations inside a guard block."""
+
+    _start: int = 0
+    allowed: int = 0
+
+    @property
+    def count(self) -> int:
+        return _compile_count - self._start
+
+    @property
+    def events(self) -> List[str]:
+        """The most recent compile event names (bounded window) — context
+        for the error message, not a complete ledger."""
+        return list(_recent_events)[-max(self.count, 0):] if self.count else []
+
+
+@contextlib.contextmanager
+def track_compiles() -> Iterator[CompileTally]:
+    """Count backend compilations in a block without asserting."""
+    _install_listener()
+    yield CompileTally(_start=_compile_count)
+
+
+@contextlib.contextmanager
+def assert_no_recompiles(allowed: int = 0) -> Iterator[CompileTally]:
+    """Raise `RecompileError` if the block triggers more than `allowed`
+    backend compilations (default: zero — the steady-state contract)."""
+    _install_listener()
+    tally = CompileTally(_start=_compile_count, allowed=allowed)
+    yield tally
+    if tally.count > allowed:
+        raise RecompileError(
+            f"guarded region compiled {tally.count} program(s) "
+            f"(allowed {allowed}) — a shape/dtype drifted out of the "
+            f"warmup set. Recent compile events: {tally.events}"
+        )

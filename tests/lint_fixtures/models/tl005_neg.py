@@ -1,0 +1,12 @@
+"""TL005 negative: pinned dtypes (keyword or positional), dtype-inheriting
+constructors, and jnp.array outside the disciplined dirs is out of scope."""
+
+import jax.numpy as jnp
+
+
+def build_state(n, like):
+    row = jnp.zeros((n, 16), jnp.float32)  # positional dtype pins it
+    mask = jnp.ones(n, dtype=jnp.bool_)
+    table = jnp.array([1, 2, 3], dtype=jnp.int32)
+    ring = jnp.zeros_like(like)  # inherits its dtype: no drift
+    return row, mask, table, ring

@@ -1,0 +1,11 @@
+"""TL005 positive: dtype-less constructors in a `models/` path — the
+default dtype drifts with x64 flags and platform."""
+
+import jax.numpy as jnp
+
+
+def build_state(n):
+    row = jnp.zeros((n, 16))  # float32? float64? depends on flags
+    mask = jnp.ones(n)
+    table = jnp.array([1, 2, 3])  # int32 vs int64 platform drift
+    return row, mask, table

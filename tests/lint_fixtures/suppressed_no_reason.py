@@ -1,0 +1,10 @@
+"""A suppression WITHOUT a reason: the TL002 finding still fires, and the
+bare suppression adds a TL000 on top — silent opt-outs cannot accumulate."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def unjustified(x):
+    return np.asarray(x)  # tracelint: disable=TL002

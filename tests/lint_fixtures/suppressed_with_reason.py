@@ -1,0 +1,11 @@
+"""Suppression fixtures: a reasoned suppression hides the finding; a
+bare one does not (and is itself a TL000 finding)."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def justified(x):
+    # the sync below is deliberate and explained: suppressed cleanly
+    return np.asarray(x)  # tracelint: disable=TL002 -- fixture: demonstrating a reasoned suppression

@@ -1,0 +1,45 @@
+"""TL001 negative: control flow that is fine under tracing — static
+arguments, shape/dtype facts, and plain host functions."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def branch_on_static(x, n):
+    if n > 2:  # n is static: concrete at trace time
+        return x * n
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("training",))
+def branch_on_static_name(x, training):
+    if training:  # static by name
+        return x * 2
+    return x
+
+
+@jax.jit
+def branch_on_shape(x):
+    if x.shape[0] > 4:  # shapes are static under tracing
+        return x[:4]
+    if x.ndim == 2 and len(x) > 0:  # so are ndim / len / isinstance
+        return x
+    assert x.dtype == jnp.float32  # and dtype facts
+    return x
+
+
+def host_function(x):
+    if x > 0:  # not traced: ordinary Python is ordinary Python
+        return x
+    return -x
+
+
+def scan_caller(xs):
+    def body(carry, x):
+        return carry + x, jnp.where(x > 0, x, carry)  # data-dependent via where
+
+    return lax.scan(body, 0.0, xs)

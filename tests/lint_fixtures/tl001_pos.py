@@ -1,0 +1,44 @@
+"""TL001 positive: Python control flow on traced parameters. Never
+executed — tracelint parses it; pytest ignores non-test_ files."""
+
+import functools
+
+import jax
+from jax import lax
+
+
+@jax.jit
+def branch_on_param(x):
+    if x > 0:  # branching on a tracer: ConcretizationTypeError at runtime
+        return x
+    return -x
+
+
+@jax.jit
+def loop_on_param(x):
+    while x.sum() < 10:  # while on a tracer
+        x = x + 1
+    return x
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def assert_on_traced(x, n):
+    assert x.mean() > 0  # assert on the TRACED arg (n is the static one)
+    return x * n
+
+
+def scan_caller(xs):
+    def body(carry, x):
+        if carry > 0:  # scan-body carry is always traced
+            carry = carry + x
+        return carry, carry
+
+    return lax.scan(body, 0.0, xs)
+
+
+@jax.jit
+def alias_flow(x):
+    y = x + 1  # y aliases a traced value...
+    if y.any():  # ...so branching on it is the same hazard
+        return y
+    return x

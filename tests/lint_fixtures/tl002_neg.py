@@ -1,0 +1,33 @@
+"""TL002 negative: host-side numpy on host data, and device work kept on
+device under tracing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def stays_on_device(x):
+    return jnp.asarray(x) + jnp.sum(x)  # jnp, not np: stays traced
+
+
+def host_prep(specs):
+    # np on host-side request data is ordinary batch assembly, not a sync
+    seeds = np.asarray([s.seed for s in specs], np.int32)
+    return np.stack([s.ids for s in specs]), seeds
+
+
+class Engine:
+    # tracelint: hotloop
+    def admit(self, spec):
+        # np.asarray on REQUEST data (not engine state) is host-side prep
+        text = np.asarray(spec.text_ids, np.int32)
+        return self.dispatch(text)
+
+
+def scan_caller(xs):
+    def body(carry, x):
+        return carry + x, carry
+
+    return lax.scan(body, 0.0, xs)

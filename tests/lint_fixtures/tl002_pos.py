@@ -1,0 +1,32 @@
+"""TL002 positive: device->host syncs under tracing and in hot loops."""
+
+import jax
+import numpy as np
+from jax import lax
+
+
+@jax.jit
+def sync_in_jit(x):
+    host = np.asarray(x)  # numpy inside jit: pulled off-device every call
+    return host.item()  # .item() is a sync
+
+
+@jax.jit
+def cast_in_jit(x):
+    return float(x.sum())  # float() concretizes the tracer
+
+
+def scan_with_sync(xs):
+    def body(carry, x):
+        return carry + x, x.tolist()  # .tolist() inside a scan body
+
+    return lax.scan(body, 0.0, xs)
+
+
+class Engine:
+    # tracelint: hotloop
+    def step(self):
+        pos = np.asarray(self._state["pos"])  # implicit sync on engine state
+        jax.device_get(self._state)  # explicit sync, still needs a reason
+        self._state["row"].block_until_ready()  # stall in the hot loop
+        return pos

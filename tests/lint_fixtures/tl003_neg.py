@@ -1,0 +1,47 @@
+"""TL003 negative: the correct donation idioms — rebind the reference,
+or read only the dispatch's return value."""
+
+import jax
+
+
+def _chunk_builder(model, key):
+    def fn(state):
+        return state
+
+    return fn
+
+
+_chunk_builder._donate_argnums = (0,)
+
+
+def _jit_sample(builder, model, key, *args):
+    return builder(model, key)(*args)
+
+
+def chunk(state):
+    return _jit_sample(_chunk_builder, None, (), state)
+
+
+step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+
+def rebind_is_fine(state):
+    state = chunk(state)  # the PR-2 engine idiom: replace the reference
+    return state["img_pos"]  # reads the NEW state
+
+
+def read_result_only(state):
+    new = step(state)
+    return new["row"]  # only the return value is touched
+
+
+def fresh_binding_after(state):
+    _ = chunk(state)
+    state = {"img_pos": 0}  # rebound to a fresh object
+    return state["img_pos"]
+
+
+def undonated_call_is_fine(state):
+    probe = len(state)  # reads before the dispatch are fine
+    new = chunk(state)
+    return new, probe

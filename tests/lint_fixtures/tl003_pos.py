@@ -1,0 +1,41 @@
+"""TL003 positive: donated buffers read after the donating dispatch."""
+
+import jax
+
+
+def _chunk_builder(model, key):
+    def fn(state):
+        return state
+
+    return fn
+
+
+_chunk_builder._donate_argnums = (0,)
+
+
+def _jit_sample(builder, model, key, *args):
+    return builder(model, key)(*args)
+
+
+def chunk(state):
+    # wrapper donating its own param via the builder dispatch idiom
+    return _jit_sample(_chunk_builder, None, (), state)
+
+
+step = jax.jit(lambda s: s, donate_argnums=(0,))
+
+
+def read_after_wrapper_donation(state):
+    new = chunk(state)  # state's buffers are donated here...
+    pos = state["img_pos"]  # ...so this reads an invalidated buffer
+    return new, pos
+
+
+def read_after_jit_donation(state):
+    out = step(state)  # direct jax.jit(donate_argnums=...) dispatch
+    return out, state["row"]  # read of the donated arg
+
+
+def donate_then_return(state):
+    _ = chunk(state)
+    return state  # returning the dead buffer is a read too

@@ -1,0 +1,48 @@
+"""TL004 negative: correct key hygiene — split/fold_in between draws,
+and per-scope single use."""
+
+import jax
+
+
+def split_between(rng):
+    rng, sub = jax.random.split(rng)
+    a = jax.random.normal(sub, (3,))
+    rng, sub = jax.random.split(rng)  # rng rebound by the split
+    b = jax.random.uniform(sub, (3,))
+    return a + b
+
+
+def fold_in_between(rng):
+    a = jax.random.normal(jax.random.fold_in(rng, 0), (3,))
+    b = jax.random.normal(jax.random.fold_in(rng, 1), (3,))  # distinct streams
+    return a + b
+
+
+def rebind_fresh(rng):
+    x = jax.random.normal(rng, (2,))
+    rng = jax.random.PRNGKey(7)  # brand-new key, not a reuse
+    y = jax.random.normal(rng, (2,))
+    return x, y
+
+
+def numpy_random_is_not_a_key_api(mu):
+    import numpy as np
+
+    a = np.random.normal(mu, 0.1)  # first arg is a mean, not a PRNG key
+    b = np.random.normal(mu, 0.2)
+    return a + b
+
+
+def loop_target_is_fresh(rng):
+    keys = jax.random.split(rng, 4)
+    out = []
+    for key in keys:  # each iteration binds a fresh key: the standard idiom
+        out.append(jax.random.normal(key, (2,)))
+    return out
+
+
+def single_use_per_scope(rng):
+    def inner(key):
+        return jax.random.gumbel(key, (2,))  # its own scope, its own use
+
+    return jax.random.normal(rng, (2,)) + inner(rng)

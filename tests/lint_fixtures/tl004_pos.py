@@ -1,0 +1,23 @@
+"""TL004 positive: one PRNG key feeding two draws with no split between."""
+
+import jax
+
+
+def double_draw(rng):
+    a = jax.random.normal(rng, (3,))
+    b = jax.random.uniform(rng, (3,))  # same key: a and b are correlated
+    return a + b
+
+
+def reuse_after_derive(rng):
+    child = jax.random.fold_in(rng, 1)
+    noise = jax.random.gumbel(child, (4,))
+    more = jax.random.gumbel(child, (4,))  # child consumed twice
+    return noise + more
+
+
+def reuse_fresh_key():
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (2,))
+    y = jax.random.bernoulli(key, 0.5, (2,))  # PRNGKey(0) drawn twice
+    return x, y

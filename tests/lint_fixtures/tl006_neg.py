@@ -1,0 +1,19 @@
+"""TL006 negative: identifiers that merely resemble debugger calls."""
+
+
+def first(items):
+    return items[0]
+
+
+def not_a_debugger(self_test):
+    # `st` with arguments is some function named st, not the ipdb alias;
+    # mentioning breakpoint in a string or comment is documentation
+    result = list(range(3))
+    note = "never ship a breakpoint() call"
+    stats = {"st": 1}
+    return self_test(result), note, stats
+
+
+class Stage:
+    def st(self, x):  # a method named st is fine
+        return x

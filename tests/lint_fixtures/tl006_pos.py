@@ -1,0 +1,18 @@
+"""TL006 positive: debugger artifacts — the reference repo's import-time
+breakpoint regression (SURVEY.md §0). Parsed, never imported."""
+
+import ipdb
+
+
+def hung_on_import():
+    ipdb.set_trace()
+
+
+def forgotten_breakpoint(x):
+    breakpoint()
+    return x
+
+
+def st_alias(x):
+    st()
+    return x

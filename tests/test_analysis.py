@@ -1,0 +1,344 @@
+"""tracelint: rule-pack coverage over the fixture corpus, suppression and
+baseline workflows, the CLI contract, and the package-stays-clean gate.
+
+Each rule TL001-TL006 is pinned by a positive fixture it must catch and a
+negative fixture it must ignore (tests/lint_fixtures/). The package gate
+at the bottom is the acceptance criterion: the shipped baseline is empty
+and `python -m dalle_pytorch_tpu.analysis` exits 0 over the package.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from dalle_pytorch_tpu.analysis import PACKAGE_DIR, lint_paths
+from dalle_pytorch_tpu.analysis.baseline import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    write_baseline,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+# ------------------------------------------------------------ rule corpus
+
+
+class TestRuleCorpus:
+    """Every rule: the positive fixture trips it, the negative doesn't."""
+
+    @pytest.mark.parametrize(
+        "fixture, code, expected",
+        [
+            ("tl001_pos.py", "TL001", 5),
+            ("tl002_pos.py", "TL002", 7),
+            ("tl003_pos.py", "TL003", 3),
+            ("tl004_pos.py", "TL004", 3),
+            ("models/tl005_pos.py", "TL005", 3),
+            ("tl006_pos.py", "TL006", 4),
+        ],
+    )
+    def test_positive_fixture_caught(self, fixture, code, expected):
+        result = lint_paths([FIXTURES / fixture])
+        got = codes(result)
+        assert got.count(code) == expected, (
+            f"{fixture}: expected {expected} {code} findings, got {got}"
+        )
+        assert all(c == code for c in got), (
+            f"{fixture}: unexpected extra findings {got}"
+        )
+
+    @pytest.mark.parametrize(
+        "fixture",
+        [
+            "tl001_neg.py",
+            "tl002_neg.py",
+            "tl003_neg.py",
+            "tl004_neg.py",
+            "models/tl005_neg.py",
+            "tl006_neg.py",
+        ],
+    )
+    def test_negative_fixture_clean(self, fixture):
+        result = lint_paths([FIXTURES / fixture])
+        assert result.clean, (
+            f"{fixture} should be clean, got: "
+            + "; ".join(f.render() for f in result.findings)
+        )
+
+    def test_tl005_scoped_to_models_and_ops(self, tmp_path):
+        """The same dtype-less constructor outside models/ or ops/ is out
+        of the precision-discipline scope."""
+        f = tmp_path / "elsewhere.py"
+        f.write_text(
+            "import jax.numpy as jnp\n\ndef g(n):\n    return jnp.zeros(n)\n"
+        )
+        assert lint_paths([f]).clean
+
+    def test_tl006_message_points_at_survey(self):
+        result = lint_paths([FIXTURES / "tl006_pos.py"])
+        assert all("SURVEY.md" in f.message for f in result.findings)
+
+
+# ------------------------------------------------------------ suppressions
+
+
+class TestSuppressions:
+    def test_reasoned_suppression_hides_finding(self):
+        result = lint_paths([FIXTURES / "suppressed_with_reason.py"])
+        assert result.clean
+        assert len(result.suppressed) == 1
+        finding, sup = result.suppressed[0]
+        assert finding.rule == "TL002"
+        assert "reasoned suppression" in sup.reason
+
+    def test_bare_suppression_rejected(self):
+        result = lint_paths([FIXTURES / "suppressed_no_reason.py"])
+        got = sorted(codes(result))
+        assert got == ["TL000", "TL002"], got  # finding stays + TL000 on top
+
+    def test_standalone_suppression_covers_next_line(self, tmp_path):
+        f = tmp_path / "standalone.py"
+        f.write_text(textwrap.dedent(
+            """\
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def g(x):
+                # tracelint: disable=TL002 -- fixture: standalone line covers the next line
+                return np.asarray(x)
+            """
+        ))
+        result = lint_paths([f])
+        assert result.clean and len(result.suppressed) == 1
+
+    def test_tl006_has_no_opt_out(self, tmp_path):
+        """A debugger artifact cannot be suppressed away — the regex scan
+        this rule replaced had no opt-out, and neither does TL006."""
+        f = tmp_path / "sneaky.py"
+        f.write_text(
+            "def g():\n"
+            "    breakpoint()  # tracelint: disable=TL006 -- just debugging\n"
+        )
+        assert codes(lint_paths([f])) == ["TL006"]
+
+    def test_suppression_is_per_rule(self, tmp_path):
+        """A TL002 suppression does not silence a TL001 on the same line."""
+        f = tmp_path / "wrongcode.py"
+        f.write_text(textwrap.dedent(
+            """\
+            import jax
+
+            @jax.jit
+            def g(x):
+                if x > 0:  # tracelint: disable=TL002 -- fixture: wrong rule code
+                    return x
+                return -x
+            """
+        ))
+        assert codes(lint_paths([f])) == ["TL001"]
+
+
+# ---------------------------------------------------- cross-file donation
+
+
+def test_donation_contract_crosses_files(tmp_path):
+    """The donation registry is package-wide: a wrapper whose builder tag
+    lives in another file still poisons its argument at the call site —
+    the serving-engine-vs-models/dalle.py split."""
+    (tmp_path / "dispatch.py").write_text(textwrap.dedent(
+        """\
+        def _chunk_builder(model, key):
+            def fn(state):
+                return state
+            return fn
+
+        _chunk_builder._donate_argnums = (0,)
+
+        def _jit_sample(builder, model, key, *args):
+            return builder(model, key)(*args)
+
+        def chunk(state):
+            return _jit_sample(_chunk_builder, None, (), state)
+        """
+    ))
+    (tmp_path / "caller.py").write_text(textwrap.dedent(
+        """\
+        from dispatch import chunk
+
+        def serve(state):
+            new = chunk(state)
+            return state["img_pos"]
+        """
+    ))
+    result = lint_paths([tmp_path])
+    assert codes(result) == ["TL003"]
+    assert result.findings[0].path.endswith("caller.py")
+
+
+def test_donate_argnames_resolves_to_positions(tmp_path):
+    """`jax.jit(f, donate_argnames=('state',))` donates by NAME; the
+    registry resolves it through the wrapped def's parameter list."""
+    f = tmp_path / "named.py"
+    f.write_text(textwrap.dedent(
+        """\
+        import jax
+
+        def _dispatch(params, state):
+            return state
+
+        g = jax.jit(_dispatch, donate_argnames=("state",))
+
+        def serve(params, state):
+            out = g(params, state)
+            return out, state["row"]
+        """
+    ))
+    assert codes(lint_paths([f])) == ["TL003"]
+
+
+# ---------------------------------------------------------------- baseline
+
+
+class TestBaseline:
+    def test_grandfather_then_clean(self, tmp_path):
+        """write-baseline grandfathers today's findings; the next run is
+        clean; a NEW finding still fails."""
+        target = FIXTURES / "tl006_pos.py"
+        first = lint_paths([target])
+        assert not first.clean
+
+        bl = tmp_path / "baseline.json"
+        write_baseline(bl, first.findings)
+        prints = load_baseline(bl)
+        again = lint_paths([target], baseline_fingerprints=prints)
+        assert again.clean
+        assert len(again.baselined) == len(first.findings)
+
+        fresh = tmp_path / "fresh.py"
+        fresh.write_text("import ipdb\n")
+        third = lint_paths([target, fresh], baseline_fingerprints=prints)
+        assert codes(third) == ["TL006"]
+        assert third.findings[0].path.endswith("fresh.py")
+
+    def test_fingerprint_survives_line_drift(self, tmp_path):
+        """Fingerprints key on content, not line numbers: edits above a
+        grandfathered finding don't resurrect it."""
+        f = tmp_path / "drift.py"
+        f.write_text("import ipdb\n")
+        before = lint_paths([f]).findings[0].fingerprint()
+        f.write_text("'''new docstring'''\nX = 1\n\nimport ipdb\n")
+        after = lint_paths([f]).findings[0].fingerprint()
+        assert before == after
+
+    def test_duplicate_line_is_still_new(self, tmp_path):
+        """Occurrence-aware fingerprints: adding a SECOND copy of an
+        already-grandfathered line is a new finding, not a baseline hit
+        (caught live while driving the CLI)."""
+        f = tmp_path / "dup.py"
+        f.write_text("def a():\n    breakpoint()\n")
+        bl = tmp_path / "bl.json"
+        write_baseline(bl, lint_paths([f]).findings)
+        f.write_text(
+            "def a():\n    breakpoint()\n\ndef b():\n    breakpoint()\n"
+        )
+        result = lint_paths([f], baseline_fingerprints=load_baseline(bl))
+        assert codes(result) == ["TL006"]
+        assert len(result.baselined) == 1
+
+    def test_fingerprint_is_cwd_independent(self, tmp_path, monkeypatch):
+        """Fingerprints key on root-relative paths, not the invocation
+        directory — a baseline written from repo root still matches when
+        CI lints from somewhere else."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "mod.py").write_text("import ipdb\n")
+        monkeypatch.chdir(tmp_path)
+        fp_here = lint_paths([pkg]).findings[0].fingerprint()
+        monkeypatch.chdir(pkg)
+        fp_there = lint_paths([pkg]).findings[0].fingerprint()
+        assert fp_here == fp_there
+
+    def test_write_baseline_needs_explicit_target_for_paths(self, tmp_path, capsys):
+        """--write-baseline over explicit paths must not silently
+        overwrite the shipped package baseline."""
+        from dalle_pytorch_tpu.analysis import main
+
+        f = tmp_path / "x.py"
+        f.write_text("import ipdb\n")
+        assert main([str(f), "--write-baseline"]) == 2
+        assert "--baseline" in capsys.readouterr().err
+        assert load_baseline(DEFAULT_BASELINE) == set()  # untouched
+
+    def test_shipped_baseline_is_empty(self):
+        """Acceptance: no grandfathered findings ship — every kept hazard
+        carries an inline reasoned suppression instead."""
+        assert load_baseline(DEFAULT_BASELINE) == set()
+
+
+# --------------------------------------------------------------- CLI gate
+
+
+class TestCLI:
+    """Exit-code/format contracts via in-process `main(argv)` (same code
+    path as the console script); one real subprocess pins the
+    `python -m dalle_pytorch_tpu.analysis` module entry itself."""
+
+    def test_module_entry_zero_on_clean_package(self):
+        """The package itself lints clean through the real CLI — the
+        zero-baseline acceptance criterion, enforced in-suite so a hazard
+        can't land silently."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "dalle_pytorch_tpu.analysis"],
+            capture_output=True, text=True, timeout=240,
+        )
+        assert proc.returncode == 0, (
+            "package no longer lints clean:\n" + proc.stdout + proc.stderr
+        )
+
+    def test_nonzero_on_seeded_fixture_with_json(self, capsys):
+        from dalle_pytorch_tpu.analysis import main
+
+        rc = main([str(FIXTURES / "tl006_pos.py"), "--format", "json"])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["findings"] and all(
+            f["rule"] == "TL006" for f in payload["findings"]
+        )
+
+    def test_select_restricts_rules(self):
+        from dalle_pytorch_tpu.analysis import main
+
+        assert main([str(FIXTURES / "tl001_pos.py"), "--select", "TL006"]) == 0
+
+    def test_unknown_rule_code_is_usage_error(self):
+        from dalle_pytorch_tpu.analysis import main
+
+        assert main(["--select", "TL999"]) == 2
+
+    def test_missing_path_is_usage_error(self, capsys):
+        """A typo'd CI path must fail loudly, not lint nothing and pass."""
+        from dalle_pytorch_tpu.analysis import main
+
+        assert main(["no/such/dir"]) == 2
+        assert "no such file" in capsys.readouterr().err.lower()
+
+
+def test_package_lint_inprocess_fast_gate():
+    """Same gate as the CLI test but in-process (no subprocess import
+    cost): the shipped package has zero findings and every suppression
+    carries a reason."""
+    result = lint_paths([PACKAGE_DIR])
+    assert result.clean, "package findings:\n" + "\n".join(
+        f.render() for f in result.findings
+    )
+    assert all(sup.reason for _, sup in result.suppressed)

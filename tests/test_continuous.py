@@ -221,6 +221,42 @@ class TestContinuousEngine:
         pos, act = cont.step_chunk(_warmup=True)
         assert not act.any() and (pos == 0).all()
 
+    def test_steady_state_compiles_nothing_after_warmup(self):
+        """Warmup compiles the full fixed-shape program set (prefill,
+        chunk, release, pixel decode); a post-warmup serve cycle — admit,
+        chunk to completion, mid-flight admission, harvest, release — must
+        hit only the compile cache. Guarded by the jax.monitoring-based
+        `assert_no_recompiles`, which counts every backend compilation
+        including first-execution compiles of stray eager ops."""
+        from dalle_pytorch_tpu.utils import assert_no_recompiles
+
+        _, cont = _build()
+        cont.warmup()
+        with assert_no_recompiles() as tally:
+            cont.prefill_slot(0, spec(11))
+            cont.step_chunk()
+            cont.prefill_slot(1, spec(22))  # mid-flight admission
+            _drain(cont)
+            toks = cont.harvest([0, 1])
+            cont.release([0, 1])
+        assert tally.count == 0
+        assert toks.shape == (2, IMG_SEQ)
+
+    def test_recompile_guard_catches_new_shape(self):
+        """The guard actually fires: a fresh batch shape inside the block
+        is a compile, and the error names the compile event."""
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.utils import RecompileError, assert_no_recompiles
+
+        f = jax.jit(lambda x: x * 2)
+        f(jnp.ones((3,)))  # warm one shape
+        with assert_no_recompiles():
+            f(jnp.ones((3,)))  # cache hit: fine
+        with pytest.raises(RecompileError, match="compiled"):
+            with assert_no_recompiles():
+                f(jnp.ones((5,)))  # new shape -> new program
+
     def test_cond_scale_rejected(self):
         micro, _ = _build()
         with pytest.raises(AssertionError, match="cond_scale"):

@@ -2,40 +2,25 @@
 
 The reference codebase shipped live import-time breakpoints — `import ipdb;
 st()` at module scope (SURVEY.md §0) — which turn any import into a hung
-process. This lint fails the suite if `ipdb`, `breakpoint()`, or the
-`st()` alias appears anywhere under `dalle_pytorch_tpu/`, so the same
-regression can never land here.
+process. This check used to be a regex scan; it is now a thin shim over
+tracelint's TL006 rule (`dalle_pytorch_tpu/analysis/`), which parses the
+AST instead of pattern-matching lines: strings and comments mentioning
+`breakpoint()` no longer need carve-outs, and `.set_trace()` is covered
+too. The suite still fails with the same SURVEY.md §0 message.
 """
 
-import re
 from pathlib import Path
 
-PACKAGE = Path(__file__).resolve().parent.parent / "dalle_pytorch_tpu"
+from dalle_pytorch_tpu.analysis import lint_paths
 
-# \b keeps identifiers like `list(` or `self.first(` from matching st(
-PATTERNS = {
-    "ipdb import": re.compile(r"\bipdb\b"),
-    "breakpoint() call": re.compile(r"\bbreakpoint\s*\("),
-    "st() debugger alias": re.compile(r"\bst\s*\(\s*\)"),
-}
+PACKAGE = Path(__file__).resolve().parent.parent / "dalle_pytorch_tpu"
 
 
 def test_no_debugger_artifacts_in_package():
     assert PACKAGE.is_dir(), f"package dir moved? {PACKAGE}"
-    offenders = []
-    for path in sorted(PACKAGE.rglob("*.py")):
-        for lineno, line in enumerate(
-            path.read_text(encoding="utf-8").splitlines(), start=1
-        ):
-            stripped = line.split("#", 1)[0]  # commented-out code is noise
-            for what, pat in PATTERNS.items():
-                if pat.search(stripped):
-                    offenders.append(
-                        f"{path.relative_to(PACKAGE.parent)}:{lineno}: "
-                        f"{what}: {line.strip()}"
-                    )
-    assert not offenders, (
+    result = lint_paths([PACKAGE], select={"TL006"})
+    assert result.clean, (
         "debugger artifacts in shipped code (the reference repo's "
         "import-time-breakpoint regression, SURVEY.md §0):\n"
-        + "\n".join(offenders)
+        + "\n".join(f.render() for f in result.findings)
     )
