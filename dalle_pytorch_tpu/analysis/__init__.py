@@ -3,7 +3,8 @@
 Catches the hazard classes the serving/training stack's performance story
 depends on keeping out — recompilation (TL001), hidden host syncs (TL002),
 donated-buffer reuse (TL003), PRNG key reuse (TL004), dtype drift (TL005),
-and debugger artifacts (TL006) — before they ship. Run it with
+debugger artifacts (TL006), and scan-body host-constant captures (TL007)
+— before they ship. Run it with
 
     python -m dalle_pytorch_tpu.analysis        # or: dalle-tpu-lint
 

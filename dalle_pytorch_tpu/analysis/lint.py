@@ -5,6 +5,7 @@ Usage (CLI is also installed as `dalle-tpu-lint`):
     python -m dalle_pytorch_tpu.analysis                      # lint the package
     python -m dalle_pytorch_tpu.analysis path/ other.py       # explicit paths
     python -m dalle_pytorch_tpu.analysis --format json
+    python -m dalle_pytorch_tpu.analysis --format github   # CI annotations
     python -m dalle_pytorch_tpu.analysis --select TL003,TL006
     python -m dalle_pytorch_tpu.analysis --write-baseline     # grandfather
 
@@ -159,6 +160,35 @@ def _render_text(result: LintResult) -> str:
     return "\n".join(out)
 
 
+def _gh_escape(text: str, is_property: bool = False) -> str:
+    """GitHub Actions workflow-command escaping: % first (it is the escape
+    introducer), then newlines; property values additionally escape the
+    delimiters `:` and `,`."""
+    out = text.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    if is_property:
+        out = out.replace(":", "%3A").replace(",", "%2C")
+    return out
+
+
+def _render_github(result: LintResult) -> str:
+    """One `::error` workflow command per finding — GitHub Actions renders
+    them as inline annotations on the PR diff — plus the human summary
+    line (not a command, so it lands in the raw log only)."""
+    out: List[str] = []
+    for f in result.findings:
+        out.append(
+            f"::error file={_gh_escape(f.path, True)},"
+            f"line={f.line},"
+            f"title={_gh_escape(f'tracelint {f.rule}', True)}"
+            f"::{_gh_escape(f.message)}"
+        )
+    out.append(
+        f"tracelint: {len(result.findings)} finding(s) over "
+        f"{result.files_checked} file(s)"
+    )
+    return "\n".join(out)
+
+
 def _render_json(result: LintResult) -> str:
     return json.dumps(
         {
@@ -187,7 +217,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help=f"files/dirs to lint (default: the installed package, {PACKAGE_DIR})",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text"
+        "--format", choices=("text", "json", "github"), default="text",
+        help="github emits ::error workflow commands so CI review shows "
+        "findings as inline annotations",
     )
     parser.add_argument(
         "--select", default=None, metavar="TLxxx[,TLxxx...]",
@@ -258,7 +290,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         return 0
 
-    print(_render_text(result) if args.format == "text" else _render_json(result))
+    renderer = {
+        "text": _render_text,
+        "json": _render_json,
+        "github": _render_github,
+    }[args.format]
+    print(renderer(result))
     return 0 if result.clean else 1
 
 

@@ -25,6 +25,12 @@ TL005  dtype-less `jnp.array`/`jnp.zeros`/`jnp.ones` in `models/` and
 TL006  debugger artifacts (`import ipdb`, `breakpoint()`, `st()`,
        `.set_trace()`): the reference codebase shipped an import-time
        breakpoint (SURVEY.md §0); any import became a hung process.
+TL007  `jnp.asarray`/`jnp.array` of a LARGE host constant inside a
+       `lax.scan` body: the constant is captured into the trace, re-staged
+       (device upload + program bloat) on every retrace instead of living
+       once outside the loop. Size heuristic (estimated element count from
+       the numpy constructor expression or a module-level constant) keeps
+       small iotas/eye-size constants out of the findings.
 """
 
 from __future__ import annotations
@@ -41,6 +47,7 @@ from dalle_pytorch_tpu.analysis.jaxctx import (
     propagate_traced,
     terminal_name,
     _assign_targets,
+    _int_elements,
 )
 
 _ALL_FUNCS = FunctionNode + (ast.Lambda,)
@@ -506,6 +513,125 @@ class DebuggerArtifactRule(Rule):
                     )
 
 
+#: numpy constructors whose element count is the product of their shape arg
+_NP_SHAPE_CTORS = {"zeros", "ones", "empty", "full"}
+#: numpy wrappers that preserve their (first) argument's element count
+_NP_SIZE_PRESERVING = {"asarray", "ascontiguousarray", "tril", "triu", "copy"}
+
+
+class ScanConstUploadRule(Rule):
+    code = "TL007"
+    name = "scan-const-upload"
+    description = (
+        "jnp.asarray/jnp.array of a large host constant inside a lax.scan "
+        "body — captured into the trace and re-staged on every retrace; "
+        "hoist it out of the body"
+    )
+
+    #: estimated element count at or above which the capture is flagged
+    #: (~8 KB of fp32 — below that the program-constant cost is noise)
+    MIN_ELEMENTS = 2048
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        index = _jax_index(ctx)
+        consts = self._module_const_sizes(ctx.tree)
+        for func, info in index.traced.items():
+            if info.kind != "scan":
+                continue
+            for node in _walk_shallow(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = dotted_name(node.func) or ""
+                if dotted not in ("jnp.asarray", "jnp.array"):
+                    continue
+                if not node.args:
+                    continue
+                size = self._const_size(node.args[0], consts)
+                if size is not None and size >= self.MIN_ELEMENTS:
+                    yield ctx.finding(
+                        self.code,
+                        node,
+                        f"`{dotted}` of a host constant (~{size} elements) "
+                        "inside a lax.scan body — it is re-staged into the "
+                        "program on every trace; build it once outside the "
+                        "body and close over the device array",
+                    )
+
+    @staticmethod
+    def _module_const_sizes(tree: ast.Module) -> Dict[str, int]:
+        """Module-level `NAME = <numpy constructor expr>` bindings whose
+        element count is estimable (the only cross-scope lookup: a scan
+        body wrapping a module constant is exactly the hazard)."""
+        sizes: Dict[str, int] = {}
+        for stmt in tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            size = ScanConstUploadRule._const_size(stmt.value, {})
+            if size is None:
+                continue
+            for t in stmt.targets:
+                if isinstance(t, ast.Name):
+                    sizes[t.id] = size
+        return sizes
+
+    @staticmethod
+    def _const_size(node: ast.AST, consts: Dict[str, int]) -> Optional[int]:
+        """Estimated element count of a host-constant expression, or None
+        when the expression is not recognizably a sized numpy constant
+        (false-negative bias: unknown means silent, like the rest of the
+        rule pack)."""
+        rec = ScanConstUploadRule._const_size
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        if isinstance(node, (ast.Compare, ast.BinOp)):
+            # broadcasting lower bound: the result is at least as large as
+            # its largest sized operand (`np.arange(V) < k`)
+            parts = (
+                [node.left] + list(node.comparators)
+                if isinstance(node, ast.Compare)
+                else [node.left, node.right]
+            )
+            sizes = [s for s in (rec(p, consts) for p in parts) if s is not None]
+            return max(sizes) if sizes else None
+        if not isinstance(node, ast.Call):
+            return None
+        dotted = dotted_name(node.func) or ""
+        parts = dotted.split(".")
+        if len(parts) != 2 or parts[0] not in ("np", "numpy"):
+            return None
+        ctor = parts[1]
+        if ctor == "arange":
+            if len(node.args) == 1:
+                vals = _int_elements(node.args[0])
+                return vals[0] if len(vals) == 1 else None
+            if len(node.args) >= 2:
+                lo = _int_elements(node.args[0])
+                hi = _int_elements(node.args[1])
+                if len(lo) != 1 or len(hi) != 1:
+                    return None
+                span = max(hi[0] - lo[0], 0)
+                if len(node.args) < 3:
+                    return span
+                # strided arange: hi-lo alone would overcount by the step
+                # factor and flag small constants (false-positive — the
+                # pack's bias is the other way)
+                step = _int_elements(node.args[2])
+                if len(step) == 1 and step[0] > 0:
+                    return -(-span // step[0])
+            return None
+        if ctor in _NP_SHAPE_CTORS and node.args:
+            dims = _int_elements(node.args[0])
+            if dims:
+                size = 1
+                for d in dims:
+                    size *= d
+                return size
+            return None
+        if ctor in _NP_SIZE_PRESERVING and node.args:
+            return rec(node.args[0], consts)
+        return None
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -513,4 +639,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     KeyReuseRule(),
     DtypeDriftRule(),
     DebuggerArtifactRule(),
+    ScanConstUploadRule(),
 )

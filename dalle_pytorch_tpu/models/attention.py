@@ -21,7 +21,11 @@ Semantics preserved from the reference:
 The decode-time KV cache is a fixed-shape pytree {k, v, index} with k/v of
 shape [B, heads, max_len, dim_head]; causality during cached decode is
 enforced by masking positions > index (the reference instead relies on only
-having written the prefix, `attention.py:71-76,86`).
+having written the prefix, `attention.py:71-76,86`). The cached path has its
+own kernel dispatch (`_use_flash_decode`): the Pallas flash-decode kernel
+(ops/pallas_decode.py) reads only each row's live KV blocks — per-row
+`index` included, the continuous-batching slot cache — with dense attention
+over the whole cache as the fallback for pattern masks and small caches.
 """
 
 from __future__ import annotations
@@ -39,16 +43,33 @@ from dalle_pytorch_tpu.ops.pallas_attention import (
     flash_attention,
     lib_flash_attention,
 )
+from dalle_pytorch_tpu.ops.pallas_decode import flash_decode_attention
 from dalle_pytorch_tpu.ops.rotary import apply_rotary
 
 # Sequence length at or above which `attn_impl="auto"` switches from the
 # dense einsum to the Pallas flash kernel (O(N) memory vs dense's O(N^2)
-# score tensors). 2048 is a conservative UNMEASURED default: the round-3
-# HBM analysis (BASELINE.md) suggests flash wins already at the flagship's
-# 1280, but until the on-chip A/B (`scripts/pallas_onchip.py`) lands the
-# auto path stays dense there and flash is selected explicitly
-# (model.attn_impl=flash / the bench's fastest profile).
-AUTO_FLASH_MIN_SEQ = 2048
+# score tensors). MEASURED default (scripts/flash_crossover.py, recorded in
+# BASELINE.md §flash-crossover): on the v5e roofline over compiled-program
+# cost analysis, dense attention is bandwidth-bound from seq 256 up (score
+# chain 212 MB @256 → 4.5 GB @1280 vs flash's tiled 10→137 MB), but
+# op-level counting can't resolve the sub-1k region (fusion may keep short
+# score chains out of HBM), so the default is the largest bench-grid point
+# that still auto-selects flash for the flagship 1280 — where the r3 HBM
+# analysis, this measurement, and the r4 hardware run (flash wall == dense
+# even under dispatch overhead) all agree. Overridable per model
+# (attn_impl=) or by rebinding this constant; the on-chip wall-clock A/B
+# (`scripts/pallas_onchip.py`) stays armed as the final decider.
+AUTO_FLASH_MIN_SEQ = 1024
+
+# Cache length at or above which `attn_impl="auto"` runs the CACHED decode
+# path through the Pallas flash-decode kernel (ops/pallas_decode.py) instead
+# of dense attention over the whole [B, H, max_len, D] cache. MEASURED
+# (same script/table): one decode step's K/V reads cross at max_len 512 —
+# below it the per-kernel overhead charge beats the saved reads at expected
+# live length max_len/2; at the flagship cache (1281) flash-decode halves
+# the average K/V reads and cuts them ~3x for a freshly-admitted
+# continuous-batching slot still at its text prefix.
+AUTO_FLASH_DECODE_MIN_LEN = 512
 
 
 def _cache_write(buf: jnp.ndarray, val: jnp.ndarray, index) -> jnp.ndarray:
@@ -107,6 +128,22 @@ class Attention(nn.Module):
         if self.attn_impl == "dense" or key_mask is not None:
             return False
         return n >= AUTO_FLASH_MIN_SEQ
+
+    def _use_flash_decode(self, max_len: int, has_pattern: bool) -> bool:
+        """Cached-path dispatch: flash-decode reads only each row's live KV
+        blocks (ops/pallas_decode.py); dense reads the whole cache. Pattern
+        masks (static or traced) fall back to dense — a per-step row-sliced
+        mask cannot drive the kernel's block skip. `attn_impl="flash"`
+        forces the kernel; "auto" switches on cache length;
+        "dense"/"lib_flash"/"ring" stay dense (the library kernel has no
+        decode analog, and ring is a training-time layout)."""
+        if has_pattern:
+            return False
+        if self.attn_impl == "flash":
+            return True
+        if self.attn_impl == "auto":
+            return max_len >= AUTO_FLASH_DECODE_MIN_LEN
+        return False
 
     def _full_mask(self, n_q: int, n_k: int) -> Optional[np.ndarray]:
         """Host-side composition of causal + static masks, cropped."""
@@ -175,46 +212,62 @@ class Attention(nn.Module):
             ck = _cache_write(cache["k"], k, index)
             cv = _cache_write(cache["v"], v, index)
             max_len = ck.shape[2]
-            # query row i sits at global position index + i: causal over the
-            # written prefix (the reference instead relies on only having
-            # written the prefix, `attention.py:71-76,86`)
-            if per_row:
-                valid = (
-                    jnp.arange(max_len)[None, None, :]
-                    <= index[:, None, None] + jnp.arange(n)[None, :, None]
-                )
-                mask = valid[:, None]  # [B,1,n,max_len]
+            if self._use_flash_decode(
+                max_len,
+                has_pattern=(
+                    self.static_mask is not None or mask_array is not None
+                ),
+            ):
+                # per-row live length = cache index + this chunk; the kernel
+                # applies the same causal-over-prefix mask the dense branch
+                # builds below, but reads ONLY each row's live K/V blocks
+                # (scalar index = lockstep decode: every row at one length)
+                lengths = jnp.broadcast_to(index + n, (b,)).astype(jnp.int32)
+                out = flash_decode_attention(q, ck, cv, lengths)
             else:
-                valid = (
-                    jnp.arange(max_len)[None, :]
-                    <= index + jnp.arange(n)[:, None]
-                )
-                mask = valid[None, None]
-            def mask_rows_at(pm):
-                # pad to max_len with True (decode caches may be 1 longer
-                # than the mask), then row-slice at the decode position —
-                # shared by the host-side static_mask and the scan
-                # executor's traced mask_array so the two paths cannot
-                # drift
-                if pm.shape[0] < max_len:
-                    pad = max_len - pm.shape[0]
-                    pm = jnp.pad(pm, ((0, pad), (0, pad)), constant_values=True)
-                pm = pm[:, :max_len]
+                # query row i sits at global position index + i: causal over
+                # the written prefix (the reference instead relies on only
+                # having written the prefix, `attention.py:71-76,86`)
                 if per_row:
-                    return jax.vmap(
-                        lambda i: lax.dynamic_slice_in_dim(pm, i, n, axis=0)
-                    )(index)[:, None]  # [B,1,n,max_len]
-                return lax.dynamic_slice_in_dim(pm, index, n, axis=0)[
-                    None, None
-                ]
+                    valid = (
+                        jnp.arange(max_len)[None, None, :]
+                        <= index[:, None, None] + jnp.arange(n)[None, :, None]
+                    )
+                    mask = valid[:, None]  # [B,1,n,max_len]
+                else:
+                    valid = (
+                        jnp.arange(max_len)[None, :]
+                        <= index + jnp.arange(n)[:, None]
+                    )
+                    mask = valid[None, None]
 
-            if self.static_mask is not None:
-                mask = mask & mask_rows_at(
-                    jnp.asarray(np.asarray(self.static_mask))
-                )
-            if mask_array is not None:
-                mask = mask & mask_rows_at(mask_array)
-            out = dense_attention(q, ck, cv, mask=mask, stable=self.stable)
+                def mask_rows_at(pm):
+                    # pad to max_len with True (decode caches may be 1
+                    # longer than the mask), then row-slice at the decode
+                    # position — shared by the host-side static_mask and
+                    # the scan executor's traced mask_array so the two
+                    # paths cannot drift
+                    if pm.shape[0] < max_len:
+                        pad = max_len - pm.shape[0]
+                        pm = jnp.pad(
+                            pm, ((0, pad), (0, pad)), constant_values=True
+                        )
+                    pm = pm[:, :max_len]
+                    if per_row:
+                        return jax.vmap(
+                            lambda i: lax.dynamic_slice_in_dim(pm, i, n, axis=0)
+                        )(index)[:, None]  # [B,1,n,max_len]
+                    return lax.dynamic_slice_in_dim(pm, index, n, axis=0)[
+                        None, None
+                    ]
+
+                if self.static_mask is not None:
+                    mask = mask & mask_rows_at(
+                        jnp.asarray(np.asarray(self.static_mask))
+                    )
+                if mask_array is not None:
+                    mask = mask & mask_rows_at(mask_array)
+                out = dense_attention(q, ck, cv, mask=mask, stable=self.stable)
             new_cache = {"k": ck, "v": cv, "index": index + n}
         else:
             if rotary is not None:

@@ -797,10 +797,12 @@ def _generate_images_cached_batched_impl(
 # token. The slot API below instead keeps ONE persistent fixed-shape decode
 # state of `max_batch` cache slots, advanced in chunks of K tokens by one
 # jitted step; a host-side allocator (serving/engine.py) admits new prompts
-# into free slots (prefill-into-slot) and retires finished rows at chunk
-# boundaries — vLLM-style token-boundary admission, with the same
+# into free slots (batched prefill-into-slots) and retires finished rows at
+# chunk boundaries — vLLM-style token-boundary admission, with the same
 # fixed-shape-compilation discipline as the rest of the serving stack
-# (exactly two compiled programs: prefill at batch 1, chunk at max_batch).
+# (three compiled slot programs: prefill at batch `prefill_batch`, chunk
+# at max_batch, slot release — R pending admissions cost
+# ceil(R / prefill_batch) dispatches, not R).
 #
 # Per-row state threaded through the stack: per-slot cache `index`
 # (models/attention.py per-row cached path), per-slot token-shift ring
@@ -815,8 +817,8 @@ def _generate_images_cached_batched_impl(
 def init_slot_state(model: DALLE, max_batch: int, dtype=None) -> dict:
     """Persistent decode state for `max_batch` cache slots.
 
-    Free slots hold zeros; `prefill_into_slot` overwrites a slot wholesale
-    on admission (including every cache position, so no state leaks between
+    Free slots hold zeros; `prefill_into_slots` overwrites admitted slots
+    wholesale (including every cache position, so no state leaks between
     the consecutive occupants of a slot), and `active` gates which rows
     advance in `decode_image_chunk`.
     """
@@ -847,45 +849,56 @@ def init_slot_state(model: DALLE, max_batch: int, dtype=None) -> dict:
     }
 
 
-def prefill_into_slot(
+def prefill_into_slots(
     model: DALLE,
     variables,
     state: dict,
-    text: jnp.ndarray,
-    slot,
-    seed,
-    temperature,
-    keep_k,
+    texts: jnp.ndarray,
+    slots,
+    seeds,
+    temperatures,
+    keep_ks,
 ):
-    """Admit one prompt into cache slot `slot` (traced scalar).
+    """Admit up to R prompts into their cache slots in ONE donated dispatch.
 
-    Runs the text prefill at batch 1 — the same `decode_prefill` the
-    micro-batch sampler runs, so per-row numerics match bit-for-bit — and
-    scatters the resulting K/V (+ token-shift rings) into the slot row of
-    the persistent state. ONE compiled program regardless of which slot is
-    filled: the slot index is traced data, never a shape.
+    `texts` is [R, text_seq_len]; `slots`/`seeds`/`temperatures`/`keep_ks`
+    are [R] (traced data — ONE compiled program per prefill batch size R
+    regardless of which slots are filled). Runs the text prefill at batch R
+    — the same `decode_prefill` the micro-batch sampler runs, so per-row
+    numerics match the lockstep path bit-for-bit (batch-composition
+    invariance is already the serving stack's contract) — and scatters each
+    resulting K/V row (+ token-shift rings, pending logits, per-slot
+    sampling params) into its slot of the persistent state.
+
+    Fewer than R real prompts: pad by REPEATING a real (slot, prompt) pair —
+    the duplicate rows re-write the same slot with identical content, so
+    padding costs compute but never correctness (the same trade the
+    micro-batch engine makes with its padded batch rungs). Duplicate slots
+    among the real rows are the caller's bug.
 
     `state` is DONATED: its buffers are invalid after the call — always
     replace your reference with the return value (as the slot ops below
     all do). This keeps exactly one slot cache alive instead of two.
     """
+    texts = jnp.asarray(texts, jnp.int32)
+    prefill_batch = int(texts.shape[0])
     return _jit_sample(
-        _prefill_slot_builder, model, (),
-        variables, state, text,
-        jnp.int32(slot), jnp.int32(seed),
-        jnp.float32(temperature), jnp.int32(keep_k),
+        _prefill_slots_builder, model, (prefill_batch,),
+        variables, state, texts,
+        jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
+        jnp.asarray(temperatures, jnp.float32), jnp.asarray(keep_ks, jnp.int32),
     )
 
 
-def _prefill_slot_builder(model, key):
-    del key
+def _prefill_slots_builder(model, key):
+    (prefill_batch,) = key
     batch_axis = 1 if model.executor == "scan" else 0
 
-    def fn(variables, state, text, slot, seed, temperature, keep_k):
-        row, cache1 = model.apply(
+    def fn(variables, state, texts, slots, seeds, temperatures, keep_ks):
+        rows, cache_r = model.apply(
             variables,
-            text,
-            init_decode_cache(model, 1),
+            texts,
+            init_decode_cache(model, prefill_batch),
             method=DALLE.decode_prefill,
         )
 
@@ -895,34 +908,46 @@ def _prefill_slot_builder(model, key):
             # truth for position — see set_decode_cache_index)
             if getattr(path[-1], "key", None) == "index":
                 return s_leaf
-            return jax.lax.dynamic_update_slice_in_dim(
-                s_leaf, p_leaf.astype(s_leaf.dtype), slot, axis=batch_axis
-            )
+            out = s_leaf
+            for r in range(prefill_batch):
+                p_row = jax.lax.dynamic_slice_in_dim(
+                    p_leaf, r, 1, axis=batch_axis
+                )
+                out = jax.lax.dynamic_update_slice_in_dim(
+                    out, p_row.astype(out.dtype), slots[r], axis=batch_axis
+                )
+            return out
 
         new_cache = jax.tree_util.tree_map_with_path(
-            write, state["cache"], cache1
+            write, state["cache"], cache_r
         )
         out = dict(state)
         out["cache"] = new_cache
-        out["row"] = jax.lax.dynamic_update_slice(
-            state["row"], row.astype(state["row"].dtype), (slot, 0)
-        )
-        out["img_tokens"] = jax.lax.dynamic_update_slice(
-            state["img_tokens"],
-            jnp.zeros((1, model.image_seq_len), jnp.int32),
-            (slot, 0),
-        )
-        out["img_pos"] = state["img_pos"].at[slot].set(0)
-        out["active"] = state["active"].at[slot].set(True)
-        out["seeds"] = state["seeds"].at[slot].set(seed)
-        out["temps"] = state["temps"].at[slot].set(temperature)
-        out["keep_k"] = state["keep_k"].at[slot].set(keep_k)
+        row_buf = state["row"]
+        tok_buf = state["img_tokens"]
+        zero_row = jnp.zeros((1, model.image_seq_len), jnp.int32)
+        for r in range(prefill_batch):
+            row_buf = jax.lax.dynamic_update_slice(
+                row_buf, rows[r : r + 1].astype(row_buf.dtype), (slots[r], 0)
+            )
+            tok_buf = jax.lax.dynamic_update_slice(
+                tok_buf, zero_row, (slots[r], 0)
+            )
+        out["row"] = row_buf
+        out["img_tokens"] = tok_buf
+        # scatter-with-duplicates is safe here: padded rows repeat a real
+        # (slot, value) pair, so whichever duplicate lands last is identical
+        out["img_pos"] = state["img_pos"].at[slots].set(0)
+        out["active"] = state["active"].at[slots].set(True)
+        out["seeds"] = state["seeds"].at[slots].set(seeds)
+        out["temps"] = state["temps"].at[slots].set(temperatures)
+        out["keep_k"] = state["keep_k"].at[slots].set(keep_ks)
         return out
 
     return fn
 
 
-_prefill_slot_builder._donate_argnums = (1,)  # state
+_prefill_slots_builder._donate_argnums = (1,)  # state
 
 
 def release_slots(model: DALLE, state: dict, mask) -> dict:
@@ -957,7 +982,7 @@ def decode_image_chunk(model: DALLE, variables, state: dict, chunk: int):
     and position stop advancing) until the host retires them at the chunk
     boundary; inactive slots compute along as padding but persist nothing.
 
-    `state` is DONATED (see `prefill_into_slot`) — replace your reference
+    `state` is DONATED (see `prefill_into_slots`) — replace your reference
     with the return value.
     """
     return _jit_sample(

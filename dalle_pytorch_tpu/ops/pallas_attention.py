@@ -44,6 +44,20 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+#: jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams; support both
+#: so the kernels run on whichever jax the image bakes in
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
+
+#: whether this jax can force the LIBRARY TPU kernel through the
+#: interpreter on CPU (`pltpu.force_tpu_interpret_mode`). The in-repo
+#: kernels pass `interpret=` per pallas_call and don't need it; the
+#: library kernel's internal pallas_calls (and its custom-VJP backward's)
+#: can only be interpreted via this context manager, so without it
+#: `lib_flash` is TPU-hardware-only (tests skip accordingly).
+HAS_FORCE_TPU_INTERPRET = hasattr(pltpu, "force_tpu_interpret_mode")
+
 
 def _use_interpret() -> bool:
     """Compile the kernel on real TPU hardware, interpret elsewhere.
@@ -244,7 +258,7 @@ def _flash_forward(
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=(
                 "parallel", "parallel", "parallel", "arbitrary",
             ),
@@ -439,7 +453,7 @@ def _flash_backward(
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=(
                 "parallel", "parallel", "parallel", "arbitrary",
             ),
@@ -495,7 +509,7 @@ def _flash_backward(
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=(
                 "parallel", "parallel", "parallel", "arbitrary",
             ),
@@ -601,8 +615,13 @@ def lib_flash_attention(
 
     scale = q.shape[-1] ** -0.5 if sm_scale is None else sm_scale
     if _use_interpret():
-        import jax.experimental.pallas.tpu as pltpu
-
+        if not HAS_FORCE_TPU_INTERPRET:
+            raise NotImplementedError(
+                'attn_impl="lib_flash" off-TPU needs '
+                "pltpu.force_tpu_interpret_mode, which this jax does not "
+                'provide — use attn_impl="flash" (the in-repo kernel '
+                "interprets per-call) or run on TPU hardware"
+            )
         with pltpu.force_tpu_interpret_mode():
             return _lib(q, k, v, causal=causal, sm_scale=scale)
     return _lib(q, k, v, causal=causal, sm_scale=scale)

@@ -1,0 +1,202 @@
+"""Pallas TPU flash-decode: cached attention over a slot KV cache with
+per-row live lengths.
+
+The decode analog of `ops/pallas_attention.py`. During KV-cached decode the
+dense path attends every query chunk against the ENTIRE fixed-shape cache
+[B, H, max_len, D] — dead positions included, masked out in the softmax
+epilogue — so every decode step pays max_len worth of K/V reads no matter
+how short the live prefix is. Under continuous batching the waste compounds:
+each slot row sits at its OWN position, and a freshly-admitted row drags the
+full cache through the MXU for a prefix of a few hundred tokens.
+
+Design (split-K over the key axis, cf. flash-decoding / "SparkAttention",
+PAPERS.md):
+
+  * grid (b, h, ki): the small query chunk (1..K tokens per slot row) stays
+    resident in VMEM while [block_k, d] K/V tiles stream through; the
+    online-softmax state (m, l, acc) carries across ki in fp32 VMEM scratch
+    and the normalized output flushes on the last step — O(max_len) memory
+    never materializes a [*, max_len] score row in HBM;
+  * per-row liveness: `lengths[b]` (the row's cache index + the chunk size)
+    arrives via scalar prefetch (SMEM), so K/V tiles fully above a row's
+    live prefix are skipped ENTIRELY — the kernel predicates compute with
+    `@pl.when`, and the DMA index map clamps dead steps to the row's last
+    live tile (Pallas elides the copy when the block index repeats, the
+    same trick as the causal skip in `ops/pallas_attention.py`), so a row
+    at position p costs ceil(p/block_k) tiles of K/V traffic, not
+    max_len/block_k;
+  * within the live region, causality over the written prefix matches the
+    dense cached path exactly: query row i (global position
+    lengths[b] - n + i) attends to cache positions <= lengths[b] - n + i;
+  * fp32 accumulation regardless of input dtype; no VJP (decode is
+    inference-only — the training path keeps `flash_attention`'s
+    recompute-based backward).
+
+Dispatch lives in `models/attention.py` (`Attention._use_flash_decode`):
+the dense cached path remains the fallback for pattern masks (static or
+traced — a per-step row-sliced mask cannot drive the block skip) and for
+small caches below `AUTO_FLASH_DECODE_MIN_LEN`. Interpret mode is selected
+automatically off-TPU (same `_use_interpret` probe as the training kernel)
+so CPU tests exercise the real kernel logic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from dalle_pytorch_tpu.ops.pallas_attention import (
+    NEG_INF,
+    CompilerParams,
+    _pad_to,
+    _use_interpret,
+)
+
+#: minimum q-axis tile (fp32 sublane count) — single-token decode pads its
+#: one query row up to this and slices the garbage rows back off
+_MIN_BLOCK_Q = 8
+
+
+def _last_live_block(length, block_k):
+    """Index of the last K/V block holding a live position for a row of
+    `length` live cache entries. Single source of truth for the kernel's
+    liveness predicate AND the DMA-skip index map — they must stay in
+    lockstep (a skipped copy for a step the kernel treats as live would
+    compute on stale data silently)."""
+    return jnp.maximum(length - 1, 0) // block_k
+
+
+def _decode_kernel(
+    lengths_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, sm_scale, block_k, n_real_q, nk_blocks,
+):
+    """Grid (b, h, ki): the q chunk stays put over the inner ki steps while
+    [block_k, d] K/V tiles stream through (auto double-buffered). Tiles
+    fully above the row's live length never run — and never DMA (their
+    index-map steps repeat the last live tile, so the copy is elided)."""
+    b = pl.program_id(0)
+    ki = pl.program_id(2)
+    length = lengths_ref[b]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    live = ki <= _last_live_block(length, block_k)
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [bq, d]
+        kb = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
+        vb = v_ref[0, 0].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        bq = q.shape[0]
+        col = ki * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+        row = lax.broadcasted_iota(jnp.int32, (bq, block_k), 0)
+        # query row i sits at global position length - n + i; causal over
+        # the written prefix (same mask the dense cached path builds in
+        # models/attention.py) — this also masks the key padding, since
+        # length <= n_real_k <= padded length
+        s = jnp.where(col <= length - n_real_q + row, s, NEG_INF)
+        m = m_ref[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        m_ref[...] = m_new
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jnp.dot(
+            p.astype(vb.dtype), vb, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == nk_blocks - 1)
+    def _flush():
+        # padded q rows (bq > n_real_q) DO accumulate — their causal bound
+        # is wider than any real row's — but the caller slices them off;
+        # the guard only protects the lengths == 0 corner (no live key at
+        # all), which real callers never produce (lengths >= n >= 1)
+        safe_l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def flash_decode_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    sm_scale: Optional[float] = None,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Cached-decode attention with per-row live lengths and KV block skip.
+
+    q: [B, H, n, D] — the current chunk's queries (n = 1 for single-token
+       decode, larger for prefill chunks), already written into the cache;
+    k, v: [B, H, S, D] — the fixed-shape slot cache AFTER the chunk write;
+    lengths: [B] int — per-row live cache entries INCLUDING the chunk, i.e.
+       the row's pre-chunk cache index + n. Query row i of batch row b
+       attends to cache positions <= lengths[b] - n + i, exactly the mask
+       the dense cached path applies.
+
+    Matches `dense_attention(q, k, v, mask)` over that mask to fp32
+    tolerance (pinned in tests/test_pallas_decode.py). Not differentiable
+    by design — decode only.
+    """
+    b, h, n, d = q.shape
+    s_len = k.shape[2]
+    assert k.shape == v.shape == (b, h, s_len, d), (q.shape, k.shape, v.shape)
+    assert lengths.shape == (b,), f"lengths {lengths.shape} != ({b},)"
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    interp = _use_interpret() if interpret is None else interpret
+
+    block_k = max(min(block_k, s_len), 1)
+    qp = _pad_to(q, 2, _MIN_BLOCK_Q)
+    kp = _pad_to(k, 2, block_k)
+    vp = _pad_to(v, 2, block_k)
+    bq = qp.shape[2]
+    nk_blocks = kp.shape[2] // block_k
+    lengths = jnp.clip(lengths.astype(jnp.int32), 0, s_len)
+
+    kernel = functools.partial(
+        _decode_kernel,
+        sm_scale=scale,
+        block_k=block_k,
+        n_real_q=n,
+        nk_blocks=nk_blocks,
+    )
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, lens: (b_, h_, 0, 0))
+
+    def k_idx(b_, h_, j, lens):
+        # DMA skip: steps above the row's last live tile re-index that tile,
+        # so Pallas elides their copies (repeat block index = no new DMA)
+        return (b_, h_, jnp.minimum(j, _last_live_block(lens[b_], block_k)), 0)
+
+    kspec = pl.BlockSpec((1, 1, block_k, d), k_idx)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, nk_blocks),
+            in_specs=[qspec, kspec, kspec],
+            out_specs=qspec,
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interp,
+    )(lengths, qp, kp, vp)
+    return out[:, :, :n, :]

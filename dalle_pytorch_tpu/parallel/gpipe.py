@@ -28,6 +28,8 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+
+from dalle_pytorch_tpu.parallel.mesh import axis_size, shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -84,7 +86,7 @@ def pipeline_layers(
     ever used for *memory* scaling, move injection/collection to
     stage-local slices instead.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     p = lax.axis_index(axis_name)
     fwd_perm = [(i, i + 1) for i in range(n_stages - 1)]
     ticks = n_micro + n_stages - 1
@@ -190,7 +192,7 @@ def gpipe_apply(
         return outs[None]
 
     if mb_aux is None:
-        sharded = jax.shard_map(
+        sharded = shard_map(
             lambda p_, m_: stage_fn(p_, m_, None),
             mesh=mesh,
             in_specs=(P("pp"), P()),
@@ -199,7 +201,7 @@ def gpipe_apply(
         )
         outs = sharded(staged, mb)
     else:
-        sharded = jax.shard_map(
+        sharded = shard_map(
             stage_fn,
             mesh=mesh,
             in_specs=(P("pp"), P(), P()),

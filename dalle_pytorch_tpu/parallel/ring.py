@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dalle_pytorch_tpu.parallel.mesh import axis_size, shard_map
+
 _NEG = -1e30
 
 
@@ -40,7 +42,7 @@ def ring_attention(
     Shard i owns global positions [i*n_local, (i+1)*n_local). Must run
     inside shard_map over `axis_name`.
     """
-    n_shards = lax.axis_size(axis_name)
+    n_shards = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, n_local, d = q.shape
     scale = d**-0.5 if scale is None else scale
@@ -109,7 +111,7 @@ def ring_attention_sharded(
         dp_extent *= mesh.shape.get(a, 1)
     b_axes = batch_axes if q.shape[0] % dp_extent == 0 else None
     spec = P(b_axes, None, seq_axis, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(ring_attention, axis_name=seq_axis, causal=causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -9,8 +9,8 @@ queue feeding fixed-shape compiled sampler programs.
     and optionally CLIP-reranks results. `ContinuousEngine` +
     `SlotAllocator`: continuous batching — one persistent decode state of
     `max_batch` cache slots advanced in K-token chunks, prompts admitted
-    into free slots at token boundaries (`models/dalle.py:
-    prefill_into_slot` / `decode_image_chunk`).
+    into free slots at token boundaries in batched prefill waves
+    (`models/dalle.py:prefill_into_slots` / `decode_image_chunk`).
   * `batcher.py`  — `MicroBatcher`: bounded queue with dynamic
     micro-batching (flush on max-batch or deadline), backpressure via
     queue-full rejection, per-request timeout/cancellation, graceful

@@ -422,9 +422,12 @@ class ContinuousBatcher(MicroBatcher):
     cancel / drain semantics, same instrument names), but the worker never
     assembles flush batches: it runs a persistent loop of
 
-        admit   — pop queued requests into free cache slots (one prefill
-                  dispatch per row; a request's rows admit all-or-nothing
-                  so its images stay one retirement unit),
+        admit   — pop queued requests into free cache slots, batched: the
+                  admission wave prefills in groups of the engine's fixed
+                  `prefill_batch`, so R pending rows cost
+                  ceil(R / prefill_batch) dispatches, not R (a request's
+                  rows admit all-or-nothing so its images stay one
+                  retirement unit),
         chunk   — advance every live slot by `engine.chunk_tokens` tokens
                   in one fixed-shape dispatch,
         retire  — at the chunk boundary, harvest rows that completed
@@ -447,8 +450,9 @@ class ContinuousBatcher(MicroBatcher):
     ):
         """`engine` needs the slot surface of `ContinuousEngine`
         (`prefill_slot` / `step_chunk` / `harvest` / `release` /
-        `decode_pixels` / `image_seq_len` / `max_batch`) — the tests drive
-        a fake with exactly that surface."""
+        `decode_pixels` / `image_seq_len` / `max_batch`; batched admission
+        additionally uses `prefill_slots` + `prefill_batch` when present)
+        — the tests drive a fake with exactly that surface."""
         super().__init__(
             engine,
             max_queue_rows=max_queue_rows,
@@ -509,8 +513,18 @@ class ContinuousBatcher(MicroBatcher):
                 self._m_depth.set(self._pending_rows)
 
             try:
-                for slot, spec in admitted:
-                    self.engine.prefill_slot(slot, spec)
+                # batched admission: the whole wave goes in groups of the
+                # engine's fixed prefill batch — ceil(R / prefill_batch)
+                # dispatches instead of R (engines without the batched
+                # surface, e.g. test fakes, fall back to per-row prefill)
+                prefill_slots = getattr(self.engine, "prefill_slots", None)
+                if prefill_slots is not None:
+                    pb = max(1, int(getattr(self.engine, "prefill_batch", 1)))
+                    for i in range(0, len(admitted), pb):
+                        prefill_slots(admitted[i : i + pb])
+                else:
+                    for slot, spec in admitted:
+                        self.engine.prefill_slot(slot, spec)
                 t0 = time.monotonic()
                 img_pos, _active = self.engine.step_chunk()
                 self._m_chunk_seconds.observe(time.monotonic() - t0)

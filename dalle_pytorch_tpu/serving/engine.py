@@ -274,6 +274,21 @@ class GenerationEngine:
         return np.asarray(sorted_imgs), np.asarray(scores), np.asarray(order)
 
 
+def _pack_prefill_rows(rows, keep_k_of):
+    """Host-side packing of (slot, SampleSpec) pairs into the batched
+    prefill's dispatch arrays. Pure request-dataclass reads — deliberately
+    outside the hotloop-marked engine methods, which must stay free of
+    anything TL002 could mistake for a device sync."""
+    texts = np.stack([np.asarray(spec.text_ids, np.int32) for _, spec in rows])
+    slots = np.asarray([s for s, _ in rows], np.int32)
+    seeds = np.asarray(
+        [int(spec.seed) & 0x7FFFFFFF for _, spec in rows], np.int32
+    )
+    temps = np.asarray([spec.temperature for _, spec in rows], np.float32)
+    keep = np.asarray([keep_k_of(spec.top_k) for _, spec in rows], np.int32)
+    return texts, slots, seeds, temps, keep
+
+
 class SlotAllocator:
     """Host-side allocator for the continuous engine's fixed cache slots.
 
@@ -324,11 +339,15 @@ class ContinuousEngine(GenerationEngine):
     chunk boundaries, so occupancy backfills mid-flight and time-to-first-
     token is bounded by ~one chunk instead of up to two full passes.
 
-    Fixed-shape discipline is preserved: exactly three compiled programs —
-    prefill (batch 1, slot index traced), chunk step (batch `max_batch`),
-    pixel decode (batch `max_batch`) — regardless of load. `chunk_tokens`
-    is the latency/throughput knob: smaller chunks admit and retire sooner
-    (lower TTFT) but pay more host round trips per image.
+    Fixed-shape discipline is preserved: exactly four compiled programs —
+    batched prefill (batch `prefill_batch`, slot indices traced), chunk
+    step (batch `max_batch`), slot release, pixel decode (batch
+    `max_batch`) — regardless of load. `chunk_tokens` is the latency/throughput knob: smaller chunks
+    admit and retire sooner (lower TTFT) but pay more host round trips per
+    image. `prefill_batch` is the admission-amortization knob: R pending
+    requests at a chunk boundary cost ceil(R / prefill_batch) prefill
+    dispatches (padded by repeating a real row — same trade as the
+    micro-batch engine's padded rungs) instead of R batch-1 dispatches.
 
     Classifier-free guidance is engine-wide OFF here (cond_scale=1): a
     guided continuous batch needs a paired null-stream slot per row —
@@ -344,6 +363,7 @@ class ContinuousEngine(GenerationEngine):
         vae_params=None,
         max_batch: int = 8,
         chunk_tokens: int = 4,
+        prefill_batch: int = 4,
         cond_scale: float = 1.0,
         clip=None,
         clip_params=None,
@@ -371,6 +391,9 @@ class ContinuousEngine(GenerationEngine):
             cfg=cfg,
         )
         self.chunk_tokens = int(chunk_tokens)
+        # admission never spans more slots than exist; 1 degrades to the
+        # per-row admission of PR 2
+        self.prefill_batch = max(1, min(int(prefill_batch), self.max_batch))
         from dalle_pytorch_tpu.models.dalle import init_slot_state
 
         self._state = init_slot_state(model, self.max_batch)
@@ -385,6 +408,11 @@ class ContinuousEngine(GenerationEngine):
         self._m_prefills = self.registry.counter(
             "dalle_serving_prefills_total",
             "prompts prefilled into cache slots",
+        )
+        self._m_prefill_dispatches = self.registry.counter(
+            "dalle_serving_prefill_dispatches_total",
+            "batched prefill dispatches (each admits up to prefill_batch "
+            "rows in one fixed-shape program)",
         )
         self._decode_pixels_jit = None
 
@@ -406,22 +434,46 @@ class ContinuousEngine(GenerationEngine):
             self._state = init_slot_state(self.model, self.max_batch)
             raise
 
+    def prefill_slots(  # tracelint: hotloop
+        self,
+        assignments: Sequence[Tuple[int, SampleSpec]],
+        _warmup: bool = False,
+    ) -> None:
+        """Admit up to `prefill_batch` (slot, prompt) pairs in ONE
+        fixed-shape dispatch. Short batches pad by repeating the first
+        pair — the duplicate rows re-write the same slot with identical
+        content (see `models/dalle.py:prefill_into_slots`), so every
+        admission, single or batched, runs the SAME compiled program."""
+        from dalle_pytorch_tpu.models.dalle import prefill_into_slots
+
+        n = len(assignments)
+        assert 1 <= n <= self.prefill_batch, (
+            f"{n} assignments exceed prefill_batch={self.prefill_batch}; "
+            "the batcher must split admission waves"
+        )
+        rows = list(assignments) + [assignments[0]] * (self.prefill_batch - n)
+        texts, slots, seeds, temps, keep = _pack_prefill_rows(
+            rows, self._keep_k
+        )
+        assert texts.shape == (self.prefill_batch, self.model.text_seq_len), (
+            f"prompt rows must be [{self.model.text_seq_len}] token ids, "
+            f"got batch {texts.shape}"
+        )
+        with self._lock:
+            self._replace_state(lambda s: prefill_into_slots(
+                self.model, self.variables, s, texts, slots, seeds, temps,
+                keep,
+            ))
+            if not _warmup:
+                self._m_prefills.inc(n)
+                self._m_prefill_dispatches.inc()
+
     def prefill_slot(  # tracelint: hotloop
         self, slot: int, spec: SampleSpec, _warmup: bool = False
     ) -> None:
-        """Admit one prompt into `slot` (one fixed-shape dispatch)."""
-        from dalle_pytorch_tpu.models.dalle import prefill_into_slot
-
-        text = np.asarray(spec.text_ids, np.int32)[None]
-        assert text.shape == (1, self.model.text_seq_len)
-        with self._lock:
-            self._replace_state(lambda s: prefill_into_slot(
-                self.model, self.variables, s, text,
-                slot, int(spec.seed) & 0x7FFFFFFF,
-                float(spec.temperature), self._keep_k(spec.top_k),
-            ))
-            if not _warmup:
-                self._m_prefills.inc()
+        """Admit one prompt into `slot` — a 1-row `prefill_slots` wave
+        (padded to the fixed prefill shape; no extra compiled program)."""
+        self.prefill_slots([(slot, spec)], _warmup=_warmup)
 
     def step_chunk(self, _warmup: bool = False):  # tracelint: hotloop
         """Advance all live slots by `chunk_tokens`; returns the post-chunk
@@ -515,13 +567,15 @@ class ContinuousEngine(GenerationEngine):
     # ----------------------------------------------------------- warmup
 
     def warmup(self, shapes: Optional[Sequence[int]] = None) -> None:
-        """Compile the full fixed-shape program set (prefill, chunk, slot
-        release, pixel decode) with dummy traffic, then reset the slot
-        state. Counts only toward compile metrics + `stats.warmup_batches`
-        (same tagging contract as the micro-batch engine). Warming ALL of
-        the steady-state programs — release included — is load-bearing:
-        tests/test_continuous.py pins with `assert_no_recompiles` that a
-        post-warmup serve cycle compiles nothing."""
+        """Compile the full fixed-shape program set (batched prefill at
+        `prefill_batch` — the one program every admission wave runs —
+        chunk, slot release, pixel decode) with dummy traffic, then reset
+        the slot state. Counts only toward compile metrics +
+        `stats.warmup_batches` (same tagging contract as the micro-batch
+        engine). Warming ALL of the steady-state programs — release
+        included — is load-bearing: tests/test_continuous.py pins with
+        `assert_no_recompiles` that a post-warmup serve cycle compiles
+        nothing."""
         from dalle_pytorch_tpu.models.dalle import init_slot_state
 
         t0 = time.perf_counter()
@@ -551,6 +605,7 @@ def engine_from_checkpoint(
     registry=None,
     mode: str = "micro",
     chunk_tokens: int = 4,
+    prefill_batch: int = 4,
 ):
     """Build a serving engine from a single-file DALLE checkpoint.
 
@@ -618,6 +673,7 @@ def engine_from_checkpoint(
         return ContinuousEngine(
             max_batch=max(int(b) for b in batch_shapes),
             chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch,
             **common,
         )
     return GenerationEngine(batch_shapes=batch_shapes, **common)

@@ -1,0 +1,168 @@
+"""Dense↔flash crossover measurement: sets AUTO_FLASH_MIN_SEQ and
+AUTO_FLASH_DECODE_MIN_LEN from data instead of folklore.
+
+Methodology (the same hardware-free instrument as `scripts/hbm_model.py`,
+whose r4 ladder the live TPU bench later validated): AOT-compile the REAL
+dense attention program per sequence length on the CPU backend (same HLO
+structure as TPU), read `compiled.cost_analysis()` FLOPs/bytes, and place
+both kernels on the v5e roofline (197 TFLOP/s, ~819 GB/s):
+
+  * dense: measured op-level bytes include the [B, H, N, N] fp32 score
+    chain the fused MXU epilogue cannot eliminate once it spills VMEM;
+  * flash: analytic tile traffic, EXACT from the kernel's BlockSpecs
+    (q/o streamed once per q block; k/v once per LIVE (qi, ki) tile under
+    the causal DMA skip — `_causal_last_live_k` is imported, not re-derived)
+    plus the same measured matmul FLOPs halved by the causal block cut.
+
+The prefill/training crossover is the first N where the dense program goes
+BANDWIDTH-bound (bytes/BW > flops/peak): below it both kernels are
+compute-bound and dense's tighter fusion wins (the r4 on-chip finding:
+dense == fully-levered flash wall time at 1280 under dispatch overhead);
+above it dense pays score traffic that flash simply does not have.
+
+The decode crossover compares one cached step's K/V reads: dense always
+reads the whole [B, H, max_len, D] cache; flash-decode reads
+ceil(live/block_k) tiles (expected live ~ max_len/2 over an image) plus a
+per-kernel overhead charge. Emits one JSON line per seq and a final
+recommendation line. Caveats stated in BASELINE.md §flash-crossover; the
+on-chip wall-clock A/B (`scripts/pallas_onchip.py`) stays armed in the
+watchdog matrix as the final decider.
+
+Usage: JAX_PLATFORMS=cpu python scripts/flash_crossover.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+V5E_PEAK_FLOPS = 197e12
+V5E_HBM_BPS = 819e9
+#: in-program Mosaic kernel overhead per pallas_call (grid setup; NOT a
+#: host dispatch — the kernel runs inside the jitted step)
+KERNEL_OVERHEAD_S = 5e-6
+
+# serving/training flagship geometry (BASELINE.md): heads 16, head dim 64
+BATCH, HEADS, DIM_HEAD = 4, 16, 64
+BLOCK = 128
+SEQS = (256, 384, 512, 640, 768, 1024, 1280, 1536, 2048, 4096)
+
+
+def measured_dense(seq, dtype):
+    """cost_analysis FLOPs/bytes of the compiled dense causal attention."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dalle_pytorch_tpu.ops.attention_core import dense_attention
+
+    mask = jnp.asarray(np.tril(np.ones((seq, seq), dtype=bool))[None, None])
+    q = jnp.zeros((BATCH, HEADS, seq, DIM_HEAD), dtype)
+
+    compiled = (
+        jax.jit(lambda q_, k_, v_: dense_attention(q_, k_, v_, mask=mask))
+        .lower(q, q, q)
+        .compile()
+    )
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    return float(cost["flops"]), float(cost["bytes accessed"])
+
+
+def flash_tile_bytes(seq, itemsize):
+    """Exact causal-skip K/V tile traffic of the flash forward at this seq
+    (q/o once per q block; k/v once per live (qi, ki) tile)."""
+    from dalle_pytorch_tpu.ops.pallas_attention import _causal_last_live_k
+
+    nq = -(-seq // BLOCK)
+    live_tiles = sum(
+        min(_causal_last_live_k(qi, BLOCK, BLOCK), nq - 1) + 1
+        for qi in range(nq)
+    )
+    per_head = (
+        2 * seq * DIM_HEAD  # q in, o out
+        + 2 * live_tiles * BLOCK * DIM_HEAD  # k + v tiles
+    ) * itemsize + seq * 4  # lse row, fp32
+    return BATCH * HEADS * per_head
+
+
+def decode_step_times(max_len, itemsize):
+    """(dense_s, flash_s) roofline time of ONE cached decode step's
+    attention reads at expected live length max_len/2 (bandwidth-bound:
+    q is a single token)."""
+    kv = 2 * BATCH * HEADS * max_len * DIM_HEAD * itemsize
+    dense_s = kv / V5E_HBM_BPS
+    live = max_len / 2
+    tiles = -(-live // BLOCK)
+    kv_flash = 2 * BATCH * HEADS * tiles * BLOCK * DIM_HEAD * itemsize
+    flash_s = kv_flash / V5E_HBM_BPS + KERNEL_OVERHEAD_S
+    return dense_s, flash_s
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16
+    itemsize = 2
+    prefill_cross = None
+    decode_cross = None
+    for seq in SEQS:
+        flops, dense_bytes = measured_dense(seq, dtype)
+        t_dense = max(flops / V5E_PEAK_FLOPS, dense_bytes / V5E_HBM_BPS)
+        dense_bw_bound = dense_bytes / V5E_HBM_BPS > flops / V5E_PEAK_FLOPS
+        fbytes = flash_tile_bytes(seq, itemsize)
+        # causal block cut halves the matmul work; epilogue FLOPs are noise
+        t_flash = max(
+            (flops / 2) / V5E_PEAK_FLOPS, fbytes / V5E_HBM_BPS
+        ) + KERNEL_OVERHEAD_S
+        d_dense, d_flash = decode_step_times(seq, itemsize)
+        row = {
+            "probe": "flash_crossover",
+            "seq": seq,
+            "dense_flops": flops,
+            "dense_bytes": dense_bytes,
+            "flash_bytes": fbytes,
+            "dense_roofline_us": round(t_dense * 1e6, 1),
+            "flash_roofline_us": round(t_flash * 1e6, 1),
+            "dense_bw_bound": dense_bw_bound,
+            "decode_dense_us": round(d_dense * 1e6, 2),
+            "decode_flash_us": round(d_flash * 1e6, 2),
+            "device": jax.devices()[0].platform,
+        }
+        print(json.dumps(row), flush=True)
+        if prefill_cross is None and dense_bw_bound and t_flash < t_dense:
+            prefill_cross = seq
+        if decode_cross is None and d_flash < d_dense:
+            decode_cross = seq
+    # Op-level counting cannot resolve the LOW end of the prefill bracket:
+    # below ~1k tokens XLA's epilogue fusion may keep (part of) the score
+    # chain out of HBM, so "dense is BW-bound from `prefill_cross` on" is a
+    # lower bound, not a crossover. The r4 hardware anchor (flash == dense
+    # wall at 1280 even under dispatch overhead; the r3 HBM analysis says
+    # flash wins there outright) caps the bracket from above. Recommend the
+    # largest bench-grid point that still auto-selects flash for the
+    # flagship 1280: every estimate agrees there, and the unreliable
+    # sub-1k region stays dense until the on-chip A/B rules on it.
+    recommended_prefill = 1024
+    print(
+        json.dumps(
+            {
+                "probe": "flash_crossover_recommendation",
+                "prefill_bracket_low_seq": prefill_cross,
+                "prefill_hardware_anchor_seq": 1280,
+                "auto_flash_min_seq": recommended_prefill,
+                "auto_flash_decode_min_len": decode_cross,
+                "basis": "v5e roofline over measured dense cost_analysis; "
+                "on-chip wall-clock A/B remains the final decider",
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

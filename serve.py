@@ -44,6 +44,11 @@ def parse_args(argv=None):
                    help="continuous engine: tokens decoded per chunk "
                    "dispatch (smaller = faster admission/retirement, more "
                    "host round trips)")
+    p.add_argument("--prefill_batch", type=int, default=4,
+                   help="continuous engine: prompts admitted per prefill "
+                   "dispatch (R pending requests cost ceil(R/prefill_batch) "
+                   "dispatches at a chunk boundary; clamped to the slot "
+                   "count)")
     p.add_argument("--max_queue", type=int, default=64,
                    help="queue bound in rows; beyond it requests get 503")
     p.add_argument("--request_timeout_s", type=float, default=120.0)
@@ -73,6 +78,7 @@ def main(argv=None):
         cond_scale=args.cond_scale,
         mode=args.engine,
         chunk_tokens=args.chunk_tokens,
+        prefill_batch=args.prefill_batch,
     )
     if not args.no_warmup:
         print(f"[serve] warming up batch shapes {engine.batch_shapes} ...",

@@ -1,0 +1,48 @@
+"""TL007 negative: scan-body constant patterns that are fine — small
+constants (below the size heuristic), constants hoisted OUT of the body,
+unknown-size wraps of traced data, and host code outside any scan."""
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+SMALL = np.arange(16)
+
+
+def small_constant(xs):
+    def body_small_constant(carry, x):
+        t = jnp.asarray(np.arange(8))  # tiny: below the size heuristic
+        return carry + t[0] + jnp.asarray(SMALL)[0], x
+
+    return lax.scan(body_small_constant, 0.0, xs)
+
+
+def hoisted(xs):
+    table = jnp.asarray(np.arange(100_000))  # built ONCE, closed over
+
+    def body_hoisted(carry, x):
+        return carry + table[0], x
+
+    return lax.scan(body_hoisted, 0.0, xs)
+
+
+def strided_arange(xs):
+    def body_strided_arange(carry, x):
+        # 1000 elements despite the huge stop: the step divides the span
+        t = jnp.asarray(np.arange(0, 1_000_000, 1000))
+        return carry + t[0], x
+
+    return lax.scan(body_strided_arange, 0.0, xs)
+
+
+def traced_wrap(xs):
+    def body_traced_wrap(carry, x):
+        y = jnp.asarray(x)  # traced data, not a host constant
+        return carry + y, x
+
+    return lax.scan(body_traced_wrap, 0.0, xs)
+
+
+def host_function():
+    # the same expression OUTSIDE a scan body stages once per call site
+    return jnp.asarray(np.arange(100_000))

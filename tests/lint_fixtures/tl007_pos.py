@@ -1,0 +1,33 @@
+"""TL007 positive: large host constants materialized inside lax.scan
+bodies. Never executed — tracelint parses it; pytest ignores non-test_
+files."""
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+BIG_MASK = np.tril(np.ones((512, 512)))  # ~262k elements, module level
+
+
+def direct_ctor(xs):
+    def body_direct_ctor(carry, x):
+        table = jnp.asarray(np.arange(100_000))  # staged per trace
+        return carry + table[0], x
+
+    return lax.scan(body_direct_ctor, 0.0, xs)
+
+
+def module_const(xs):
+    def body_module_const(carry, x):
+        mask = jnp.array(BIG_MASK)  # the module constant re-wrapped per trace
+        return carry + mask[0, 0], x
+
+    return lax.scan(body_module_const, 0.0, xs)
+
+
+def comparison_const(xs):
+    def body_comparison_const(carry, x):
+        blocked = jnp.asarray(np.arange(66_000) < 50_000)  # vocab-range mask
+        return carry + blocked[0], x
+
+    return lax.scan(body_comparison_const, 0.0, xs)

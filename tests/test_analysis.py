@@ -44,6 +44,7 @@ class TestRuleCorpus:
             ("tl004_pos.py", "TL004", 3),
             ("models/tl005_pos.py", "TL005", 3),
             ("tl006_pos.py", "TL006", 4),
+            ("tl007_pos.py", "TL007", 3),
         ],
     )
     def test_positive_fixture_caught(self, fixture, code, expected):
@@ -65,6 +66,7 @@ class TestRuleCorpus:
             "tl004_neg.py",
             "models/tl005_neg.py",
             "tl006_neg.py",
+            "tl007_neg.py",
         ],
     )
     def test_negative_fixture_clean(self, fixture):
@@ -86,6 +88,33 @@ class TestRuleCorpus:
     def test_tl006_message_points_at_survey(self):
         result = lint_paths([FIXTURES / "tl006_pos.py"])
         assert all("SURVEY.md" in f.message for f in result.findings)
+
+    def test_tl007_size_heuristic_boundary(self, tmp_path):
+        """The element-count threshold separates signal from noise: one
+        element under MIN_ELEMENTS is silent, at the threshold it fires."""
+        from dalle_pytorch_tpu.analysis.rules import ScanConstUploadRule
+
+        n = ScanConstUploadRule.MIN_ELEMENTS
+        template = textwrap.dedent(
+            """\
+            import numpy as np
+            import jax.numpy as jnp
+            from jax import lax
+
+            def caller(xs):
+                def body(carry, x):
+                    t = jnp.asarray(np.arange({count}))
+                    return carry + t[0], x
+
+                return lax.scan(body, 0.0, xs)
+            """
+        )
+        under = tmp_path / "under.py"
+        under.write_text(template.format(count=n - 1))
+        assert lint_paths([under]).clean
+        at = tmp_path / "at.py"
+        at.write_text(template.format(count=n))
+        assert codes(lint_paths([at])) == ["TL007"]
 
 
 # ------------------------------------------------------------ suppressions
@@ -314,6 +343,29 @@ class TestCLI:
         assert payload["findings"] and all(
             f["rule"] == "TL006" for f in payload["findings"]
         )
+
+    def test_github_format_emits_error_annotations(self, capsys):
+        """--format github: one ::error workflow command per finding, with
+        the file/line properties CI needs to anchor the inline annotation,
+        and the same nonzero exit as the other formats."""
+        from dalle_pytorch_tpu.analysis import main
+
+        rc = main([str(FIXTURES / "tl007_pos.py"), "--format", "github"])
+        assert rc == 1
+        out = capsys.readouterr().out.strip().splitlines()
+        annotations = [l for l in out if l.startswith("::error ")]
+        assert len(annotations) == 3
+        for line in annotations:
+            assert "file=" in line and "line=" in line
+            assert "title=tracelint TL007" in line
+            assert "::`jnp." in line.split(",", 2)[2]  # escaped message body
+        assert out[-1].startswith("tracelint: 3 finding(s)")
+
+    def test_github_format_escapes_newlines_and_delimiters(self):
+        from dalle_pytorch_tpu.analysis.lint import _gh_escape
+
+        assert _gh_escape("a%b\nc") == "a%25b%0Ac"
+        assert _gh_escape("p:q,r", is_property=True) == "p%3Aq%2Cr"
 
     def test_select_restricts_rules(self):
         from dalle_pytorch_tpu.analysis import main

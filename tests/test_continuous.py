@@ -40,7 +40,8 @@ FMAP = 4
 IMG_SEQ = FMAP * FMAP
 
 
-def _build(batch_shapes=(1, 4), max_batch=4, chunk_tokens=4, **model_kw):
+def _build(batch_shapes=(1, 4), max_batch=4, chunk_tokens=4,
+           prefill_batch=4, **model_kw):
     """(micro engine, continuous engine) over ONE set of toy weights."""
     kw = dict(
         dim=32, depth=2, heads=2, dim_head=8,
@@ -59,7 +60,8 @@ def _build(batch_shapes=(1, 4), max_batch=4, chunk_tokens=4, **model_kw):
     )
     cont = ContinuousEngine(
         model=model, variables=params, max_batch=max_batch,
-        chunk_tokens=chunk_tokens, registry=MetricsRegistry(),
+        chunk_tokens=chunk_tokens, prefill_batch=prefill_batch,
+        registry=MetricsRegistry(),
     )
     return micro, cont
 
@@ -182,6 +184,96 @@ class TestDecodeCompositionInvariance:
         np.testing.assert_array_equal(toks[1], alone[0])
 
 
+class TestBatchedPrefill:
+    """Batched multi-slot admission (`prefill_into_slots`): composition
+    invariance and the ceil(R / prefill_batch) dispatch contract."""
+
+    def test_together_vs_one_at_a_time(self, engines):
+        """The acceptance invariant: admitting rows {a, b} in ONE batched
+        dispatch yields the same tokens as admitting them one at a time —
+        and both match the micro engine serving each row alone."""
+        micro, _ = engines
+        _, cont = _build(prefill_batch=2)
+        alone99, _ = micro.generate([spec(99)])
+        alone55, _ = micro.generate([spec(55)])
+
+        cont.prefill_slots([(0, spec(99)), (1, spec(55))])  # together
+        _drain(cont)
+        together = cont.harvest([0, 1])
+        cont.release([0, 1])
+
+        cont.prefill_slot(2, spec(99))  # one at a time, mid-flight apart
+        cont.step_chunk()
+        cont.prefill_slot(3, spec(55))
+        _drain(cont)
+        separate = cont.harvest([2, 3])
+        cont.release([2, 3])
+
+        np.testing.assert_array_equal(together[0], alone99[0])
+        np.testing.assert_array_equal(together[1], alone55[0])
+        np.testing.assert_array_equal(separate[0], together[0])
+        np.testing.assert_array_equal(separate[1], together[1])
+
+    def test_short_wave_padding_is_harmless(self):
+        """A 1-row wave through prefill_batch=4 pads by repeating the row;
+        the duplicate writes must not perturb the admitted slot or the
+        mid-image neighbor they sit next to."""
+        micro, cont = _build(prefill_batch=4)
+        alone7, _ = micro.generate([spec(7)])
+        alone99, _ = micro.generate([spec(99)])
+        cont.prefill_slot(0, spec(99))  # established neighbor
+        cont.step_chunk()  # slot 0 is mid-image
+        cont.prefill_slots([(2, spec(7))])  # short wave, 3 padding rows
+        pos, act = _drain(cont)
+        assert act[0] and act[2]
+        toks = cont.harvest([0, 2])
+        cont.release([0, 2])
+        np.testing.assert_array_equal(toks[1], alone7[0])
+        np.testing.assert_array_equal(toks[0], alone99[0])
+
+    def test_dispatch_count_and_zero_compiles(self):
+        """Admitting R rows costs ceil(R / prefill_batch) prefill
+        dispatches, and — warmup having compiled the ONE batched prefill
+        program — a full post-warmup admit/decode/retire cycle compiles
+        nothing (utils/compile_guard.py)."""
+        from dalle_pytorch_tpu.utils import assert_no_recompiles
+
+        _, cont = _build(prefill_batch=2)
+        cont.warmup()
+        with assert_no_recompiles() as tally:
+            cont.prefill_slots([(0, spec(1)), (1, spec(2))])
+            cont.prefill_slots([(2, spec(3))])  # R=3 -> ceil(3/2)=2 waves
+            _drain(cont)
+            toks = cont.harvest([0, 1, 2])
+            cont.release([0, 1, 2])
+        assert tally.count == 0
+        assert toks.shape == (3, IMG_SEQ)
+        reg = cont.registry
+        assert reg.get("dalle_serving_prefills_total").value == 3
+        assert reg.get("dalle_serving_prefill_dispatches_total").value == 2
+
+    def test_batcher_splits_admission_waves(self):
+        """The worker admits a queued backlog in groups of the engine's
+        prefill_batch. A dummy request parks the worker inside a gated
+        chunk while the real backlog queues, so the admission wave is
+        deterministic: 4 free slots, 4 queued rows -> dispatches [2, 2],
+        then the leftover row -> [1]."""
+        gate = threading.Event()
+        eng = FakeBatchedEngine(prefill_batch=2, chunk=8, block_event=gate)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        park = b.submit([spec(41)])  # worker admits this, blocks in chunk
+        assert eng.chunk_entered.wait(10.0)  # worker provably parked
+        reqs = [b.submit([spec(i)]) for i in range(5)]
+        gate.set()
+        park.future.result(timeout=10)
+        for i, r in enumerate(reqs):
+            toks, _ = r.future.result(timeout=10)
+            assert int(toks[0, 0]) == i
+        b.shutdown()
+        # calls: [1] (dummy), [2, 2] (the parked backlog wave), [1]
+        assert eng.prefill_calls == [1, 2, 2, 1]
+
+
 class TestInvarianceAcrossExecutors:
     def test_scan_executor(self):
         """Per-row index rides the depth-stacked scan cache too."""
@@ -192,6 +284,22 @@ class TestInvarianceAcrossExecutors:
         cont.prefill_slot(0, spec(55))
         _drain(cont)
         toks = cont.harvest([0])
+        np.testing.assert_array_equal(toks[0], alone[0])
+
+    def test_flash_decode_impl(self):
+        """The whole continuous stack over the Pallas flash-decode kernel
+        (attn_impl="flash", interpret mode on CPU): batched admission and
+        mid-flight admission still reproduce the micro engine bit-for-bit
+        — both engines run the SAME kernel per row, so per-row live
+        lengths vs lockstep decode cannot drift."""
+        micro, cont = _build(attn_impl="flash", prefill_batch=2)
+        alone, _ = micro.generate([spec(55)])
+        cont.prefill_slot(0, spec(99))
+        cont.step_chunk()  # slot 0 mid-image
+        cont.prefill_slots([(1, spec(55)), (2, spec(7))])
+        _drain(cont)
+        toks = cont.harvest([1])
+        cont.release([0, 1, 2])
         np.testing.assert_array_equal(toks[0], alone[0])
 
     def test_non_rotary_axial_positions(self):
@@ -325,6 +433,9 @@ class FakeContinuousEngine:
         self.fail_chunks = fail_chunks
         self.fail_release = fail_release
         self.block_event = block_event
+        # set when the worker ENTERS a gated chunk — tests that need the
+        # worker provably parked wait on this instead of sleeping
+        self.chunk_entered = threading.Event()
         self.pos = np.zeros(self.max_batch, np.int64)
         self.active = np.zeros(self.max_batch, bool)
         self.seeds = np.zeros(self.max_batch, np.int64)
@@ -336,6 +447,7 @@ class FakeContinuousEngine:
 
     def step_chunk(self):
         if self.block_event is not None:
+            self.chunk_entered.set()
             assert self.block_event.wait(10.0)
         if self.fail_chunks:
             raise RuntimeError("XLA fell over")
@@ -360,6 +472,27 @@ class FakeContinuousEngine:
 
     def slots_active_gauge(self, n):
         self.registry.gauge("dalle_serving_slots_active").set(n)
+
+
+class FakeBatchedEngine(FakeContinuousEngine):
+    """Adds the batched-admission surface: `prefill_slots` + `prefill_batch`,
+    recording each dispatch's row count for the wave-splitting tests."""
+
+    def __init__(self, prefill_batch=2, **kw):
+        super().__init__(**kw)
+        self.prefill_batch = prefill_batch
+        self.prefill_calls = []
+
+    def prefill_slots(self, assignments):
+        assert 1 <= len(assignments) <= self.prefill_batch
+        self.prefill_calls.append(len(assignments))
+        for slot, sp in assignments:
+            super().prefill_slot(slot, sp)
+
+    def prefill_slot(self, slot, sp):  # the batcher must not use this path
+        raise AssertionError(
+            "batcher fell back to per-row prefill despite prefill_slots"
+        )
 
 
 class TestContinuousBatcher:

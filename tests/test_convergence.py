@@ -13,11 +13,16 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parents[1]
 ENV = {**os.environ, "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"}
 
 
 class TestRainbowConvergence:
+    @pytest.mark.slow  # ~200 s of real training: a quarter of the fast
+    # tier's whole time budget for one test — it belongs with the other
+    # long-running integration tests (same tier as the serve-CLI e2e)
     def test_overfit_reaches_exact_accuracy(self, tmp_path):
         result = subprocess.run(
             [

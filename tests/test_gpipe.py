@@ -210,8 +210,10 @@ def test_composes_with_data_parallel_axis():
         )
         return outs[None]
 
+    from dalle_pytorch_tpu.parallel.mesh import shard_map
+
     outs = jax.jit(
-        jax.shard_map(
+        shard_map(
             stage_fn,
             mesh=mesh,
             in_specs=(P("pp"), P(None, "dp")),  # batch rows over dp

@@ -1,0 +1,207 @@
+"""Pallas flash-decode kernel vs the dense cached-attention oracle.
+
+The acceptance contract of the decode hot-path overhaul: attending a query
+chunk against the slot KV cache with per-row live lengths must match dense
+attention under the causal-over-prefix mask to fp32 tolerance — across
+per-row lengths (continuous-batching slots admitted at different times),
+chunk sizes (single-token decode AND multi-token prefill), key padding
+(cache lengths that don't divide the K block), and garbage beyond each
+row's live prefix (the skip must be a *mask*, not an assumption about
+zeroed cache). Runs in Pallas interpret mode on CPU.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dalle_pytorch_tpu.ops.attention_core import dense_attention
+from dalle_pytorch_tpu.ops.pallas_decode import flash_decode_attention
+
+
+def _oracle(q, k, v, lengths):
+    """Dense cached attention: query row i of batch row b attends to cache
+    positions <= lengths[b] - n + i — the exact mask models/attention.py
+    builds on the dense cached path."""
+    n = q.shape[2]
+    s = k.shape[2]
+    mask = (
+        jnp.arange(s)[None, None, :]
+        <= (lengths[:, None, None] - n + jnp.arange(n)[None, :, None])
+    )
+    return dense_attention(q, k, v, mask=mask[:, None])
+
+
+def _qkv(b, h, n, s, d, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, h, n, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("block_k", [8, 16, 128])
+def test_single_token_per_row_lengths(block_k):
+    """n=1 decode: every row at its OWN live length, including length 1
+    (just-admitted slot) and the full cache."""
+    b, h, s, d = 4, 2, 37, 16
+    q, k, v = _qkv(b, h, 1, s, d)
+    lengths = jnp.asarray([1, 9, 20, s], jnp.int32)
+    out = flash_decode_attention(q, k, v, lengths, block_k=block_k)
+    np.testing.assert_allclose(
+        out, _oracle(q, k, v, lengths), atol=2e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("n", [4, 9])
+def test_chunk_queries_causal_within_chunk(n):
+    """n>1 (prefill / K-token chunk): rows inside the chunk see strictly
+    growing prefixes — causality within the chunk must match dense."""
+    b, h, s, d = 3, 2, 25, 8
+    q, k, v = _qkv(b, h, n, s, d, seed=1)
+    lengths = jnp.asarray([n, n + 7, s], jnp.int32)
+    out = flash_decode_attention(q, k, v, lengths, block_k=16)
+    np.testing.assert_allclose(
+        out, _oracle(q, k, v, lengths), atol=2e-5, rtol=1e-5
+    )
+
+
+def test_key_padding_and_garbage_beyond_length():
+    """Two hazards at once: the cache length doesn't divide block_k (the
+    kernel pads K/V), and positions beyond each row's live length hold
+    huge finite garbage (a previous slot occupant's stale keys, scaled up)
+    — dead positions must be MASKED, not merely assumed zero: unmasked,
+    the 1e4-magnitude logits would dominate every softmax."""
+    b, h, s, d = 2, 2, 21, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=2)
+    lengths = jnp.asarray([5, 13], jnp.int32)
+    poison = jnp.where(
+        jnp.arange(s)[None, None, :, None] >= lengths[:, None, None, None],
+        1e4,
+        0.0,
+    )
+    out = flash_decode_attention(q, k + poison, v + poison, lengths, block_k=8)
+    np.testing.assert_allclose(
+        out, _oracle(q, k, v, lengths), atol=2e-5, rtol=1e-5
+    )
+
+
+def test_lockstep_equals_per_row_at_same_length():
+    """A batch decoding in lockstep (all lengths equal) must produce the
+    same rows as the same data served at per-row lengths that happen to
+    coincide — the decode-composition-invariance property at kernel level."""
+    b, h, s, d = 3, 2, 32, 16
+    q, k, v = _qkv(b, h, 1, s, d, seed=3)
+    lock = flash_decode_attention(
+        q, k, v, jnp.full((b,), 17, jnp.int32), block_k=8
+    )
+    per_row = flash_decode_attention(
+        q, k, v, jnp.asarray([17, 17, 17], jnp.int32), block_k=8
+    )
+    np.testing.assert_array_equal(np.asarray(lock), np.asarray(per_row))
+
+
+def test_under_jit_and_scan():
+    """The serving decode loop runs the kernel inside jit(lax.scan(...));
+    the traced-lengths path must lower cleanly and stay correct."""
+    b, h, s, d = 2, 2, 24, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=4)
+
+    def step(lengths, _):
+        out = flash_decode_attention(q, k, v, lengths, block_k=8)
+        return lengths + 1, out
+
+    lengths0 = jnp.asarray([3, 11], jnp.int32)
+    _, outs = jax.jit(
+        lambda l: jax.lax.scan(step, l, None, length=3)
+    )(lengths0)
+    for i in range(3):
+        np.testing.assert_allclose(
+            outs[i], _oracle(q, k, v, lengths0 + i), atol=2e-5, rtol=1e-5
+        )
+
+
+def test_bf16_inputs_fp32_accumulation():
+    b, h, s, d = 2, 2, 16, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=5)
+    lengths = jnp.asarray([7, 16], jnp.int32)
+    out = flash_decode_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16), lengths, block_k=8,
+    )
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), _oracle(q, k, v, lengths),
+        atol=3e-2, rtol=3e-2,
+    )
+
+
+# --------------------------------------------- module-level dispatch wiring
+
+
+class TestAttentionDispatch:
+    """`Attention` cached-path kernel selection (`_use_flash_decode`)."""
+
+    def _run(self, impl, index, seed=0, static_mask=None):
+        from dalle_pytorch_tpu.models.attention import Attention
+
+        b, dim, h, dh, s = 2, 32, 2, 8, 21
+        rng = np.random.RandomState(seed)
+        x = jnp.asarray(rng.randn(b, 1, dim), jnp.float32)
+        cache = {
+            "k": jnp.asarray(rng.randn(b, h, s, dh), jnp.float32),
+            "v": jnp.asarray(rng.randn(b, h, s, dh), jnp.float32),
+            "index": index,
+        }
+        m = Attention(
+            dim=dim, seq_len=s, heads=h, dim_head=dh, attn_impl=impl,
+            static_mask=static_mask,
+        )
+        params = m.init(jax.random.PRNGKey(0), x, cache=cache)
+        out, new_cache = m.apply(params, x, cache=cache)
+        return out, new_cache
+
+    @pytest.mark.parametrize(
+        "index",
+        [jnp.int32(7), jnp.asarray([3, 11], jnp.int32)],
+        ids=["scalar", "per_row"],
+    )
+    def test_flash_matches_dense(self, index):
+        dense_out, dense_cache = self._run("dense", index)
+        flash_out, flash_cache = self._run("flash", index)
+        np.testing.assert_allclose(flash_out, dense_out, atol=1e-5, rtol=1e-5)
+        np.testing.assert_array_equal(
+            np.asarray(dense_cache["index"]), np.asarray(flash_cache["index"])
+        )
+
+    def test_pattern_mask_falls_back_to_dense(self):
+        """A static pattern mask cannot drive the block skip: flash must
+        fall back to the dense row-sliced path, not silently drop the
+        mask."""
+        s = 21
+        sm = np.tril(np.ones((s, s), dtype=bool))
+        sm[:, ::2] = False  # an asymmetric pattern the mask must honor
+        sm[np.arange(s), np.arange(s)] = True
+        dense_out, _ = self._run("dense", jnp.int32(7), static_mask=sm)
+        flash_out, _ = self._run("flash", jnp.int32(7), static_mask=sm)
+        np.testing.assert_allclose(flash_out, dense_out, atol=1e-5, rtol=1e-5)
+
+    def test_auto_threshold(self, monkeypatch):
+        """auto switches on cache length: below the constant the cached
+        path stays dense (no pallas lowering), at/above it runs flash."""
+        import dalle_pytorch_tpu.models.attention as attention_mod
+
+        calls = []
+        real = attention_mod.flash_decode_attention
+
+        def spy(*args, **kwargs):
+            calls.append(1)
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(attention_mod, "flash_decode_attention", spy)
+        monkeypatch.setattr(attention_mod, "AUTO_FLASH_DECODE_MIN_LEN", 32)
+        self._run("auto", jnp.int32(7))  # cache len 21 < 32
+        assert not calls
+        monkeypatch.setattr(attention_mod, "AUTO_FLASH_DECODE_MIN_LEN", 16)
+        self._run("auto", jnp.int32(7))  # 21 >= 16
+        assert calls
