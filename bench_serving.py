@@ -24,7 +24,11 @@ open-loop: SERVE_RATE_RPS (default auto-calibrated), SERVE_OPEN_SECONDS
 (10), SERVE_CHUNK_TOKENS (4), SERVE_PREFILL_BATCH (4), SERVE_ARRIVAL_SEED
 (0). The continuous JSON line reports admission-dispatch accounting
 (prefill_dispatches / prefill_rows_per_dispatch) so the batched-prefill
-amortization is visible in the output.
+amortization is visible in the output. Both open-loop lines carry a
+`stages` per-stage breakdown ({stage: {mean_ms, count}} deltas from the
+`dalle_serving_stage_seconds` family over the measured window only), so
+a TTFT regression is attributable to queue vs prefill vs chunk without
+re-running under a tracer.
 """
 
 from __future__ import annotations
@@ -158,6 +162,35 @@ def _percentile(values, q):
         return None
     ordered = sorted(values)
     return ordered[min(len(ordered) - 1, max(0, int(q * len(ordered))))]
+
+
+def _stage_snapshot(registry):
+    """(sum, count) per stage label of the batcher's stage family — taken
+    before a measured window so the breakdown excludes warmup and the
+    saturation-calibration flood."""
+    fam = registry.get("dalle_serving_stage_seconds")
+    if fam is None:
+        return {}
+    return {label: (child.sum, child.count) for label, child in fam.items()}
+
+
+def _stage_breakdown(registry, before):
+    """Per-stage deltas since `before` as {stage: {mean_ms, count}} — the
+    JSON-line view of where a request's wall time went (queue vs
+    prefill/chunk/harvest vs the micro engine's generate)."""
+    fam = registry.get("dalle_serving_stage_seconds")
+    if fam is None:
+        return {}
+    out = {}
+    for label, child in fam.items():
+        s0, c0 = before.get(label, (0.0, 0))
+        dc = child.count - c0
+        if dc > 0:
+            out[label] = {
+                "mean_ms": round(1000.0 * (child.sum - s0) / dc, 3),
+                "count": int(dc),
+            }
+    return out
 
 
 def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0):
@@ -340,11 +373,13 @@ def main_open_loop():
         "continuous_saturation_rps": round(cont_cap, 3),
     }
 
+    micro_stages0 = _stage_snapshot(micro.registry)
     micro_stats = run_open_loop(mb, text_ids, arrivals, seeds)
     mb.shutdown(drain=True)
     micro_line = {
         **common, "engine": "micro", "value": micro_stats["rps"],
         "max_delay_ms": delay_ms, **micro_stats,
+        "stages": _stage_breakdown(micro.registry, micro_stages0),
     }
     print(json.dumps(micro_line), flush=True)
 
@@ -357,6 +392,7 @@ def main_open_loop():
     pf_disp0 = cont.registry.get(
         "dalle_serving_prefill_dispatches_total"
     ).value
+    cont_stages0 = _stage_snapshot(cont.registry)
     cont_stats = run_open_loop(cb, text_ids, arrivals, seeds)
     cb.shutdown(drain=True)
     pf_rows = (
@@ -376,6 +412,7 @@ def main_open_loop():
             round(pf_rows / pf_disp, 2) if pf_disp else None
         ),
         **cont_stats,
+        "stages": _stage_breakdown(cont.registry, cont_stages0),
     }
     if micro_stats["rps"]:
         cont_line["rps_ratio_vs_micro"] = round(

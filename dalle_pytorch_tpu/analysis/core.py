@@ -49,6 +49,12 @@ class Finding:
     #: found under) — fingerprints use THIS, so a baseline written from one
     #: directory still matches when the linter runs from another
     stable_path: str = ""
+    #: "error" (always a bug: sync under tracing, donated reuse, ...) or
+    #: "warning" (needs justification: sync in a `# tracelint: hotloop`
+    #: loop). Severity is presentation + exit-code tier only — it is NOT
+    #: part of the fingerprint, so retiering a rule never invalidates a
+    #: baseline.
+    severity: str = "error"
 
     def fingerprint(self) -> str:
         """Stable identity for baselining: rule + root-relative path +
@@ -60,7 +66,8 @@ class Finding:
         return hashlib.sha1(raw).hexdigest()[:16]
 
     def render(self) -> str:
-        out = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        sev = "" if self.severity == "error" else f" {self.severity}:"
+        out = f"{self.path}:{self.line}: {self.rule}{sev} {self.message}"
         if self.snippet:
             out += f"\n    {self.snippet.strip()}"
         return out
@@ -70,6 +77,7 @@ class Finding:
             "rule": self.rule,
             "path": self.path,
             "line": self.line,
+            "severity": self.severity,
             "message": self.message,
             "snippet": self.snippet.strip(),
             "fingerprint": self.fingerprint(),
@@ -145,7 +153,10 @@ class FileContext:
             return self.lines[line - 1]
         return ""
 
-    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+    def finding(
+        self, rule: str, node: ast.AST, message: str,
+        severity: str = "error",
+    ) -> Finding:
         line = getattr(node, "lineno", 1)
         return Finding(
             rule=rule,
@@ -154,6 +165,7 @@ class FileContext:
             message=message,
             snippet=self.snippet(line),
             stable_path=self.stable_path,
+            severity=severity,
         )
 
     def is_hotloop(self, func: ast.AST) -> bool:
@@ -226,4 +238,14 @@ class LintResult:
 
     @property
     def clean(self) -> bool:
+        """No findings of ANY severity: warnings still need an inline
+        justification before the package gate goes green."""
         return not self.findings
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warning"]

@@ -9,7 +9,11 @@ Usage (CLI is also installed as `dalle-tpu-lint`):
     python -m dalle_pytorch_tpu.analysis --select TL003,TL006
     python -m dalle_pytorch_tpu.analysis --write-baseline     # grandfather
 
-Exit codes: 0 clean, 1 new findings, 2 usage/internal error.
+Exit codes are a severity bitmask: 0 clean, bit 0 (1) new error-tier
+findings, bit 2 (4) new warning-tier findings (TL002's hot-loop tier) —
+so 1 = errors only, 4 = warnings only, 5 = both; 2 stays the
+usage/internal-error code. CI that only blocks on errors can test
+`rc & 1`; `rc != 0` keeps the strict gate.
 
 The driver builds the package-wide `DonationRegistry` over EVERY file it
 was pointed at before running per-file rules, so TL003 sees donation
@@ -150,6 +154,8 @@ def _render_text(result: LintResult) -> str:
         f"{result.files_checked} file(s)"
     )
     extras = []
+    if result.warnings:
+        extras.append(f"{len(result.warnings)} warning-tier")
     if result.suppressed:
         extras.append(f"{len(result.suppressed)} suppressed")
     if result.baselined:
@@ -176,8 +182,9 @@ def _render_github(result: LintResult) -> str:
     line (not a command, so it lands in the raw log only)."""
     out: List[str] = []
     for f in result.findings:
+        command = "error" if f.severity == "error" else "warning"
         out.append(
-            f"::error file={_gh_escape(f.path, True)},"
+            f"::{command} file={_gh_escape(f.path, True)},"
             f"line={f.line},"
             f"title={_gh_escape(f'tracelint {f.rule}', True)}"
             f"::{_gh_escape(f.message)}"
@@ -296,7 +303,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "github": _render_github,
     }[args.format]
     print(renderer(result))
-    return 0 if result.clean else 1
+    # severity bitmask (module docstring): errors set bit 0, warning-tier
+    # findings set bit 2 — bit 1 stays reserved for usage errors (2)
+    return (1 if result.errors else 0) | (4 if result.warnings else 0)
 
 
 if __name__ == "__main__":

@@ -10,8 +10,10 @@ TL001  Python `if`/`while`/`assert` on a traced parameter of a jit/pjit/
        silently recompiles per value, destroying the compiled-shape ladder.
 TL002  device->host syncs (`.item()`, `float()/int()/bool()` on arrays,
        `np.asarray`, `jax.device_get`, `.block_until_ready()`) inside
-       traced functions, or on engine state inside functions marked
-       `# tracelint: hotloop` (the serving admit/chunk/retire loops):
+       traced functions (error tier — always a bug), or on engine state
+       inside functions marked `# tracelint: hotloop` (the serving
+       admit/chunk/retire loops; warning tier with its own exit-code bit
+       — a sync there needs a reasoned suppression, not deletion):
        every unplanned sync stalls the dispatch pipeline.
 TL003  a donated argument read after the donating dispatch: donation
        invalidates the buffer, so the read returns garbage or raises —
@@ -157,6 +159,14 @@ class HostSyncRule(Rule):
         "`# tracelint: hotloop`-marked serving loop"
     )
 
+    # Severity tiers: a sync UNDER TRACING is always a bug (error tier —
+    # it concretizes or stalls on every call, there is no legitimate
+    # unannotated form); a sync in a hotloop-marked host function is a
+    # hazard needing justification (warning tier, its own exit-code bit)
+    # — the designed chunk-boundary syncs live there behind reasoned
+    # suppressions, and a new one may be a deliberate boundary the author
+    # hasn't annotated yet.
+
     def check(self, ctx: FileContext, package) -> Iterator[Finding]:
         index = _jax_index(ctx)
         for func, info in index.traced.items():
@@ -217,6 +227,7 @@ class HostSyncRule(Rule):
                     f"`.{fname}()` in a hot loop stalls the dispatch "
                     "pipeline — move the sync to a chunk boundary or "
                     "justify it with a suppression",
+                    severity="warning",
                 )
             elif dotted.endswith("jax.device_get") or dotted.endswith(
                 "jax.block_until_ready"
@@ -226,6 +237,7 @@ class HostSyncRule(Rule):
                     f"`{dotted}` in a hot loop — every call is a "
                     "device round trip; batch transfers at the boundary "
                     "or justify with a suppression",
+                    severity="warning",
                 )
             elif _is_np_call(node, ("asarray", "array")) and node.args and (
                 _mentions_self_state(node.args[0], derived)
@@ -236,6 +248,7 @@ class HostSyncRule(Rule):
                     "implicit device->host sync — make it explicit "
                     "(jax.device_get at the designed boundary) or justify "
                     "with a suppression",
+                    severity="warning",
                 )
 
 

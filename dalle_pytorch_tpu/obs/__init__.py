@@ -1,0 +1,43 @@
+"""Request-scoped observability for the serving stack.
+
+The debugging surface production LM serving systems are tuned off
+(Orca's iteration-level scheduling, vLLM's continuous batching) is the
+per-request stage breakdown: where did THIS request's latency go — the
+queue, the prefill wave, a slow decode chunk, the harvest? Aggregate
+Prometheus counters can't answer that; these modules can:
+
+  * `tracing.py`  — `Span`/`Trace`/`Tracer`: a lock-safe in-process span
+    pipeline. Trace IDs are minted at HTTP ingress and propagated through
+    the batcher worker's admit→prefill→chunk→retire loop, recording
+    per-stage wall time plus dispatch metadata (wave size, chunk index,
+    compile events via `utils/compile_guard`). Finished traces land in a
+    bounded ring buffer and export as Chrome/Perfetto `trace_event` JSON
+    (`GET /debug/traces`, `serve.py --trace-dump`). A disabled tracer is
+    zero-overhead: every call returns shared null singletons and the
+    `spans_created` counter stays at zero (pinned by test).
+  * `logging.py`  — `StructuredLog`: one JSON line per completed request
+    (trace ID + stage breakdown + outcome) and lifecycle events,
+    replacing ad-hoc prints in the serving path.
+  * `profiler.py` — `ProfilerCapture`: on-demand `jax.profiler` capture
+    behind `POST /debug/profile?seconds=N` (root-gated, single-flight,
+    writes a TensorBoard trace dir) so a TPU hotspot can be captured
+    from a live server without a restart.
+
+Stage timings also feed the `dalle_serving_stage_seconds{stage=}`
+histogram family (`training/metrics.py`), so `/metrics` and the traces
+agree on where the time went.
+"""
+
+from dalle_pytorch_tpu.obs.tracing import NULL_TRACE, Span, Trace, Tracer
+from dalle_pytorch_tpu.obs.logging import StructuredLog
+from dalle_pytorch_tpu.obs.profiler import ProfilerBusy, ProfilerCapture
+
+__all__ = [
+    "NULL_TRACE",
+    "ProfilerBusy",
+    "ProfilerCapture",
+    "Span",
+    "StructuredLog",
+    "Trace",
+    "Tracer",
+]

@@ -1,0 +1,82 @@
+"""Structured JSON logging for the serving path.
+
+One JSON object per line (JSONL on a stream, stdout by default): a
+`request` line per completed request — trace ID, outcome, HTTP status,
+latency, per-stage breakdown — plus free-form lifecycle `event` lines
+(warmup, shutdown, profile captures). This replaces ad-hoc prints in the
+serving path with lines an aggregator can parse; the one human-first
+exception is `serve.py`'s `[serve] listening on ...` readiness line,
+which orchestrators (and the e2e tests) pattern-match.
+
+Request-line schema (keys always present):
+
+    {"ts": <unix seconds>, "event": "request", "trace_id": str,
+     "outcome": "ok" | "rejected" | "timeout" | "cancelled" | "error"
+               | "shutdown",
+     "status": <http code>, "latency_ms": float,
+     "stages": {"queue": ms, "prefill": ms, "chunk": ms, ...}}
+
+plus whatever extra fields the caller attaches (prompt length, rows,
+seed, error text). `stages` is empty when tracing is disabled — the log
+line still records outcome and latency.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+
+class StructuredLog:
+    """Thread-safe JSONL writer. Failures to write never raise into the
+    serving path (a closed pipe must not fail a request)."""
+
+    def __init__(self, stream=None, component: str = "dalle.serving"):
+        self._stream = stream if stream is not None else sys.stdout
+        self._component = component
+        self._lock = threading.Lock()
+
+    def _emit(self, record: Dict) -> None:
+        line = json.dumps(record, default=str)
+        try:
+            with self._lock:
+                self._stream.write(line + "\n")
+                self._stream.flush()
+        except (ValueError, OSError):
+            pass  # stream closed mid-shutdown; the request already succeeded
+
+    def event(self, event: str, **fields) -> None:
+        """Free-form lifecycle line (warmup, listening, shutdown, ...)."""
+        self._emit({
+            "ts": round(time.time(), 3),
+            "component": self._component,
+            "event": event,
+            **fields,
+        })
+
+    def request(
+        self,
+        trace_id: str,
+        outcome: str,
+        status: int,
+        latency_ms: float,
+        stages: Optional[Dict[str, float]] = None,
+        **fields,
+    ) -> None:
+        """One line per completed (or failed) request."""
+        self._emit({
+            "ts": round(time.time(), 3),
+            "component": self._component,
+            "event": "request",
+            "trace_id": trace_id,
+            "outcome": outcome,
+            "status": int(status),
+            "latency_ms": round(float(latency_ms), 2),
+            "stages": {
+                k: round(v * 1000.0, 2) for k, v in (stages or {}).items()
+            },
+            **fields,
+        })

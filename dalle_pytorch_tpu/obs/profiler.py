@@ -1,0 +1,103 @@
+"""On-demand `jax.profiler` capture for a live server.
+
+`training/metrics.py:ProfilerHook` captures a trace around a chosen
+TRAINING step; a serving hotspot shows up on a process that has been up
+for days and must not be restarted to attach a profiler. `ProfilerCapture`
+is the serving-side answer: `POST /debug/profile?seconds=N` starts a
+`jax.profiler` trace, holds it open for N seconds of live traffic, stops
+it, and returns the TensorBoard trace directory (`tensorboard --logdir`
+or xprof reads it).
+
+Guard rails, because the profiler is process-global state:
+
+  * single-flight — one capture at a time; a second request while one is
+    in flight raises `ProfilerBusy` (HTTP 409). Concurrent start_trace
+    calls would raise deep inside jax otherwise.
+  * root-gated — only the root process of a multi-host deployment
+    captures (`jax.process_index() == 0`); non-root raises
+    `PermissionError` (HTTP 403) instead of writing trace dirs on every
+    host.
+  * bounded — `seconds` is clamped to `max_seconds` so a typo can't hold
+    the profiler (and its buffer growth) open for an hour.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already in flight (single-flight contract)."""
+
+
+class ProfilerCapture:
+    def __init__(self, out_dir: str = "profiles", max_seconds: float = 60.0):
+        self.out_dir = Path(out_dir)
+        self.max_seconds = float(max_seconds)
+        self._lock = threading.Lock()  # held for the whole capture
+        self.last_dir: Optional[Path] = None
+        self.captures = 0
+
+    @property
+    def busy(self) -> bool:
+        return self._lock.locked()
+
+    # jax touchpoints behind override seams: the HTTP-wiring tests drive
+    # the real guard-rail logic with these stubbed (a first real capture
+    # in a compile-heavy process pays O(10 s) of one-time profiler
+    # initialization — too slow and load-sensitive for the fast tier)
+
+    def _process_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def _start(self, trace_dir: Path) -> None:
+        import jax
+
+        jax.profiler.start_trace(str(trace_dir))
+
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+
+    def capture(self, seconds: float) -> Path:
+        """Blocking capture: start the trace, sleep `seconds` of live
+        traffic, stop, return the trace directory. Raises `ProfilerBusy`
+        / `PermissionError` / `ValueError` per the guard rails above."""
+        seconds = float(seconds)
+        if not seconds > 0:
+            raise ValueError(f"seconds must be > 0, got {seconds}")
+        seconds = min(seconds, self.max_seconds)
+        if self._process_index() != 0:
+            raise PermissionError(
+                f"profiler capture is root-gated; this is process "
+                f"{self._process_index()}"
+            )
+        if not self._lock.acquire(blocking=False):
+            raise ProfilerBusy(
+                "a profiler capture is already in flight; retry when it "
+                "completes"
+            )
+        try:
+            trace_dir = self.out_dir / (
+                f"profile_{time.strftime('%Y%m%d_%H%M%S')}_{self.captures}"
+            )
+            # counted per attempt, not per success: a failed capture must
+            # not let a same-second retry reuse (and mix output into) the
+            # failed attempt's directory
+            self.captures += 1
+            trace_dir.mkdir(parents=True, exist_ok=True)
+            self._start(trace_dir)
+            try:
+                time.sleep(seconds)
+            finally:
+                self._stop()
+            self.last_dir = trace_dir
+            return trace_dir
+        finally:
+            self._lock.release()

@@ -33,6 +33,7 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from dalle_pytorch_tpu.obs.tracing import NULL_SPAN, NULL_TRACE
 from dalle_pytorch_tpu.serving.engine import SampleSpec
 
 
@@ -91,13 +92,25 @@ class GenRequest:
     (e.g. num_images samples of one prompt), flushed in a single batch so
     the result arrives whole."""
 
-    def __init__(self, specs: Sequence[SampleSpec], timeout_s: float = 120.0):
+    def __init__(
+        self,
+        specs: Sequence[SampleSpec],
+        timeout_s: float = 120.0,
+        trace=NULL_TRACE,
+    ):
         assert specs, "request needs at least one sample row"
         self.specs: List[SampleSpec] = list(specs)
         self.timeout_s = float(timeout_s)
         self.enqueued_at = time.monotonic()
         self.future = _Future()
         self._cancelled = threading.Event()
+        # request-scoped trace (obs/tracing.py), minted at HTTP ingress and
+        # carried through the worker so stage spans land on one tree; the
+        # default NULL_TRACE makes every span call a no-op for callers
+        # (benches, tests) that don't trace
+        self.trace = trace
+        self._queue_span = trace.begin("queue", rows=len(self.specs))
+        self._stage_span = NULL_SPAN  # current worker-side stage span
         # when the request's FIRST token existed on the host: the chunk
         # boundary after admission (continuous engine) or batch completion
         # (micro-batch engine — its tokens only materialize at scan end).
@@ -118,6 +131,21 @@ class GenRequest:
 
     def expired(self, now: float) -> bool:
         return now - self.enqueued_at > self.timeout_s
+
+
+def _unique_requests(reqs) -> List[GenRequest]:
+    """First-seen-order dedup by identity (GenRequest hashes by identity;
+    a multi-row request owns several slots but is one trace)."""
+    return list(dict.fromkeys(reqs))
+
+
+def _first_trace_id(reqs) -> Optional[str]:
+    """Exemplar for a shared-dispatch observation: the first traced
+    request's ID, or None when nothing in the group is traced."""
+    for req in reqs:
+        if req.trace:
+            return req.trace.trace_id
+    return None
 
 
 class MicroBatcher:
@@ -193,6 +221,18 @@ class MicroBatcher:
             f"{p}_request_latency_seconds",
             "enqueue-to-result latency per request",
         )
+        # per-stage wall time, labeled by stage — the aggregate view of the
+        # span tracer's per-request breakdown, so /metrics and
+        # /debug/traces agree on where the time went. Observed whether or
+        # not tracing is on; exemplars carry the most recent trace ID when
+        # it is (render(exemplars=True)).
+        self.stage_seconds = registry.histogram_family(
+            f"{p}_stage_seconds",
+            "wall time per request stage (queue/prefill/chunk/harvest for "
+            "the continuous engine; queue/generate for micro-batches; "
+            "respond is observed by the HTTP layer)",
+            label_name="stage",
+        )
 
         self._post_init()  # batching-mode instruments + subclass state must
         self._worker = threading.Thread(  # exist before the worker runs
@@ -238,14 +278,19 @@ class MicroBatcher:
     # -------------------------------------------------------------- intake
 
     def submit(
-        self, specs: Sequence[SampleSpec], timeout_s: float = 120.0
+        self,
+        specs: Sequence[SampleSpec],
+        timeout_s: float = 120.0,
+        trace=NULL_TRACE,
     ) -> GenRequest:
         """Enqueue one request; returns it (result via `req.future.result()`).
 
         Raises `QueueFullError` (backpressure) or `ShuttingDownError`
-        immediately instead of blocking the caller.
+        immediately instead of blocking the caller. `trace` (a
+        `Trace` from `obs/tracing.py`) rides on the request; the worker
+        records stage spans onto it.
         """
-        req = GenRequest(specs, timeout_s=timeout_s)
+        req = GenRequest(specs, timeout_s=timeout_s, trace=trace)
         with self._cond:
             if self._closed:
                 raise ShuttingDownError("batcher is shutting down")
@@ -295,12 +340,24 @@ class MicroBatcher:
                 self._pending.popleft()
                 self._pending_rows -= head.rows
                 self._m_cancelled.inc()
+                head.trace.end(head._queue_span, outcome="cancelled")
+                # requests that die queued still observe the queue stage
+                # so /metrics and the traces keep agreeing under overload
+                self.stage_seconds.labels("queue").observe(
+                    now - head.enqueued_at,
+                    exemplar=head.trace.trace_id or None,
+                )
                 head.future.set_exception(RequestCancelled("cancelled"))
                 continue
             if head.expired(now):
                 self._pending.popleft()
                 self._pending_rows -= head.rows
                 self._m_timeouts.inc()
+                head.trace.end(head._queue_span, outcome="timeout")
+                self.stage_seconds.labels("queue").observe(
+                    now - head.enqueued_at,
+                    exemplar=head.trace.trace_id or None,
+                )
                 head.future.set_exception(
                     RequestTimeout(
                         f"spent >{head.timeout_s:.1f}s queued; overloaded?"
@@ -364,6 +421,14 @@ class MicroBatcher:
         for req in batch:
             specs.extend(req.specs)
         t0 = time.monotonic()
+        for req in batch:
+            req.trace.end(req._queue_span)
+            self.stage_seconds.labels("queue").observe(
+                t0 - req.enqueued_at, exemplar=req.trace.trace_id or None
+            )
+            req._stage_span = req.trace.begin(
+                "generate", rows=req.rows, batch_rows=len(specs)
+            )
         try:
             tokens, pixels = self.engine.generate(specs)
         except Exception as exc:  # fail fast: every waiter gets the error
@@ -371,7 +436,13 @@ class MicroBatcher:
             self._last_error_at = time.monotonic()
             self.last_error = exc
             self._m_errors.inc()
+            # errored batches still observe the stage so /metrics and the
+            # traces keep agreeing (same contract as the harvest path)
+            self.stage_seconds.labels("generate").observe(
+                self._last_error_at - t0, exemplar=_first_trace_id(batch)
+            )
             for req in batch:
+                req.trace.end(req._stage_span, error=repr(exc))
                 req.future.set_exception(exc)
             return
         self.last_error = None  # engine recovered: let /healthz go green again
@@ -383,8 +454,14 @@ class MicroBatcher:
         self._m_batch_seconds.observe(batch_s)
         pick = getattr(self.engine, "pick_shape", None)
         shape = pick(len(specs)) if pick is not None else len(specs)
-        self._m_occupancy_by_shape.labels(shape).observe(len(specs))
-        self._m_batch_seconds_by_shape.labels(shape).observe(batch_s)
+        ex = _first_trace_id(batch)
+        self._m_occupancy_by_shape.labels(shape).observe(
+            len(specs), exemplar=ex
+        )
+        self._m_batch_seconds_by_shape.labels(shape).observe(
+            batch_s, exemplar=ex
+        )
+        self.stage_seconds.labels("generate").observe(batch_s, exemplar=ex)
         offset = 0
         now = time.monotonic()
         for req in batch:
@@ -393,6 +470,7 @@ class MicroBatcher:
             offset += req.rows
             self._m_images.inc(req.rows)
             self._m_latency.observe(now - req.enqueued_at)
+            req.trace.end(req._stage_span, shape=shape)
             req.first_token_at = now
             req.future.set_result((toks, pix))
 
@@ -406,6 +484,11 @@ class MicroBatcher:
             if not drain:
                 while self._pending:
                     req = self._pending.popleft()
+                    req.trace.end(req._queue_span, outcome="shutdown")
+                    self.stage_seconds.labels("queue").observe(
+                        time.monotonic() - req.enqueued_at,
+                        exemplar=req.trace.trace_id or None,
+                    )
                     req.future.set_exception(
                         ShuttingDownError("server shutting down")
                     )
@@ -476,6 +559,9 @@ class ContinuousBatcher(MicroBatcher):
         self._m_admitted = self.registry.counter(
             f"{p}_admitted_total", "rows admitted into cache slots"
         )
+        # fallback chunk index for span metadata when the engine doesn't
+        # keep its own (`ContinuousEngine.chunk_index`; test fakes don't)
+        self._chunks_dispatched = 0
 
     # ------------------------------------------------------------- worker
 
@@ -509,25 +595,84 @@ class ContinuousBatcher(MicroBatcher):
                         inflight[slot] = (head, i)
                         admitted.append((slot, spec))
                     self._m_admitted.inc(head.rows)
+                    t_admit = time.monotonic()
+                    head.trace.end(head._queue_span)
+                    self.stage_seconds.labels("queue").observe(
+                        t_admit - head.enqueued_at,
+                        exemplar=head.trace.trace_id or None,
+                    )
+                    head._stage_span = head.trace.begin("prefill")
                     head = self._viable_head(time.monotonic())
                 self._m_depth.set(self._pending_rows)
 
+            # which engine dispatch is in flight, so a failure still
+            # observes the stage's wall time into stage_seconds — /metrics
+            # and the (abandoned) trace spans must agree on error paths too
+            stage_name = None
+            stage_t0 = 0.0
             try:
-                # batched admission: the whole wave goes in groups of the
-                # engine's fixed prefill batch — ceil(R / prefill_batch)
-                # dispatches instead of R (engines without the batched
-                # surface, e.g. test fakes, fall back to per-row prefill)
-                prefill_slots = getattr(self.engine, "prefill_slots", None)
-                if prefill_slots is not None:
-                    pb = max(1, int(getattr(self.engine, "prefill_batch", 1)))
-                    for i in range(0, len(admitted), pb):
-                        prefill_slots(admitted[i : i + pb])
-                else:
-                    for slot, spec in admitted:
-                        self.engine.prefill_slot(slot, spec)
+                if admitted:
+                    # batched admission: the whole wave goes in groups of
+                    # the engine's fixed prefill batch — ceil(R /
+                    # prefill_batch) dispatches instead of R (engines
+                    # without the batched surface, e.g. test fakes, fall
+                    # back to per-row prefill)
+                    tp0 = time.monotonic()
+                    stage_name, stage_t0 = "prefill", tp0
+                    dispatches = 0
+                    prefill_slots = getattr(self.engine, "prefill_slots", None)
+                    if prefill_slots is not None:
+                        pb = max(
+                            1, int(getattr(self.engine, "prefill_batch", 1))
+                        )
+                        for i in range(0, len(admitted), pb):
+                            prefill_slots(admitted[i : i + pb])
+                            dispatches += 1
+                    else:
+                        for slot, spec in admitted:
+                            self.engine.prefill_slot(slot, spec)
+                            dispatches += 1
+                    prefill_s = time.monotonic() - tp0
+                    stage_name = None
+                    wave_reqs = _unique_requests(
+                        inflight[slot][0] for slot, _ in admitted
+                    )
+                    for req in wave_reqs:
+                        req.trace.end(
+                            req._stage_span,
+                            wave_rows=len(admitted),
+                            dispatches=dispatches,
+                        )
+                    self.stage_seconds.labels("prefill").observe(
+                        prefill_s, exemplar=_first_trace_id(wave_reqs)
+                    )
+                chunk_reqs = _unique_requests(
+                    req for req, _ in inflight.values()
+                )
+                self._chunks_dispatched += 1
+                spans = [
+                    (
+                        req,
+                        req.trace.begin(
+                            "chunk", slots_active=len(inflight)
+                        ),
+                    )
+                    for req in chunk_reqs
+                ]
                 t0 = time.monotonic()
+                stage_name, stage_t0 = "chunk", t0
                 img_pos, _active = self.engine.step_chunk()
-                self._m_chunk_seconds.observe(time.monotonic() - t0)
+                chunk_s = time.monotonic() - t0
+                stage_name = None
+                chunk_index = getattr(
+                    self.engine, "chunk_index", self._chunks_dispatched
+                )
+                for req, sp in spans:
+                    req.trace.end(sp, chunk_index=chunk_index)
+                self._m_chunk_seconds.observe(chunk_s)
+                self.stage_seconds.labels("chunk").observe(
+                    chunk_s, exemplar=_first_trace_id(chunk_reqs)
+                )
 
                 now = time.monotonic()
                 finished = []
@@ -544,6 +689,15 @@ class ContinuousBatcher(MicroBatcher):
                     # requests nobody will ever serve)
                     self._retire(finished, inflight, partial)
             except Exception as exc:  # fail fast: every live request errors
+                if stage_name is not None:
+                    self.stage_seconds.labels(stage_name).observe(
+                        time.monotonic() - stage_t0,
+                        exemplar=_first_trace_id(
+                            _unique_requests(
+                                req for req, _ in inflight.values()
+                            )
+                        ),
+                    )
                 self._fail_all(exc, inflight, partial)
                 continue
             self._set_slots_gauge()
@@ -569,6 +723,9 @@ class ContinuousBatcher(MicroBatcher):
     def _retire(self, finished, inflight, partial) -> None:  # tracelint: hotloop
         """Harvest finished slots, resolve fully-collected requests, free
         the slots for the next admission wave."""
+        t0 = time.monotonic()
+        touched = _unique_requests(inflight[s][0] for s in finished)
+        hspans = [(req, req.trace.begin("harvest")) for req in touched]
         tokens = self.engine.harvest(finished)
         self.engine.release(finished)
         done: List = []  # (request, stacked rows) completed this boundary
@@ -581,7 +738,17 @@ class ContinuousBatcher(MicroBatcher):
             if info["remaining"] == 0:
                 del partial[req]
                 done.append((req, np.stack(info["tokens"])))
+        done_reqs = {req for req, _ in done}
+        # requests with rows still decoding get their harvest span closed
+        # now (it covered token collection only); completing requests keep
+        # theirs open across the pixel decode below
+        for req, sp in hspans:
+            if req not in done_reqs:
+                req.trace.end(sp, slots=len(finished), partial=True)
         if not done:
+            self.stage_seconds.labels("harvest").observe(
+                time.monotonic() - t0, exemplar=_first_trace_id(touched)
+            )
             return
         # ONE pixel-decode dispatch for every request completing at this
         # boundary (the engine pads to its fixed decode shape internally);
@@ -600,9 +767,22 @@ class ContinuousBatcher(MicroBatcher):
             self._last_error_at = time.monotonic()
             self.last_error = exc
             self._m_errors.inc()
+            # errored harvests still observe the stage so /metrics and the
+            # traces keep agreeing on where the time went
+            self.stage_seconds.labels("harvest").observe(
+                time.monotonic() - t0, exemplar=_first_trace_id(touched)
+            )
+            for req, sp in hspans:
+                if req in done_reqs:
+                    req.trace.end(sp, error=repr(exc))
             for req, _ in done:
                 req.future.set_exception(exc)
             return
+        harvest_s = time.monotonic() - t0
+        self.stage_seconds.labels("harvest").observe(
+            harvest_s, exemplar=_first_trace_id([req for req, _ in done])
+        )
+        done_spans = {req: sp for req, sp in hspans if req in done_reqs}
         offset = 0
         for req, toks in done:
             pix = (
@@ -612,6 +792,10 @@ class ContinuousBatcher(MicroBatcher):
             offset += req.rows
             self._m_images.inc(req.rows)
             self._m_latency.observe(now - req.enqueued_at)
+            req.trace.end(
+                done_spans.get(req, NULL_SPAN),
+                slots=len(finished), rows=req.rows,
+            )
             req.future.set_result((toks, pix))
             self.last_error = None  # a full request completed: healthy
 

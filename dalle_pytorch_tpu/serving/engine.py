@@ -415,6 +415,10 @@ class ContinuousEngine(GenerationEngine):
             "rows in one fixed-shape program)",
         )
         self._decode_pixels_jit = None
+        #: monotonic chunk-dispatch index (non-warmup), read by the
+        #: batcher as span metadata so a trace's chunk spans can be lined
+        #: up against engine-side dispatch accounting
+        self.chunk_index = 0
 
     # --------------------------------------------------------- slot ops
     # All device work is serialized under the inherited engine lock; the
@@ -488,6 +492,7 @@ class ContinuousEngine(GenerationEngine):
             ))
             if not _warmup:
                 self._m_chunks.inc()
+                self.chunk_index += 1
                 self.stats.batches += 1
             # the chunk boundary IS the designed sync point: retirement
             # decisions need the positions on the host, and fusing both

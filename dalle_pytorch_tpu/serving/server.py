@@ -12,7 +12,23 @@ Endpoints:
   GET  /metrics -> Prometheus text exposition from the shared registry
                    (`training/metrics.py:MetricsRegistry`): queue depth,
                    batch-occupancy histogram, request latency p50/p95,
-                   compile-cache hits, images/requests/batches totals.
+                   per-stage wall time, compile-cache hits,
+                   images/requests/batches totals. `?exemplars=1` adds
+                   OpenMetrics exemplar annotations (most recent trace ID
+                   per histogram).
+  GET  /debug/traces -> Chrome/Perfetto `trace_event` JSON of the most
+                   recent request traces (`obs/tracing.py` ring buffer);
+                   load the body in ui.perfetto.dev.
+  POST /debug/profile?seconds=N -> on-demand `jax.profiler` capture of N
+                   seconds of live traffic (root-gated -> 403,
+                   single-flight -> 409); returns the TensorBoard trace
+                   dir.
+
+Every /generate request gets a trace ID minted here at ingress; it rides
+the `GenRequest` through the batcher (queue/prefill/chunk/harvest spans),
+comes back in the response payload as `trace_id`, and is logged as one
+structured JSON line per completed request when a `StructuredLog` is
+attached.
 
 `ThreadingHTTPServer` gives one thread per in-flight request; they all
 funnel into the `MicroBatcher`, which is where concurrent requests
@@ -32,9 +48,13 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 import numpy as np
 
+from dalle_pytorch_tpu.obs.logging import StructuredLog
+from dalle_pytorch_tpu.obs.profiler import ProfilerBusy, ProfilerCapture
+from dalle_pytorch_tpu.obs.tracing import Tracer
 from dalle_pytorch_tpu.serving.batcher import (
     ContinuousBatcher,
     MicroBatcher,
@@ -98,29 +118,101 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         owner = self.server.owner
-        if self.path == "/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
             healthy, detail = owner.health()
             self._reply(200 if healthy else 503, detail)
-        elif self.path == "/metrics":
-            text = owner.registry.render().encode("utf-8")
-            self.send_response(200)
-            self.send_header(
-                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/metrics":
+            # exemplars are OpenMetrics syntax; classic Prometheus text
+            # parsers reject them, so they're strictly opt-in per scrape
+            # and served with the OpenMetrics content type (+ # EOF)
+            exemplars = parse_qs(query).get("exemplars", ["0"])[0] in (
+                "1", "true",
             )
+            text = owner.registry.render(exemplars=exemplars).encode("utf-8")
+            content_type = (
+                "application/openmetrics-text; version=1.0.0; charset=utf-8"
+                if exemplars
+                else "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_response(200)
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(text)))
             self.end_headers()
             try:
                 self.wfile.write(text)
             except (BrokenPipeError, ConnectionResetError):
                 pass  # scraper gave up mid-scrape; not traceback-worthy
+        elif path == "/debug/traces":
+            # ?n= bounds the payload: a small-chunk continuous config
+            # holds one chunk span per decode chunk, so the full ring
+            # can serialize to megabytes
+            try:
+                n_param = parse_qs(query).get("n", [None])[0]
+                n = None if n_param is None else int(n_param)
+                if n is not None and n <= 0:
+                    raise ValueError(n)
+            except ValueError:
+                self._reply(400, {"error": "n must be a positive integer"})
+                return
+            self._reply(200, owner.tracer.trace_events(n))
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
     # -------------------------------------------------------------- POSTs
 
+    def _profile(self, owner, query: str) -> None:
+        """POST /debug/profile?seconds=N — blocking on-demand capture."""
+        try:
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            # the endpoint takes no body; an oversized or malformed one is
+            # a client error (and _reply's >=400 path closes the
+            # connection, so undrained bytes can't corrupt keep-alive).
+            # Explicit raise, not assert: the bound must survive python -O.
+            if not 0 <= length <= MAX_BODY_BYTES:
+                raise ValueError(f"bad Content-Length {length}")
+        except ValueError as exc:
+            self._reply(400, {"error": f"bad request: {exc}"})
+            return
+        if length:
+            self.rfile.read(length)  # drain before replying 200 keep-alive
+        try:
+            seconds = float(parse_qs(query).get("seconds", ["2"])[0])
+        except (TypeError, ValueError):
+            self._reply(400, {"error": "seconds must be a number"})
+            return
+        # report what was actually captured: capture() clamps oversized
+        # requests to max_seconds, and the response/log must not claim an
+        # hour-long trace when the dir holds 60s
+        if seconds > 0:
+            seconds = min(seconds, owner.profiler.max_seconds)
+        try:
+            trace_dir = owner.profiler.capture(seconds)
+        except ProfilerBusy as exc:
+            self._reply(409, {"error": str(exc)})
+            return
+        except PermissionError as exc:
+            self._reply(403, {"error": str(exc)})
+            return
+        except ValueError as exc:
+            self._reply(400, {"error": f"bad request: {exc}"})
+            return
+        except Exception as exc:
+            self._reply(500, {"error": f"profiler capture failed: {exc}"})
+            return
+        if owner.log is not None:
+            owner.log.event(
+                "profile_capture", trace_dir=str(trace_dir), seconds=seconds
+            )
+        self._reply(200, {"trace_dir": str(trace_dir), "seconds": seconds})
+
     def do_POST(self):
         owner = self.server.owner
-        if self.path != "/generate":
+        path, _, query = self.path.partition("?")
+        if path == "/debug/profile":
+            self._profile(owner, query)
+            return
+        if path != "/generate":
             self._reply(404, {"error": f"unknown path {self.path}"})
             return
         try:
@@ -164,10 +256,28 @@ class _Handler(BaseHTTPRequestHandler):
         if seed is None:
             seed = owner.next_seed(num_images)
         t0 = time.monotonic()
+        # trace ID minted at ingress: every stage of this request's life is
+        # a span on this one tree (queue/prefill/chunk/harvest land in the
+        # batcher worker; respond below). finish() runs on EVERY exit path
+        # so error traces reach the ring buffer and the request log too.
+        trace = owner.tracer.start_trace(
+            "request", rows=num_images, seed=int(seed),
+            prompt_chars=len(prompt),
+        )
+
+        def closed_out(outcome: str, status: int, **fields):
+            trace.finish(outcome=outcome)
+            owner.log_request(
+                trace, outcome=outcome, status=status,
+                latency_ms=(time.monotonic() - t0) * 1000.0,
+                rows=num_images, **fields,
+            )
+
         try:
             try:
                 text_ids = owner.engine.tokenize(prompt)
             except Exception as exc:  # tokenizer failure is a server error
+                closed_out("error", 500, error=repr(exc))
                 self._reply(500, {"error": f"tokenization failed: {exc}"})
                 return
             specs = [
@@ -179,11 +289,15 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 for i in range(num_images)
             ]
-            req = owner.batcher.submit(specs, timeout_s=timeout_s)
+            req = owner.batcher.submit(
+                specs, timeout_s=timeout_s, trace=trace
+            )
         except QueueFullError as exc:
+            closed_out("rejected", 503, error=str(exc))
             self._reply(503, {"error": str(exc)}, [("Retry-After", "1")])
             return
         except ShuttingDownError as exc:
+            closed_out("shutdown", 503)
             self._reply(503, {"error": str(exc)})
             return
 
@@ -191,12 +305,16 @@ class _Handler(BaseHTTPRequestHandler):
             tokens, pixels = req.future.result(timeout=timeout_s + 5.0)
         except RequestTimeout as exc:
             req.cancel()
+            closed_out("timeout", 504)
             self._reply(504, {"error": str(exc)})
             return
         except Exception as exc:
+            closed_out("error", 500, error=repr(exc))
             self._reply(500, {"error": f"generation failed: {exc}"})
             return
 
+        tr0 = time.monotonic()  # stage timing works with tracing off too
+        respond_span = trace.begin("respond")
         try:
             tokens = np.asarray(tokens)
             payload = {
@@ -205,6 +323,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "seed": int(seed),
                 "latency_ms": round((time.monotonic() - t0) * 1000.0, 2),
             }
+            if trace:
+                payload["trace_id"] = trace.trace_id
             if pixels is not None:
                 clip_scores = None
                 if do_rerank:
@@ -218,8 +338,20 @@ class _Handler(BaseHTTPRequestHandler):
                     payload["clip_scores"] = clip_scores
             payload["tokens"] = tokens.tolist()
         except Exception as exc:  # rerank/PNG-encode failure: 500, not EOF
+            trace.end(respond_span, error=repr(exc))
+            # observe the stage on error too, so /metrics and the traces
+            # keep agreeing (same contract as the batcher's harvest path)
+            owner.batcher.stage_seconds.labels("respond").observe(
+                time.monotonic() - tr0, exemplar=trace.trace_id or None
+            )
+            closed_out("error", 500, error=repr(exc))
             self._reply(500, {"error": f"response encoding failed: {exc}"})
             return
+        trace.end(respond_span)
+        owner.batcher.stage_seconds.labels("respond").observe(
+            time.monotonic() - tr0, exemplar=trace.trace_id or None
+        )
+        closed_out("ok", 200)
         self._reply(200, payload)
 
 
@@ -250,11 +382,30 @@ class ServingServer:
         max_queue_rows: int = 64,
         request_timeout_s: float = 120.0,
         verbose: bool = False,
+        tracer: Optional[Tracer] = None,
+        log: Optional[StructuredLog] = None,
+        log_requests: bool = True,
+        profiler: Optional[ProfilerCapture] = None,
+        trace_dump_path: Optional[str] = None,
     ):
         self.engine = engine
         self.registry = engine.registry
         self.request_timeout_s = float(request_timeout_s)
         self.verbose = verbose
+        # tracing defaults ON: the ring buffer is bounded and span
+        # bookkeeping is host-side clock reads — pass
+        # Tracer(enabled=False) to get the pinned zero-allocation path
+        self.tracer = tracer if tracer is not None else Tracer(max_traces=128)
+        self.log = log  # None: no structured logging at all (tests stay quiet)
+        # log_requests=False keeps lifecycle events (warmup, trace_dump,
+        # shutdown) flowing but drops the per-request lines — the
+        # `serve.py --no_request_log` contract
+        self.log_requests = bool(log_requests)
+        self.profiler = (
+            profiler if profiler is not None else ProfilerCapture()
+        )
+        self.trace_dump_path = trace_dump_path
+        self._trace_dumped = False
         if isinstance(engine, ContinuousEngine):
             # token-boundary admission: max_delay_ms does not apply (there
             # is no flush deadline; admission happens at chunk boundaries)
@@ -296,6 +447,23 @@ class ServingServer:
             s = self._seed_counter
             self._seed_counter = (self._seed_counter + n) & 0x7FFFFFFF
             return s
+
+    def log_request(self, trace, outcome: str, status: int,
+                    latency_ms: float, **fields) -> None:
+        """One structured JSON line per completed request (no-op without
+        an attached StructuredLog, or with log_requests=False). The stage
+        breakdown comes from the request's finished trace; empty when
+        tracing is off."""
+        if self.log is None or not self.log_requests:
+            return
+        self.log.request(
+            trace_id=trace.trace_id,
+            outcome=outcome,
+            status=status,
+            latency_ms=latency_ms,
+            stages=trace.stage_seconds(),
+            **fields,
+        )
 
     # how long a failed flush keeps /healthz at 503. Time-decayed rather
     # than cleared-on-success only: a health-gated router pulls traffic on
@@ -356,6 +524,21 @@ class ServingServer:
             self._serving = True
         self._httpd.serve_forever(poll_interval=0.05)
 
+    def _dump_traces(self) -> None:
+        if not self.trace_dump_path or self._trace_dumped:
+            return
+        self._trace_dumped = True
+        try:
+            out = self.tracer.dump(self.trace_dump_path)
+            if self.log is not None:
+                self.log.event(
+                    "trace_dump", path=str(out),
+                    traces=len(self.tracer.recent()),
+                )
+        except OSError as exc:  # a bad path must not block shutdown
+            if self.log is not None:
+                self.log.event("trace_dump_failed", error=repr(exc))
+
     def shutdown(self, drain: bool = True) -> None:
         self._draining = True
         self.batcher.shutdown(drain=drain)
@@ -376,3 +559,11 @@ class ServingServer:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        # dump LAST — after the queue drained, the listener stopped, and
+        # the serve thread joined — so requests that were mid-respond when
+        # shutdown began have had their handler finish() the trace into
+        # the ring. (A handler thread still encoding a huge payload at
+        # this instant is best-effort: the dump won't wait for it.)
+        self._dump_traces()
+        if first_close and self.log is not None:
+            self.log.event("shutdown", drain=drain)

@@ -56,10 +56,19 @@ class Counter:
     def value(self) -> float:
         return self._value
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
+        # OpenMetrics (the exemplars exposition) reserves the _total
+        # suffix: the counter FAMILY drops it and only the sample keeps
+        # it, else the OpenMetrics parser rejects the whole scrape.
+        # Classic text keeps the flat name everywhere.
+        fam = (
+            self.name[: -len("_total")]
+            if exemplars and self.name.endswith("_total")
+            else self.name
+        )
         return [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} counter",
+            f"# HELP {fam} {self.help}",
+            f"# TYPE {fam} counter",
             f"{self.name} {_fmt(self._value)}",
         ]
 
@@ -87,7 +96,7 @@ class Gauge:
     def value(self) -> float:
         return self._value
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         return [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} gauge",
@@ -124,15 +133,22 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._recent: deque = deque(maxlen=reservoir_size)
+        # most recent exemplar-carrying observation: (value, trace_id, unix
+        # time). Exposed via `render(exemplars=True)` in OpenMetrics
+        # exemplar syntax so a scrape can jump from a latency bucket to
+        # the exact trace that landed there.
+        self._exemplar = None
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
         v = float(value)
         with self._lock:
             self._counts[bisect.bisect_left(self.buckets, v)] += 1
             self._sum += v
             self._count += 1
             self._recent.append(v)
+            if exemplar:
+                self._exemplar = (v, str(exemplar), time.time())
 
     @property
     def count(self) -> int:
@@ -156,17 +172,33 @@ class Histogram:
         with self._lock:
             return self._sum / self._count if self._count else 0.0
 
-    def render(self) -> List[str]:
+    def render(self, exemplars: bool = False) -> List[str]:
         with self._lock:
             lines = [
                 f"# HELP {self.name} {self.help}",
                 f"# TYPE {self.name} histogram",
             ]
+            # OpenMetrics exemplar: appended to the ONE bucket line whose
+            # range the exemplar value falls in (cumulative buckets, so
+            # that's the first le >= value)
+            ex_idx, ex_suffix = None, ""
+            if exemplars and self._exemplar is not None:
+                ev, etid, ets = self._exemplar
+                ex_idx = bisect.bisect_left(self.buckets, ev)
+                ex_suffix = (
+                    f' # {{trace_id="{etid}"}} {_fmt(ev)} {round(ets, 3)}'
+                )
             cum = 0
-            for bound, n in zip(self.buckets, self._counts):
+            for i, (bound, n) in enumerate(zip(self.buckets, self._counts)):
                 cum += n
-                lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {cum}')
-            lines.append(f'{self.name}_bucket{{le="+Inf"}} {self._count}')
+                suffix = ex_suffix if i == ex_idx else ""
+                lines.append(
+                    f'{self.name}_bucket{{le="{_fmt(bound)}"}} {cum}{suffix}'
+                )
+            suffix = ex_suffix if ex_idx == len(self.buckets) else ""
+            lines.append(
+                f'{self.name}_bucket{{le="+Inf"}} {self._count}{suffix}'
+            )
             lines.append(f"{self.name}_sum {_fmt(self._sum)}")
             lines.append(f"{self.name}_count {self._count}")
         # convenience percentile gauges from the reservoir (outside the
@@ -208,26 +240,38 @@ class Family:
                 self._children[key] = child
             return child
 
-    def render(self) -> List[str]:
+    def items(self) -> List:
+        """Snapshot of (label value, child instrument) pairs — the public
+        read surface for per-label reporting (bench_serving's per-stage
+        breakdown reads the stage family through this)."""
         with self._lock:
-            children = sorted(self._children.items())
+            return sorted(self._children.items())
+
+    def render(self, exemplars: bool = False) -> List[str]:
+        children = self.items()
         type_name = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}[
             self.cls
         ]
+        fam = (
+            self.name[: -len("_total")]
+            if exemplars and self.cls is Counter
+            and self.name.endswith("_total")
+            else self.name
+        )
         lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} {type_name}",
+            f"# HELP {fam} {self.help}",
+            f"# TYPE {fam} {type_name}",
         ]
         for _, child in children:
-            lines.extend(_render_samples(child))
+            lines.extend(_render_samples(child, exemplars=exemplars))
         return lines
 
 
-def _render_samples(inst) -> List[str]:
+def _render_samples(inst, exemplars: bool = False) -> List[str]:
     """Sample lines of an instrument with its family label spliced in."""
     label = getattr(inst, "_label_suffix", "")
     out = []
-    for line in inst.render():
+    for line in inst.render(exemplars=exemplars):
         if line.startswith("#"):
             continue  # family emits HELP/TYPE once
         name, value = line.split(" ", 1)
@@ -295,12 +339,20 @@ class MetricsRegistry:
     def get(self, name: str):
         return self._instruments.get(name)
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition. `exemplars=True` switches to the
+        OpenMetrics flavor: exemplar annotations (`# {trace_id="..."}`)
+        on histogram buckets that recorded one, plus the mandatory
+        `# EOF` terminator — serve it with the
+        `application/openmetrics-text` content type (the HTTP layer
+        does); classic Prometheus text parsers reject the syntax."""
         with self._lock:
             instruments = sorted(self._instruments.items())
         lines: List[str] = []
         for _, inst in instruments:
-            lines.extend(inst.render())
+            lines.extend(inst.render(exemplars=exemplars))
+        if exemplars:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 

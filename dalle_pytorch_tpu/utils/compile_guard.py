@@ -63,9 +63,19 @@ def _install_listener() -> None:
         _installed = True
 
 
+def install_listener() -> None:
+    """Public hook for consumers that read `compile_count()` outside a
+    guard block — the span tracer (`obs/tracing.py`) installs it so spans
+    can tally the compilations that happened while they were open.
+    Idempotent; imports jax on first call."""
+    _install_listener()
+
+
 def compile_count() -> int:
     """Backend compilations observed so far this process (after the first
-    guard/`track_compiles` use installed the listener)."""
+    guard/`track_compiles`/`install_listener` use installed the
+    listener; 0 forever before that — readers treat it as a delta
+    source, not an absolute truth)."""
     return _compile_count
 
 

@@ -57,6 +57,23 @@ def parse_args(argv=None):
                    help="skip compiling all batch shapes at startup (first "
                    "request per shape then pays compile latency)")
     p.add_argument("--verbose", action="store_true", help="HTTP access logs")
+    p.add_argument("--trace-dump", "--trace_dump", dest="trace_dump",
+                   type=str, default=None, metavar="PATH",
+                   help="write the request-trace ring buffer as Perfetto "
+                   "trace_event JSON to PATH on drain/shutdown (the live "
+                   "view is GET /debug/traces)")
+    p.add_argument("--trace_ring", type=int, default=256,
+                   help="how many recent request traces to keep in memory")
+    p.add_argument("--no_tracing", action="store_true",
+                   help="disable the request span tracer entirely "
+                   "(/debug/traces serves an empty trace; stage metrics "
+                   "on /metrics still work)")
+    p.add_argument("--profile_dir", type=str, default="profiles",
+                   help="where POST /debug/profile?seconds=N writes its "
+                   "TensorBoard trace directories")
+    p.add_argument("--no_request_log", action="store_true",
+                   help="suppress the structured JSON log line per "
+                   "completed request")
     return p.parse_args(argv)
 
 
@@ -68,7 +85,15 @@ def main(argv=None):
     if _os.environ.get("DALLE_TPU_FORCE_PLATFORM"):
         jax.config.update("jax_platforms", _os.environ["DALLE_TPU_FORCE_PLATFORM"])
 
+    from dalle_pytorch_tpu.obs import ProfilerCapture, StructuredLog, Tracer
     from dalle_pytorch_tpu.serving import ServingServer, engine_from_checkpoint
+
+    # structured JSONL on stdout replaces the old ad-hoc status prints;
+    # the one surviving print is the "[serve] listening" readiness line,
+    # which orchestrators pattern-match. --no_request_log drops only the
+    # per-request lines; lifecycle events (warmup, trace_dump, shutdown)
+    # always flow.
+    log = StructuredLog()
 
     batch_shapes = tuple(int(b) for b in args.batch_shapes.split(",") if b)
     engine = engine_from_checkpoint(
@@ -81,10 +106,12 @@ def main(argv=None):
         prefill_batch=args.prefill_batch,
     )
     if not args.no_warmup:
-        print(f"[serve] warming up batch shapes {engine.batch_shapes} ...",
-              flush=True)
+        log.event("warmup_start", batch_shapes=list(engine.batch_shapes))
         engine.warmup()
-        print(f"[serve] warmup done: {engine.stats.compiled_shapes}", flush=True)
+        log.event(
+            "warmup_done",
+            compiled_shapes=list(engine.stats.compiled_shapes),
+        )
 
     server = ServingServer(
         engine,
@@ -94,6 +121,13 @@ def main(argv=None):
         max_queue_rows=args.max_queue,
         request_timeout_s=args.request_timeout_s,
         verbose=args.verbose,
+        tracer=Tracer(
+            enabled=not args.no_tracing, max_traces=args.trace_ring
+        ),
+        log=log,
+        log_requests=not args.no_request_log,
+        profiler=ProfilerCapture(out_dir=args.profile_dir),
+        trace_dump_path=args.trace_dump,
     )
 
     import threading

@@ -117,6 +117,94 @@ class TestRuleCorpus:
         assert codes(lint_paths([at])) == ["TL007"]
 
 
+# --------------------------------------------------------- severity tiers
+
+
+WARNING_ONLY = textwrap.dedent(
+    """\
+    import jax
+
+    class Engine:
+        # tracelint: hotloop
+        def step(self):
+            return jax.device_get(self._state)
+    """
+)
+
+
+class TestSeverityTiers:
+    """TL002 splits 'sync under tracing' (error — always a bug) from
+    'sync in a hotloop-marked loop' (warning tier, its own exit-code
+    bit: 1 errors, 4 warnings, 5 both; 2 stays usage errors)."""
+
+    def test_tl002_fixture_splits_by_severity(self):
+        result = lint_paths([FIXTURES / "tl002_pos.py"])
+        assert len(result.errors) == 4, [f.render() for f in result.errors]
+        assert len(result.warnings) == 3
+        assert all(f.rule == "TL002" for f in result.warnings)
+        assert all("hot loop" in f.message for f in result.warnings)
+        # warnings are findings: the package gate stays strict
+        assert not result.clean
+
+    def test_warning_only_exit_bit(self, tmp_path):
+        from dalle_pytorch_tpu.analysis import main
+
+        f = tmp_path / "hotloop_only.py"
+        f.write_text(WARNING_ONLY)
+        assert main([str(f)]) == 4
+
+    def test_error_and_warning_exit_bits_compose(self):
+        from dalle_pytorch_tpu.analysis import main
+
+        assert main([str(FIXTURES / "tl002_pos.py")]) == 5
+        # error-only fixtures keep the historical exit 1
+        assert main([str(FIXTURES / "tl001_pos.py")]) == 1
+
+    def test_warning_severity_in_json_and_text(self, tmp_path, capsys):
+        from dalle_pytorch_tpu.analysis import main
+
+        f = tmp_path / "hotloop_only.py"
+        f.write_text(WARNING_ONLY)
+        main([str(f), "--format", "json"])
+        payload = json.loads(capsys.readouterr().out)
+        assert [x["severity"] for x in payload["findings"]] == ["warning"]
+        result = lint_paths([f])
+        assert "TL002 warning:" in result.findings[0].render()
+        assert "1 warning-tier" in __import__(
+            "dalle_pytorch_tpu.analysis.lint", fromlist=["_render_text"]
+        )._render_text(result)
+
+    def test_github_format_warning_annotations(self, tmp_path, capsys):
+        from dalle_pytorch_tpu.analysis import main
+
+        f = tmp_path / "hotloop_only.py"
+        f.write_text(WARNING_ONLY)
+        rc = main([str(f), "--format", "github"])
+        assert rc == 4
+        out = capsys.readouterr().out
+        assert "::warning file=" in out and "::error" not in out
+
+    def test_reasoned_suppression_silences_warning_tier(self, tmp_path):
+        f = tmp_path / "justified.py"
+        f.write_text(WARNING_ONLY.replace(
+            "jax.device_get(self._state)",
+            "jax.device_get(self._state)  "
+            "# tracelint: disable=TL002 -- fixture: designed boundary",
+        ))
+        result = lint_paths([f])
+        assert result.clean and len(result.suppressed) == 1
+
+    def test_severity_not_in_fingerprint(self, tmp_path):
+        """Retiering a rule must never invalidate existing baselines."""
+        f = tmp_path / "hotloop_only.py"
+        f.write_text(WARNING_ONLY)
+        (finding,) = lint_paths([f]).findings
+        import dataclasses
+
+        retiered = dataclasses.replace(finding, severity="error")
+        assert retiered.fingerprint() == finding.fingerprint()
+
+
 # ------------------------------------------------------------ suppressions
 
 

@@ -1,0 +1,717 @@
+"""Observability subsystem: span tracer, Perfetto export, structured logs,
+stage metrics + exemplars, debug endpoints, trace dump on drain.
+
+The acceptance path (TestContinuousServingTraces) pins the tentpole
+contract: a request served end-to-end through the `ContinuousBatcher`
+yields ONE complete trace whose stages are exactly
+queue → prefill → chunk* → harvest → respond, exported as valid Perfetto
+`trace_event` JSON from /debug/traces, with the same stage durations
+reflected in `dalle_serving_stage_seconds{stage=}` on /metrics — and the
+whole instrumented path compiles nothing after warmup.
+
+The zero-overhead contract is guarded by a counter, not timing: a
+disabled tracer creates ZERO Span objects however much traffic flows
+past it (`Tracer.spans_created`).
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dalle_pytorch_tpu.obs import (
+    NULL_TRACE,
+    ProfilerBusy,
+    ProfilerCapture,
+    StructuredLog,
+    Tracer,
+)
+from dalle_pytorch_tpu.serving.batcher import ContinuousBatcher, MicroBatcher
+from dalle_pytorch_tpu.serving.server import ServingServer
+from dalle_pytorch_tpu.training.metrics import Histogram, MetricsRegistry
+
+from test_continuous import FakeContinuousEngine, IMG_SEQ, _build, spec
+from test_serving_e2e import FakeServingEngine, _get, _post
+
+
+#: the pinned stage vocabulary of a continuous-engine request trace
+CONTINUOUS_STAGES = ("queue", "prefill", "chunk", "harvest", "respond")
+
+
+# ----------------------------------------------------------------- tracer
+
+
+class TestTracer:
+    def test_span_tree_and_stage_seconds(self):
+        tr = Tracer()
+        t = tr.start_trace("request", rows=2)
+        with t.span("queue"):
+            time.sleep(0.01)
+        for i in range(3):
+            with t.span("chunk", chunk_index=i):
+                time.sleep(0.002)
+        t.finish("ok")
+        assert t.complete()
+        stages = t.stage_seconds()
+        assert set(stages) == {"queue", "chunk"}
+        assert stages["queue"] >= 0.01
+        assert stages["chunk"] >= 0.006  # three chunk spans SUM
+        # spans are parented on the root request span
+        root = t.root
+        assert root.name == "request" and root.args["outcome"] == "ok"
+        assert all(
+            s.parent_id == root.span_id for s in t.spans if s is not root
+        )
+
+    def test_cross_thread_begin_end(self):
+        """The queue span begins on the submitting thread and ends on the
+        worker — the explicit begin/end API the batcher relies on."""
+        tr = Tracer()
+        t = tr.start_trace()
+        s = t.begin("queue")
+        worker = threading.Thread(target=lambda: t.end(s, outcome="admitted"))
+        worker.start()
+        worker.join()
+        assert s.closed and s.args["outcome"] == "admitted"
+
+    def test_finish_closes_abandoned_spans(self):
+        """Error paths abandon stage spans mid-flight; finish() must still
+        produce a complete (exportable) trace."""
+        tr = Tracer()
+        t = tr.start_trace()
+        t.begin("chunk")
+        t.finish("error")
+        assert t.complete()
+        (chunk,) = [s for s in t.spans if s.name == "chunk"]
+        assert chunk.args.get("abandoned") is True
+
+    def test_ring_buffer_bounded(self):
+        tr = Tracer(max_traces=4)
+        for i in range(10):
+            tr.start_trace("request", i=i).finish()
+        recent = tr.recent()
+        assert len(recent) == 4
+        assert [t.root.args["i"] for t in recent] == [6, 7, 8, 9]
+
+    def test_trace_ids_unique(self):
+        tr = Tracer()
+        ids = {tr.start_trace().trace_id for _ in range(64)}
+        assert len(ids) == 64
+
+    def test_disabled_tracer_is_null_and_allocation_free(self):
+        tr = Tracer(enabled=False)
+        t = tr.start_trace("request", rows=1)
+        assert t is NULL_TRACE and not t
+        with t.span("chunk", chunk_index=0):
+            pass
+        s = t.begin("queue")
+        t.end(s)
+        t.finish("ok")
+        assert t.stage_seconds() == {}
+        assert tr.spans_created == 0
+        assert tr.trace_events() == {
+            "traceEvents": [], "displayTimeUnit": "ms"
+        }
+
+
+class TestPerfettoExport:
+    def test_export_round_trips_and_has_complete_events(self, tmp_path):
+        tr = Tracer()
+        t = tr.start_trace("request")
+        with t.span("queue"):
+            pass
+        t.finish()
+        payload = json.loads(json.dumps(tr.trace_events()))
+        events = payload["traceEvents"]
+        names = {e["name"] for e in events}
+        assert {"thread_name", "request", "queue"} <= names
+        for e in events:
+            if e["ph"] == "X":
+                assert e["ts"] >= 0 and e["dur"] >= 0
+                assert e["args"]["trace_id"] == t.trace_id
+                assert e["cat"] == "serving"
+        # dump() writes the same payload as a loadable file
+        out = tr.dump(tmp_path / "traces.json")
+        assert json.loads(out.read_text())["traceEvents"]
+
+    def test_concurrent_traces_get_distinct_tracks(self):
+        tr = Tracer()
+        t1, t2 = tr.start_trace(), tr.start_trace()
+        t1.finish()
+        t2.finish()
+        events = tr.trace_events()["traceEvents"]
+        tids = {
+            e["args"]["trace_id"]: e["tid"] for e in events if e["ph"] == "X"
+        }
+        assert tids[t1.trace_id] != tids[t2.trace_id]
+
+
+# --------------------------------------------------------- structured log
+
+
+class TestStructuredLog:
+    def test_request_line_schema(self):
+        buf = io.StringIO()
+        log = StructuredLog(stream=buf)
+        log.request(
+            trace_id="abc123", outcome="ok", status=200, latency_ms=41.07,
+            stages={"queue": 0.0101, "chunk": 0.0302}, rows=2,
+        )
+        rec = json.loads(buf.getvalue())
+        assert rec["event"] == "request"
+        assert rec["trace_id"] == "abc123"
+        assert rec["outcome"] == "ok" and rec["status"] == 200
+        assert rec["latency_ms"] == 41.07
+        assert rec["stages"] == {"queue": 10.1, "chunk": 30.2}  # ms
+        assert rec["rows"] == 2 and rec["ts"] > 0
+
+    def test_event_line_and_write_failure_is_silent(self):
+        buf = io.StringIO()
+        log = StructuredLog(stream=buf)
+        log.event("warmup_done", compiled_shapes=[1, 4])
+        assert json.loads(buf.getvalue())["event"] == "warmup_done"
+        buf.close()
+        log.event("after_close")  # must not raise into the serving path
+
+
+# ------------------------------------------------- stage metrics/exemplars
+
+
+class TestExemplars:
+    def test_histogram_exemplar_behind_flag(self):
+        h = Histogram("lat", buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="tr1")
+        h.observe(0.5)  # no exemplar: the last exemplar-carrying obs wins
+        plain = "\n".join(h.render())
+        assert "trace_id" not in plain
+        annotated = "\n".join(h.render(exemplars=True))
+        assert 'lat_bucket{le="0.1"} 1 # {trace_id="tr1"} 0.05' in annotated
+        # exactly one bucket line carries the exemplar
+        assert annotated.count("trace_id") == 1
+
+    def test_exemplar_lands_in_inf_bucket(self):
+        h = Histogram("lat", buckets=(0.1,))
+        h.observe(5.0, exemplar="big")
+        out = "\n".join(h.render(exemplars=True))
+        assert 'le="+Inf"} 1 # {trace_id="big"}' in out
+
+    def test_counter_total_suffix_stripped_in_openmetrics(self):
+        """OpenMetrics reserves `_total`: the counter FAMILY is named
+        without it (samples keep it), else the OpenMetrics parser the
+        exemplar exposition exists for rejects the whole scrape."""
+        reg = MetricsRegistry()
+        reg.counter("dalle_serving_requests_total", "reqs").inc()
+        plain = reg.render()
+        assert "# TYPE dalle_serving_requests_total counter" in plain
+        om = reg.render(exemplars=True)
+        assert "# TYPE dalle_serving_requests counter" in om
+        assert "# TYPE dalle_serving_requests_total counter" not in om
+        assert "dalle_serving_requests_total 1" in om  # sample keeps suffix
+
+    def test_family_exemplars_with_labels(self):
+        reg = MetricsRegistry()
+        fam = reg.histogram_family(
+            "stage_seconds", "per stage", label_name="stage",
+            buckets=(0.1, 1.0),
+        )
+        fam.labels("chunk").observe(0.05, exemplar="tr9")
+        out = reg.render(exemplars=True)
+        assert (
+            'stage_seconds_bucket{stage="chunk",le="0.1"} 1 '
+            '# {trace_id="tr9"} 0.05'
+        ) in out
+        assert "trace_id" not in reg.render()
+
+
+# --------------------------------------- batcher propagation (fake engine)
+
+
+class TestBatcherTracing:
+    def test_continuous_stages_recorded_through_fake_engine(self):
+        tr = Tracer()
+        eng = FakeContinuousEngine()
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        traces = [tr.start_trace("request") for _ in range(3)]
+        reqs = [
+            b.submit([spec(i)], trace=traces[i]) for i in range(3)
+        ]
+        for r in reqs:
+            r.future.result(timeout=10)
+        b.shutdown()
+        for t in traces:
+            t.finish("ok")
+            names = [s.name for s in t.spans if s is not t.root]
+            assert names[0] == "queue" and names[1] == "prefill"
+            assert names[-1] == "harvest"
+            assert all(n == "chunk" for n in names[2:-1]) and "chunk" in names
+            assert t.complete()
+        # stage family observed for every batcher-side stage
+        fam = eng.registry.get("dalle_serving_stage_seconds")
+        stages = dict(fam.items())
+        assert {"queue", "prefill", "chunk", "harvest"} <= set(stages)
+
+    def test_micro_stages_recorded(self):
+        from test_serving import FakeEngine
+
+        tr = Tracer()
+        eng = FakeEngine(max_batch=4)
+        reg = MetricsRegistry()
+        b = MicroBatcher(eng, max_delay_ms=5, registry=reg)
+        t = tr.start_trace("request")
+        req = b.submit([spec(3)], trace=t)
+        req.future.result(timeout=10)
+        b.shutdown()
+        t.finish("ok")
+        assert [s.name for s in t.spans if s is not t.root] == [
+            "queue", "generate",
+        ]
+        fam = reg.get("dalle_serving_stage_seconds")
+        assert {"queue", "generate"} <= set(dict(fam.items()))
+
+    def test_disabled_tracer_zero_allocations_in_chunk_loop(self):
+        """The tier-1 zero-overhead gate: a disabled tracer adds no
+        per-token/per-chunk allocations — guarded by the spans_created
+        counter, not timing."""
+        tr = Tracer(enabled=False)
+        eng = FakeContinuousEngine(chunk=2)  # several chunks per request
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        reqs = [
+            b.submit([spec(i)], trace=tr.start_trace("request"))
+            for i in range(6)
+        ]
+        for r in reqs:
+            r.future.result(timeout=10)
+        b.shutdown()
+        assert tr.spans_created == 0
+        assert len(tr.recent()) == 0
+
+    def test_timed_out_request_trace_still_completes(self):
+        gate = threading.Event()
+        eng = FakeContinuousEngine(block_event=gate)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        tr = Tracer()
+        first = b.submit([spec(0)], trace=tr.start_trace())
+        assert eng.chunk_entered.wait(10.0)
+        doomed_trace = tr.start_trace()
+        doomed = b.submit([spec(1)], timeout_s=0.05, trace=doomed_trace)
+        time.sleep(0.2)
+        gate.set()
+        first.future.result(timeout=10)
+        with pytest.raises(Exception):
+            doomed.future.result(timeout=10)
+        doomed_trace.finish("timeout")
+        assert doomed_trace.complete()
+        (queue,) = [s for s in doomed_trace.spans if s.name == "queue"]
+        assert queue.args.get("outcome") == "timeout"
+        b.shutdown()
+
+
+# -------------------------------------------- acceptance: HTTP end-to-end
+
+
+@pytest.fixture(scope="module")
+def traced_server():
+    from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+
+    _, cont = _build(max_batch=4, chunk_tokens=4, prefill_batch=2)
+    cont.tokenizer = ByteTokenizer()
+    cont.warmup()
+    log_buf = io.StringIO()
+    server = ServingServer(
+        cont, port=0, request_timeout_s=60,
+        tracer=Tracer(max_traces=64),
+        log=StructuredLog(stream=log_buf),
+    ).start()
+    try:
+        yield server, log_buf
+    finally:
+        server.shutdown()
+
+
+def _events_by_trace(payload):
+    by_trace = {}
+    for e in payload["traceEvents"]:
+        if e["ph"] != "X":
+            continue
+        by_trace.setdefault(e["args"]["trace_id"], []).append(e)
+    return by_trace
+
+
+class TestContinuousServingTraces:
+    def test_stage_order_and_metrics_agree_single_request(self, traced_server):
+        """One request end-to-end: span stages pinned, Perfetto export
+        valid, and stage durations consistent between the trace and the
+        `dalle_serving_stage_seconds{stage=}` family."""
+        server, _ = traced_server
+        fam = server.registry.get("dalle_serving_stage_seconds")
+        before = {
+            label: (child.sum, child.count) for label, child in fam.items()
+        }
+        status, payload = _post(
+            server.port, {"prompt": "red circle", "seed": 5}
+        )
+        assert status == 200 and payload["trace_id"]
+        trace = next(
+            t for t in server.tracer.recent()
+            if t.trace_id == payload["trace_id"]
+        )
+        assert trace.complete() and trace.outcome == "ok"
+        names = [s.name for s in trace.spans if s is not trace.root]
+        assert names[0] == "queue"
+        assert names[1] == "prefill"
+        assert names[-1] == "respond"
+        assert names[-2] == "harvest"
+        chunks = names[2:-2]
+        assert chunks and all(n == "chunk" for n in chunks)
+        assert len(chunks) == -(-IMG_SEQ // 4)  # ceil(image_seq/chunk_tokens)
+        # chunk spans carry engine dispatch metadata
+        chunk_spans = [s for s in trace.spans if s.name == "chunk"]
+        assert all("chunk_index" in s.args for s in chunk_spans)
+        (pf,) = [s for s in trace.spans if s.name == "prefill"]
+        assert pf.args["wave_rows"] == 1 and pf.args["dispatches"] == 1
+
+        # the same durations land in the stage family (deltas over this
+        # request; generous tolerance — the two are measured at slightly
+        # different code points)
+        stages = trace.stage_seconds()
+        for name in CONTINUOUS_STAGES:
+            child = fam.labels(name)
+            s0, c0 = before.get(name, (0.0, 0))
+            assert child.count > c0, f"stage {name} never observed"
+            np.testing.assert_allclose(
+                child.sum - s0, stages[name], rtol=0.5, atol=0.25,
+                err_msg=f"stage {name}: /metrics and trace disagree",
+            )
+
+    def test_parallel_requests_yield_complete_disjoint_traces(
+        self, traced_server
+    ):
+        """N concurrent HTTP requests → N complete, non-interleaved span
+        trees, all exported as valid Perfetto JSON from /debug/traces."""
+        server, _ = traced_server
+        n = 4
+        results = {}
+
+        def client(i):
+            results[i] = _post(
+                server.port,
+                {"prompt": f"prompt number {i}", "seed": 100 + i},
+            )
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(n)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        ids = []
+        for i in range(n):
+            status, payload = results[i]
+            assert status == 200
+            ids.append(payload["trace_id"])
+        assert len(set(ids)) == n
+
+        status, body = _get(server.port, "/debug/traces")
+        assert status == 200
+        by_trace = _events_by_trace(json.loads(body))
+        recent = {t.trace_id: t for t in server.tracer.recent()}
+        for tid in ids:
+            trace = recent[tid]
+            assert trace.complete(), f"trace {tid} has unclosed spans"
+            events = sorted(by_trace[tid], key=lambda e: e["ts"])
+            stage_events = [
+                e["name"] for e in events if e["name"] != "request"
+            ]
+            # stage order by start time: queue → prefill → chunk* →
+            # harvest* → respond (a multi-wave boundary may harvest twice)
+            assert stage_events[0] == "queue"
+            assert stage_events[1] == "prefill"
+            assert stage_events[-1] == "respond"
+            core = stage_events[2:-1]
+            assert set(core) == {"chunk", "harvest"}
+            assert "chunk" in core and core[-1] == "harvest"
+            first_harvest = core.index("harvest")
+            assert all(s == "chunk" for s in core[:first_harvest])
+            # non-interleaved: every event of this tid belongs to this
+            # request's span set, 1:1
+            assert len(events) == len(trace.spans)
+
+    def test_request_log_line_emitted(self, traced_server):
+        server, log_buf = traced_server
+        status, payload = _post(
+            server.port, {"prompt": "logged", "seed": 9}
+        )
+        assert status == 200
+        lines = [
+            json.loads(line) for line in log_buf.getvalue().splitlines()
+        ]
+        mine = [
+            r for r in lines
+            if r["event"] == "request"
+            and r["trace_id"] == payload["trace_id"]
+        ]
+        assert len(mine) == 1
+        rec = mine[0]
+        assert rec["outcome"] == "ok" and rec["status"] == 200
+        assert rec["latency_ms"] > 0 and rec["rows"] == 1
+        assert set(CONTINUOUS_STAGES) <= set(rec["stages"])
+
+    def test_metrics_exemplars_carry_trace_id(self, traced_server):
+        server, _ = traced_server
+        status, payload = _post(
+            server.port, {"prompt": "exemplar", "seed": 13}
+        )
+        assert status == 200
+        _, plain = _get(server.port, "/metrics")
+        assert "trace_id" not in plain and "# EOF" not in plain
+        _, annotated = _get(server.port, "/metrics?exemplars=1")
+        assert 'dalle_serving_stage_seconds_bucket{stage="' in annotated
+        assert '# {trace_id="' in annotated
+        # OpenMetrics flavor ends with the mandatory EOF terminator
+        assert annotated.rstrip().endswith("# EOF")
+
+    def test_traced_serving_compiles_nothing_after_warmup(self, traced_server):
+        """The instrumentation itself must not break the fixed-shape
+        discipline: a fully traced request on a warm server is
+        zero-compile (compile_guard-pinned)."""
+        from dalle_pytorch_tpu.utils.compile_guard import assert_no_recompiles
+
+        server, _ = traced_server
+        _post(server.port, {"prompt": "warm path", "seed": 21})
+        with assert_no_recompiles():
+            status, payload = _post(
+                server.port, {"prompt": "steady state", "seed": 22}
+            )
+        assert status == 200 and payload["trace_id"]
+
+
+# ------------------------------------------------------- debug endpoints
+
+
+class TestDebugEndpoints:
+    def test_trace_dump_written_on_drain(self, tmp_path):
+        """`serve.py --trace-dump PATH` surface: the ring buffer lands on
+        disk as loadable Perfetto JSON when the server drains."""
+        dump = tmp_path / "traces" / "dump.json"
+        server = ServingServer(
+            FakeServingEngine(), port=0, max_delay_ms=5,
+            trace_dump_path=str(dump),
+        ).start()
+        _post(server.port, {"prompt": "dump me"})
+        server.shutdown()  # drain, then dump
+        payload = json.loads(dump.read_text())
+        names = {
+            e["name"] for e in payload["traceEvents"] if e["ph"] == "X"
+        }
+        assert {"request", "queue", "generate", "respond"} <= names
+        server.shutdown()  # second shutdown must not re-dump or raise
+
+    def test_profile_endpoint_wiring(self, tmp_path):
+        """HTTP contract of /debug/profile against a stubbed capture
+        backend (the guard-rail logic — single-flight, root gate, bounds
+        — is the REAL ProfilerCapture; only the jax.profiler calls are
+        stubbed: a first real capture pays O(10 s) of one-time profiler
+        init in a compile-heavy process, which belongs in the slow
+        tier — see test_profile_capture_real)."""
+
+        class StubProfiler(ProfilerCapture):
+            process_index = 0
+
+            def _process_index(self):
+                return self.process_index
+
+            def _start(self, trace_dir):
+                (trace_dir / "stub.trace").write_text("x")
+
+            def _stop(self):
+                pass
+
+        profiler = StubProfiler(out_dir=str(tmp_path / "prof"))
+        server = ServingServer(
+            FakeServingEngine(), port=0, max_delay_ms=5, profiler=profiler,
+        ).start()
+
+        def post_profile(q, timeout=10):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/debug/profile?{q}",
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+
+        try:
+            status, payload = post_profile("seconds=0.05")
+            assert status == 200 and payload["seconds"] == 0.05
+            import pathlib
+
+            trace_dir = pathlib.Path(payload["trace_dir"])
+            assert trace_dir.is_dir()
+            assert (trace_dir / "stub.trace").exists()
+
+            # single-flight: a capture in progress rejects the next one
+            assert profiler._lock.acquire(blocking=False)
+            try:
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    post_profile("seconds=1")
+                assert e.value.code == 409
+            finally:
+                profiler._lock.release()
+
+            # malformed seconds is a client error
+            for q in ("seconds=abc", "seconds=-1"):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    post_profile(q)
+                assert e.value.code == 400
+
+            # an oversized body is rejected (and the connection closed)
+            # rather than left undrained on keep-alive. The server closes
+            # without draining, so the client either reads the 400 or —
+            # when the body outruns the kernel socket buffer — hits a
+            # broken pipe mid-send; both prove the rejection.
+            with pytest.raises(urllib.error.URLError) as e:
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        f"http://127.0.0.1:{server.port}"
+                        "/debug/profile?seconds=1",
+                        data=b"x" * ((1 << 20) + 1),
+                        method="POST",
+                    ),
+                    timeout=10,
+                )
+            if isinstance(e.value, urllib.error.HTTPError):
+                assert e.value.code == 400
+
+            # root-gated: a non-root process gets 403, not a trace dir
+            profiler.process_index = 1
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post_profile("seconds=1")
+            assert e.value.code == 403
+        finally:
+            server.shutdown()
+
+    @pytest.mark.slow
+    def test_profile_capture_real(self, tmp_path):
+        """One real jax.profiler capture through the endpoint (slow: the
+        first capture in a process pays profiler initialization)."""
+        server = ServingServer(
+            FakeServingEngine(), port=0, max_delay_ms=5,
+            profiler=ProfilerCapture(out_dir=str(tmp_path / "prof")),
+        ).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/debug/profile?seconds=0.2",
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                payload = json.loads(resp.read())
+            assert resp.status == 200
+            import pathlib
+
+            trace_dir = pathlib.Path(payload["trace_dir"])
+            assert trace_dir.is_dir()
+            assert list(trace_dir.rglob("*")), "profiler wrote nothing"
+        finally:
+            server.shutdown()
+
+    def test_profiler_single_flight_direct(self):
+        p = ProfilerCapture(out_dir="unused")
+        assert p._lock.acquire(blocking=False)
+        try:
+            with pytest.raises(ProfilerBusy):
+                p.capture(0.1)
+        finally:
+            p._lock.release()
+
+    def test_debug_traces_empty_without_traffic(self):
+        server = ServingServer(
+            FakeServingEngine(), port=0, max_delay_ms=5,
+            tracer=Tracer(enabled=False),
+        ).start()
+        try:
+            status, body = _get(server.port, "/debug/traces")
+            assert status == 200
+            assert json.loads(body)["traceEvents"] == []
+        finally:
+            server.shutdown()
+
+    def test_debug_traces_n_param_and_metrics_query_parsing(self):
+        server = ServingServer(
+            FakeServingEngine(), port=0, max_delay_ms=5,
+        ).start()
+        try:
+            for prompt in ("first", "second"):
+                status, _ = _post(server.port, {"prompt": prompt})
+                assert status == 200
+            # ?n= bounds the export to the most recent n traces
+            status, body = _get(server.port, "/debug/traces?n=1")
+            assert status == 200
+            tids = {
+                e["tid"] for e in json.loads(body)["traceEvents"]
+            }
+            assert len(tids) == 1
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.port, "/debug/traces?n=0")
+            assert e.value.code == 400
+            # exemplar opt-in parses the query: neither an unrelated
+            # param nor a non-flag value flips /metrics to OpenMetrics
+            for q in ("?disable_exemplars=1", "?exemplars=10"):
+                status, body = _get(server.port, f"/metrics{q}")
+                assert status == 200 and "trace_id" not in body
+        finally:
+            server.shutdown()
+
+
+# --------------------------------------- error paths keep /metrics honest
+
+
+class TestErrorPathStageObservations:
+    """Every stage observes into stage_seconds on its error path too, so
+    /metrics and the traces agree whatever the outcome."""
+
+    def test_micro_generate_error_observes_stage(self):
+        from test_serving import FakeEngine, spec as micro_spec
+
+        eng = FakeEngine(fail=True)
+        b = MicroBatcher(eng, registry=MetricsRegistry())
+        trace = Tracer().start_trace()
+        req = b.submit([micro_spec(0)], trace=trace)
+        with pytest.raises(RuntimeError):
+            req.future.result(timeout=10)
+        b.shutdown()
+        trace.finish("error")
+        assert dict(b.stage_seconds.items())["generate"].count == 1
+        assert "generate" in trace.stage_seconds()
+
+    def test_chunk_error_observes_stage(self):
+        eng = FakeContinuousEngine(fail_chunks=True)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        trace = Tracer().start_trace()
+        req = b.submit([spec(0)], trace=trace)
+        with pytest.raises(RuntimeError):
+            req.future.result(timeout=10)
+        b.shutdown()
+        trace.finish("error")
+        assert dict(b.stage_seconds.items())["chunk"].count == 1
+
+    def test_queued_timeout_observes_queue_stage(self):
+        gate = threading.Event()
+        eng = FakeContinuousEngine(block_event=gate)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        tr = Tracer()
+        first = b.submit([spec(0)], trace=tr.start_trace())
+        assert eng.chunk_entered.wait(10.0)
+        doomed = b.submit([spec(1)], timeout_s=0.05, trace=tr.start_trace())
+        time.sleep(0.2)
+        gate.set()
+        first.future.result(timeout=10)
+        with pytest.raises(Exception):
+            doomed.future.result(timeout=10)
+        b.shutdown()
+        # both the admitted AND the expired-in-queue request observed
+        assert dict(b.stage_seconds.items())["queue"].count == 2

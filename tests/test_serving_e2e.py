@@ -523,11 +523,13 @@ class TestServeCliEndToEnd:
 
         env = dict(os.environ)
         env["DALLE_TPU_FORCE_PLATFORM"] = "cpu"
+        trace_dump = tmp_path / "traces.json"
         proc = subprocess.Popen(
             [
                 sys.executable, str(REPO / "serve.py"),
                 "--dalle_path", str(ckpt), "--port", "0",
                 "--batch_shapes", "1,2", "--max_delay_ms", "500",
+                "--trace-dump", str(trace_dump),
             ],
             cwd=tmp_path, env=env, text=True,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -576,8 +578,18 @@ class TestServeCliEndToEnd:
             status, body = _get(port, "/healthz")
             assert json.loads(body)["status"] == "ok"
 
+            status, body = _get(port, "/debug/traces")
+            assert status == 200
+            live = json.loads(body)
+            assert any(
+                e.get("name") == "generate" for e in live["traceEvents"]
+            )
+
             proc.send_signal(signal.SIGINT)
             assert proc.wait(timeout=60) == 0
+            # --trace-dump wrote a Perfetto-loadable file on drain
+            dumped = json.loads(trace_dump.read_text())
+            assert len(dumped["traceEvents"]) >= len(live["traceEvents"])
         finally:
             if proc.poll() is None:
                 proc.kill()
