@@ -29,6 +29,15 @@ amortization is visible in the output. Both open-loop lines carry a
 `dalle_serving_stage_seconds` family over the measured window only), so
 a TTFT regression is attributable to queue vs prefill vs chunk without
 re-running under a tracer.
+
+Paged KV cache (`--kv_layout paged`, SERVE_PAGE_SIZE / SERVE_KV_PAGES):
+the continuous engine becomes `PagedContinuousEngine` and its line gains
+`block_occupancy` (measured-window peak pages vs the slotted layout's
+always-resident worst case) and `prefix_cache` / `prefix_hit_rate` with
+hit-vs-cold TTFT splits. `--prompt_reuse P` (SERVE_PROMPT_REUSE) makes P
+of the arrivals repeat a prompt from a Zipf-ish popularity pool — the
+workload on which prefix caching turns repeat admissions into
+near-zero-cost TTFT; both engines replay the identical prompt schedule.
 """
 
 from __future__ import annotations
@@ -193,34 +202,42 @@ def _stage_breakdown(registry, before):
     return out
 
 
-def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0):
+def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0,
+                  texts=None):
     """Replay a pre-drawn Poisson arrival schedule against one batcher.
 
     `arrivals` are offsets (seconds) from the run start; both engines see
     the identical schedule and per-request seeds, so "at the same Poisson
-    arrival rate" is literal. Returns sustained req/s (completions over the
-    span from first submit to last completion) and TTFT percentiles from
+    arrival rate" is literal. `texts` optionally carries one prompt per
+    arrival (the `--prompt_reuse` schedule); default is `text_ids` for
+    every request. Returns sustained req/s (completions over the span from
+    first submit to last completion) and TTFT percentiles from
     `GenRequest.first_token_at` (micro-batch: batch completion — its first
     token only exists once the full scan finishes; continuous: the first
-    chunk boundary after admission).
+    chunk boundary after admission). When the engine reports prefix-cache
+    admissions (`GenRequest.prefix_hit`, paged engine only), the stats
+    split TTFT by hit vs cold so the cache's win is measured on ONE run,
+    not across runs.
     """
     from dalle_pytorch_tpu.serving.engine import SampleSpec
 
     submitted, rejected = [], 0
     t_start = time.monotonic()
-    for offset, seed in zip(arrivals, seeds):
+    for i, (offset, seed) in enumerate(zip(arrivals, seeds)):
         delay = t_start + offset - time.monotonic()
         if delay > 0:
             time.sleep(delay)
+        ids = text_ids if texts is None else texts[i]
         try:
             req = batcher.submit(
-                [SampleSpec(text_ids, seed=int(seed))], timeout_s=timeout_s
+                [SampleSpec(ids, seed=int(seed))], timeout_s=timeout_s
             )
             submitted.append((time.monotonic(), req))
         except Exception:  # queue-full backpressure counts against the engine
             rejected += 1
 
     ttfts, errors = [], 0
+    hit_ttfts, cold_ttfts, hit_known = [], [], 0
     last_done = time.monotonic()
     for t_submit, req in submitted:
         try:
@@ -230,13 +247,17 @@ def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0):
             continue
         last_done = max(last_done, time.monotonic())
         if req.first_token_at is not None:
-            ttfts.append(req.first_token_at - t_submit)
+            ttft = req.first_token_at - t_submit
+            ttfts.append(ttft)
+            if req.prefix_hit is not None:
+                hit_known += 1
+                (hit_ttfts if req.prefix_hit else cold_ttfts).append(ttft)
     # sustained rate over submit-to-last-completion: the queue backlog an
     # engine builds up during the arrival window is paid for, not free
     wall = last_done - t_start
     completed = len(submitted) - errors
     span = max(wall, 1e-9)
-    return {
+    out = {
         "offered": len(arrivals),
         "submitted": len(submitted),
         "rejected": rejected,
@@ -248,15 +269,63 @@ def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0):
         "ttft_p95_ms": round(1000 * _percentile(ttfts, 0.95), 1) if ttfts else None,
         "ttft_mean_ms": round(1000 * sum(ttfts) / len(ttfts), 1) if ttfts else None,
     }
+    if hit_known:
+        out["prefix_hit_rate"] = round(len(hit_ttfts) / hit_known, 3)
+        if hit_ttfts:
+            out["ttft_prefix_hit_p50_ms"] = round(
+                1000 * _percentile(hit_ttfts, 0.5), 1
+            )
+            out["ttft_prefix_hit_mean_ms"] = round(
+                1000 * sum(hit_ttfts) / len(hit_ttfts), 1
+            )
+        if cold_ttfts:
+            out["ttft_cold_p50_ms"] = round(
+                1000 * _percentile(cold_ttfts, 0.5), 1
+            )
+            out["ttft_cold_mean_ms"] = round(
+                1000 * sum(cold_ttfts) / len(cold_ttfts), 1
+            )
+    return out
 
 
-def _sustained_rps(batcher, text_ids, seconds=2.5, clients=16):
+def draw_prompt_schedule(rng, n, text_seq, num_text_tokens, prompt_reuse,
+                         pool_size=8):
+    """One prompt per arrival: with probability `prompt_reuse`, a draw from
+    a small popularity pool (Zipf-ish 1/rank weights — a few prompts take
+    most of the repeat traffic, like prompt templates / n-samples fan-out
+    in production mixes); otherwise a fresh unique prompt. 0 makes every
+    prompt unique — deliberately cache-cold (the pre-paging bench repeated
+    ONE prompt for every arrival, which would be a 100% prefix-hit
+    workload)."""
+    import numpy as np
+
+    weights = 1.0 / np.arange(1, pool_size + 1)
+    weights /= weights.sum()
+    popular = [
+        rng.integers(1, num_text_tokens, size=text_seq).astype(np.int32)
+        for _ in range(pool_size)
+    ]
+    return [
+        popular[rng.choice(pool_size, p=weights)]
+        if prompt_reuse > 0 and rng.random() < prompt_reuse
+        else rng.integers(1, num_text_tokens, size=text_seq).astype(np.int32)
+        for _ in range(n)
+    ]
+
+
+def _sustained_rps(batcher, text_ids, seconds=2.5, clients=16,
+                   make_text=None):
     """Closed-loop flood: measured saturation throughput of one batcher.
 
     More robust than timing a single scan — on a shared/noisy host a
     one-shot measurement can be off by 3x, and an open-loop rate derived
     from it lands past saturation, where the bench measures queue buildup
     instead of admission policy.
+
+    `make_text(cid, i)` supplies a DISTINCT prompt per submission so a
+    prefix-caching engine calibrates on the COLD admission path — one
+    repeated prompt would measure the ~100% hit path and inflate the cap
+    the open-loop rate derives from; None floods `text_ids`.
     """
     import threading as _th
 
@@ -269,9 +338,10 @@ def _sustained_rps(batcher, text_ids, seconds=2.5, clients=16):
     def client(cid):
         i = 0
         while time.monotonic() < stop:
+            ids = text_ids if make_text is None else make_text(cid, i)
             try:
                 req = batcher.submit(
-                    [SampleSpec(text_ids, seed=1_000_000 + cid * 10_000 + i)],
+                    [SampleSpec(ids, seed=1_000_000 + cid * 10_000 + i)],
                     timeout_s=60.0,
                 )
                 req.future.result(timeout=60.0)
@@ -292,13 +362,13 @@ def _sustained_rps(batcher, text_ids, seconds=2.5, clients=16):
     return len(done) / max(time.monotonic() - t0, 1e-9)
 
 
-def main_open_loop():
+def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
     import jax
     import numpy as np
 
     from dalle_pytorch_tpu.serving.batcher import ContinuousBatcher, MicroBatcher
     from dalle_pytorch_tpu.serving.engine import (
-        ContinuousEngine, GenerationEngine, SampleSpec,
+        ContinuousEngine, GenerationEngine, PagedContinuousEngine, SampleSpec,
     )
     from dalle_pytorch_tpu.training.metrics import MetricsRegistry
 
@@ -332,11 +402,22 @@ def main_open_loop():
     )
 
     prefill_batch = int(os.environ.get("SERVE_PREFILL_BATCH", "4"))
-    cont = ContinuousEngine(
-        model=model, variables=params, vae=vae, vae_params=vae_params,
-        max_batch=max_batch, chunk_tokens=chunk_tokens,
-        prefill_batch=prefill_batch, registry=MetricsRegistry(),
-    )
+    page_size = int(os.environ.get("SERVE_PAGE_SIZE", "16"))
+    if kv_layout == "paged":
+        kv_pages_env = os.environ.get("SERVE_KV_PAGES")
+        cont = PagedContinuousEngine(
+            model=model, variables=params, vae=vae, vae_params=vae_params,
+            max_batch=max_batch, chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch, registry=MetricsRegistry(),
+            page_size=page_size,
+            kv_pages=int(kv_pages_env) if kv_pages_env else None,
+        )
+    else:
+        cont = ContinuousEngine(
+            model=model, variables=params, vae=vae, vae_params=vae_params,
+            max_batch=max_batch, chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch, registry=MetricsRegistry(),
+        )
     cont.warmup()
     cb = ContinuousBatcher(
         cont, max_queue_rows=max(64, 4 * max_batch), registry=cont.registry,
@@ -349,8 +430,17 @@ def main_open_loop():
     # saturation even if the host slows down between calibration and run
     # (past saturation the bench measures queue buildup, not admission
     # policy). Override with SERVE_RATE_RPS to sweep the load axis.
-    micro_cap = _sustained_rps(mb, text_ids)
-    cont_cap = _sustained_rps(cb, text_ids)
+    def _unique_text(cid, i):
+        # distinct per submission: both caps measure COLD admissions, so
+        # they stay comparable across --kv_layout runs (a repeated prompt
+        # would calibrate the paged engine on its ~100% prefix-hit path)
+        r = np.random.default_rng([cid, i])
+        return r.integers(
+            1, model.num_text_tokens, size=model.text_seq_len
+        ).astype(np.int32)
+
+    micro_cap = _sustained_rps(mb, text_ids, make_text=_unique_text)
+    cont_cap = _sustained_rps(cb, text_ids, make_text=_unique_text)
     rate = float(
         os.environ.get("SERVE_RATE_RPS", 0.4 * min(micro_cap, cont_cap))
     )
@@ -360,6 +450,14 @@ def main_open_loop():
     arrivals = np.cumsum(gaps)
     arrivals = arrivals[arrivals < duration_s]
     seeds = rng.integers(0, 2**31 - 1, size=len(arrivals))
+    # one prompt per arrival, IDENTICAL for both engines — with
+    # --prompt_reuse > 0 repeat prompts hit the paged engine's prefix cache
+    # while the micro/slotted path pays a full prefill either way, so the
+    # hit-vs-cold TTFT split isolates the cache's win on one schedule
+    texts = draw_prompt_schedule(
+        rng, len(arrivals), model.text_seq_len, model.num_text_tokens,
+        prompt_reuse,
+    )
 
     common = {
         "metric": "serving_openloop_rps",
@@ -369,12 +467,13 @@ def main_open_loop():
         "rate_rps": round(rate, 3),
         "duration_s": duration_s,
         "batch_shapes": list(shapes),
+        "prompt_reuse": prompt_reuse,
         "micro_saturation_rps": round(micro_cap, 3),
         "continuous_saturation_rps": round(cont_cap, 3),
     }
 
     micro_stages0 = _stage_snapshot(micro.registry)
-    micro_stats = run_open_loop(mb, text_ids, arrivals, seeds)
+    micro_stats = run_open_loop(mb, text_ids, arrivals, seeds, texts=texts)
     mb.shutdown(drain=True)
     micro_line = {
         **common, "engine": "micro", "value": micro_stats["rps"],
@@ -393,7 +492,14 @@ def main_open_loop():
         "dalle_serving_prefill_dispatches_total"
     ).value
     cont_stages0 = _stage_snapshot(cont.registry)
-    cont_stats = run_open_loop(cb, text_ids, arrivals, seeds)
+    if kv_layout == "paged":
+        # measured-window occupancy: the saturation-calibration flood above
+        # already pushed the pool to ITS peak, so restart the watermark (and
+        # hit/miss tallies) at the live level before the schedule replays
+        cont.kv.pool.peak_allocated = cont.kv.pool.n_allocated
+        hits0, misses0 = cont.kv.cache.hits, cont.kv.cache.misses
+        evictions0 = cont.kv.cache.evictions
+    cont_stats = run_open_loop(cb, text_ids, arrivals, seeds, texts=texts)
     cb.shutdown(drain=True)
     pf_rows = (
         cont.registry.get("dalle_serving_prefills_total").value - pf_rows0
@@ -404,6 +510,7 @@ def main_open_loop():
     )
     cont_line = {
         **common, "engine": "continuous", "value": cont_stats["rps"],
+        "kv_layout": kv_layout,
         "chunk_tokens": chunk_tokens,
         "prefill_batch": cont.prefill_batch,
         "prefill_rows": int(pf_rows),
@@ -414,6 +521,34 @@ def main_open_loop():
         **cont_stats,
         "stages": _stage_breakdown(cont.registry, cont_stages0),
     }
+    if kv_layout == "paged":
+        # HBM story: pages the measured window ACTUALLY peaked at vs the
+        # slotted layout's always-resident worst case (max_batch full-length
+        # lanes). peak_fraction_of_slotted < 1.0 is the paged win — cache
+        # positions the slotted engine pins but this run never touched.
+        slotted_pages = cont.max_batch * cont.kv.pages_per_row
+        cache = cont.kv.cache
+        cont_line["block_occupancy"] = {
+            "page_size": cont.page_size,
+            "pages_total": cont.kv.pool.n_pages - 1,
+            "pages_peak": int(cont.kv.pool.peak_allocated),
+            "pages_slotted_equiv": slotted_pages,
+            "peak_fraction_of_slotted": round(
+                cont.kv.pool.peak_allocated / slotted_pages, 3
+            ),
+        }
+        window_hits = cache.hits - hits0
+        window_misses = cache.misses - misses0
+        admitted = window_hits + window_misses
+        cont_line["prefix_cache"] = {
+            "entries": len(cache),
+            "hits": int(window_hits),
+            "misses": int(window_misses),
+            "hit_rate": round(window_hits / admitted, 3) if admitted else None,
+            # windowed like hits/misses: the saturation-calibration flood
+            # can evict against a capped pool before the schedule replays
+            "evictions": int(cache.evictions - evictions0),
+        }
     if micro_stats["rps"]:
         cont_line["rps_ratio_vs_micro"] = round(
             cont_stats["rps"] / micro_stats["rps"], 3
@@ -465,9 +600,27 @@ def main():
         "--mode", choices=("closed-loop", "open-loop"),
         default=os.environ.get("SERVE_MODE", "closed-loop"),
     )
+    p.add_argument(
+        "--prompt_reuse", type=float,
+        default=float(os.environ.get("SERVE_PROMPT_REUSE", "0")),
+        help="open-loop: probability an arrival repeats a prompt from a "
+        "Zipf-ish popularity pool instead of drawing a unique one "
+        "(repeat prompts are the prefix cache's workload; 0 = legacy "
+        "all-unique mix)",
+    )
+    p.add_argument(
+        "--kv_layout", choices=("slot", "paged"),
+        default=os.environ.get("SERVE_KV_LAYOUT", "slot"),
+        help="open-loop: continuous engine cache layout (paged adds "
+        "block_occupancy + prefix-cache stats and hit-vs-cold TTFT "
+        "splits to its JSON line; SERVE_PAGE_SIZE / SERVE_KV_PAGES size "
+        "the pool)",
+    )
     args = p.parse_args()
     if args.mode == "open-loop":
-        main_open_loop()
+        main_open_loop(
+            prompt_reuse=args.prompt_reuse, kv_layout=args.kv_layout
+        )
     else:
         main_closed_loop()
 

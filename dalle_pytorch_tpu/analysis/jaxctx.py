@@ -12,6 +12,13 @@ idioms this codebase actually uses:
     * `g = jax.jit(f, ...)` rebinding a local def
     * bodies passed to `jax.lax.scan` / `lax.scan` (first positional arg);
       every parameter of a scan body is traced
+    * ONE-HOP cross-procedural propagation: a same-file def whose EVERY
+      call site sits inside an already-traced function inherits
+      tracedness (the `_*_impl` body factored out of a jitted entry
+      point). Parameters are traced only where some call site passes a
+      traced value; a single host call site disables the inheritance, and
+      inherited functions never propagate further (one hop, no fixpoint —
+      depth keeps the false-positive surface auditable)
 
   donated callables (for TL003)
     * `jax.jit(f, donate_argnums=(k,))` and the partial-decorator form
@@ -109,7 +116,7 @@ class TracedInfo:
 
     def traced_params(self) -> Set[str]:
         names = set(param_names(self.func))
-        if self.kind == "jit":
+        if self.kind != "scan":
             # `self`-style first params of decorated methods stay module
             # references, not tracers
             names.discard("self")
@@ -163,6 +170,7 @@ class JaxIndex:
         self._find_decorated()
         self._find_rebound()
         self._find_scan_bodies()
+        self._find_called_from_traced()
 
     # ------------------------------------------------------------ detection
 
@@ -221,6 +229,71 @@ class JaxIndex:
                 name = terminal_name(body)
                 if name and name in self._defs:
                     self._mark(self._defs[name], "scan")
+
+    def _find_called_from_traced(self) -> None:
+        """One-hop cross-procedural propagation: a def whose EVERY call
+        site in this file sits inside an already-traced function body runs
+        under tracing itself — the `_*_impl` helper factored out of a
+        jitted entry point. A parameter is traced where ANY traced call
+        site feeds it a traced value. One hop only: the snapshot below
+        fixes the caller set, so an inherited function never propagates
+        to ITS callees (no fixpoint — each extra hop multiplies the
+        heuristic's error, and one covers the factoring idiom). Any host
+        call site (including module level) disables inheritance: the
+        helper demonstrably runs both ways, and flagging its host uses
+        would be pure noise."""
+        callers = dict(self.traced)  # snapshot: the one-hop frontier
+        enclosing: Dict[int, Optional[ast.AST]] = {}
+
+        def visit(node: ast.AST, owner: Optional[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Call):
+                    enclosing[id(child)] = owner
+                visit(
+                    child,
+                    child
+                    if isinstance(child, FunctionNode + (ast.Lambda,))
+                    else owner,
+                )
+
+        visit(self.tree, None)
+        sites: Dict[str, List[ast.Call]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                name = terminal_name(node.func)
+                if name in self._defs:
+                    sites.setdefault(name, []).append(node)
+        for name, calls in sites.items():
+            func = self._defs[name]
+            if func in self.traced:
+                continue
+            owners = [enclosing.get(id(c)) for c in calls]
+            if any(o is None or o not in callers for o in owners):
+                continue
+            names = param_names(func)
+            traced_at_site: Set[str] = set()
+            for call, owner in zip(calls, owners):
+                info = callers[owner]
+                taint = propagate_traced(info.func, info.traced_params())
+                # attribute calls bind the receiver to `self`: positional
+                # args start at the second parameter
+                pos = (
+                    names[1:]
+                    if names[:1] == ["self"]
+                    and isinstance(call.func, ast.Attribute)
+                    else names
+                )
+                for i, arg in enumerate(call.args):
+                    if i < len(pos) and mentions_traced(arg, taint):
+                        traced_at_site.add(pos[i])
+                for kw in call.keywords:
+                    if kw.arg in names and mentions_traced(kw.value, taint):
+                        traced_at_site.add(kw.arg)
+            if traced_at_site:
+                self._mark(
+                    func, "jit-called",
+                    frozenset(set(names) - traced_at_site),
+                )
 
 
 # --------------------------------------------------------------- arg flow

@@ -8,6 +8,8 @@ TL001  Python `if`/`while`/`assert` on a traced parameter of a jit/pjit/
        scan-wrapped function. Branching on a tracer either raises a
        ConcretizationTypeError or — with static_argnums misapplied —
        silently recompiles per value, destroying the compiled-shape ladder.
+       Covers `_*_impl` helpers whose only call sites are traced functions
+       (one-hop cross-procedural inheritance, jaxctx.JaxIndex).
 TL002  device->host syncs (`.item()`, `float()/int()/bool()` on arrays,
        `np.asarray`, `jax.device_get`, `.block_until_ready()`) inside
        traced functions (error tier — always a bug), or on engine state

@@ -43,7 +43,11 @@ from dalle_pytorch_tpu.ops.pallas_attention import (
     flash_attention,
     lib_flash_attention,
 )
-from dalle_pytorch_tpu.ops.pallas_decode import flash_decode_attention
+from dalle_pytorch_tpu.ops.pallas_decode import (
+    flash_decode_attention,
+    paged_decode_attention,
+    paged_gather,
+)
 from dalle_pytorch_tpu.ops.rotary import apply_rotary
 
 # Sequence length at or above which `attn_impl="auto"` switches from the
@@ -197,8 +201,20 @@ class Attention(nn.Module):
             # different times) — every index-dependent op below (rotary row
             # slice, cache write, causal mask, pattern-mask row slice) then
             # runs per row via vmap, at identical per-row numerics.
+            #
+            # A cache carrying a "page_table" key is BLOCK-PAGED: k/v are a
+            # physical page pool [P, H, page_size, D] shared by all rows
+            # and the [B, n_pages] table maps each row's logical blocks to
+            # pages (serving/paging.py allocates; released rows point at
+            # the reserved garbage page 0, so a stale write can never
+            # corrupt a reallocated page). Reads either gather the row's
+            # logical view and run the IDENTICAL dense/flash path as the
+            # slotted cache (bit-for-bit — the paging parity contract) or
+            # stream pages directly through the paged Pallas kernel
+            # (ops/pallas_decode.py PAGED_DECODE_IMPL).
             index = cache["index"]
             per_row = jnp.ndim(index) == 1
+            paged = "page_table" in cache
             if rotary is not None:
                 if per_row:
                     rot = jax.vmap(
@@ -209,9 +225,30 @@ class Attention(nn.Module):
                     rot = lax.dynamic_slice_in_dim(rotary, index, n, axis=0)
                     rot = jnp.expand_dims(rot, (0, 1))  # [1,1,n,dr]
                 q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
-            ck = _cache_write(cache["k"], k, index)
-            cv = _cache_write(cache["v"], v, index)
-            max_len = ck.shape[2]
+            if paged:
+                assert per_row, "paged caches always carry per-row indices"
+                pt = cache["page_table"]
+                page_size = cache["k"].shape[2]
+                # virtual contiguous length == the slotted cache's max_len
+                # (total_seq_len + 1): gather crops to it so dense/flash see
+                # byte-identical shapes on both layouts
+                max_len = min(pt.shape[-1] * page_size, self.seq_len + 1)
+                pos = jnp.minimum(
+                    index[:, None] + jnp.arange(n), max_len - 1
+                )  # [B, n]; finished rows clamp to the spare slot like the
+                # slotted dynamic_update_slice does
+                page = jnp.take_along_axis(pt, pos // page_size, axis=1)
+                off = pos % page_size
+                ck = cache["k"].at[page, :, off, :].set(
+                    k.transpose(0, 2, 1, 3).astype(cache["k"].dtype)
+                )
+                cv = cache["v"].at[page, :, off, :].set(
+                    v.transpose(0, 2, 1, 3).astype(cache["v"].dtype)
+                )
+            else:
+                ck = _cache_write(cache["k"], k, index)
+                cv = _cache_write(cache["v"], v, index)
+                max_len = ck.shape[2]
             if self._use_flash_decode(
                 max_len,
                 has_pattern=(
@@ -223,8 +260,22 @@ class Attention(nn.Module):
                 # builds below, but reads ONLY each row's live K/V blocks
                 # (scalar index = lockstep decode: every row at one length)
                 lengths = jnp.broadcast_to(index + n, (b,)).astype(jnp.int32)
-                out = flash_decode_attention(q, ck, cv, lengths)
+                if paged:
+                    out = paged_decode_attention(
+                        q, ck, cv, lengths, pt, max_len
+                    )
+                else:
+                    out = flash_decode_attention(q, ck, cv, lengths)
             else:
+                if paged:
+                    # one gathered view per dispatch; dead positions hold
+                    # garbage-page bytes but the causal mask below replaces
+                    # their scores with the same NEG constant the slotted
+                    # path uses, so outputs stay bit-identical
+                    gk = paged_gather(ck, pt, max_len)
+                    gv = paged_gather(cv, pt, max_len)
+                else:
+                    gk, gv = ck, cv
                 # query row i sits at global position index + i: causal over
                 # the written prefix (the reference instead relies on only
                 # having written the prefix, `attention.py:71-76,86`)
@@ -267,8 +318,10 @@ class Attention(nn.Module):
                     )
                 if mask_array is not None:
                     mask = mask & mask_rows_at(mask_array)
-                out = dense_attention(q, ck, cv, mask=mask, stable=self.stable)
+                out = dense_attention(q, gk, gv, mask=mask, stable=self.stable)
             new_cache = {"k": ck, "v": cv, "index": index + n}
+            if paged:
+                new_cache["page_table"] = pt
         else:
             if rotary is not None:
                 rot = jnp.expand_dims(rotary[:n], (0, 1))

@@ -992,6 +992,17 @@ def decode_image_chunk(model: DALLE, variables, state: dict, chunk: int):
 
 def _chunk_builder(model, key):
     (chunk,) = key
+    return _make_chunk_fn(model, chunk, paged=False)
+
+
+def _make_chunk_fn(model, chunk, paged):
+    """One chunk program body, shared by the slotted and paged layouts so
+    the decode semantics (sampling, liveness gating, position threading)
+    cannot drift between them — only the cache plumbing differs: the paged
+    variant takes the host-built page table as an extra traced argument,
+    injects it into every layer's attention cache for the duration of the
+    scan, and strips it from the result (the table is host state, not part
+    of the donated device state)."""
     from dalle_pytorch_tpu.models.transformer import set_decode_cache_index
     from dalle_pytorch_tpu.ops.sampling import (
         gumbel_sample_per_row, per_row_step_keys, top_k_filter_per_row,
@@ -1003,7 +1014,7 @@ def _chunk_builder(model, key):
         np.arange(model.total_tokens) < model.total_text_tokens
     )[None]
 
-    def fn(variables, state):
+    def run(variables, state, cache0):
         active = state["active"]
         seeds = state["seeds"]
         temps = state["temps"]
@@ -1038,24 +1049,432 @@ def _chunk_builder(model, key):
             return (cache, row, img_tokens, img_pos), None
 
         carry = (
-            state["cache"], state["row"], state["img_tokens"],
-            state["img_pos"],
+            cache0, state["row"], state["img_tokens"], state["img_pos"],
         )
-        (cache, row, img_tokens, img_pos), _ = jax.lax.scan(
-            step, carry, None, length=chunk
-        )
-        return {
-            **state,
-            "cache": cache,
-            "row": row,
-            "img_tokens": img_tokens,
-            "img_pos": img_pos,
-        }
+        return jax.lax.scan(step, carry, None, length=chunk)[0]
+
+    if paged:
+        def fn(variables, state, page_table):
+            cache0 = _with_page_table(
+                state["cache"], page_table, model.executor, model.depth
+            )
+            cache, row, img_tokens, img_pos = run(variables, state, cache0)
+            return {
+                **state,
+                "cache": _without_page_table(cache, model.executor),
+                "row": row,
+                "img_tokens": img_tokens,
+                "img_pos": img_pos,
+            }
+    else:
+        def fn(variables, state):
+            cache, row, img_tokens, img_pos = run(
+                variables, state, state["cache"]
+            )
+            return {
+                **state,
+                "cache": cache,
+                "row": row,
+                "img_tokens": img_tokens,
+                "img_pos": img_pos,
+            }
 
     return fn
 
 
 _chunk_builder._donate_argnums = (1,)  # state
+
+
+# ------------------------------------------------- paged KV cache (blocks)
+#
+# The slotted state above pins max_batch * (total_seq_len + 1) cache
+# positions whether or not a row holds tokens — HBM spent on worst-case
+# padding bounds concurrency. The paged ops below move K/V into a pool of
+# fixed-size pages plus host-owned per-row page tables
+# (serving/paging.py): admission maps pages, identical caption prefixes
+# SHARE immutable prefill pages (content-hash prefix cache; a repeat
+# prompt admits with zero transformer dispatches via its cached sidecar),
+# and released rows return pages to the pool. The page table is a traced
+# argument to every dispatch — ONE compiled program regardless of which
+# pages are mapped — and the state stays donated exactly like the slotted
+# ops. models/attention.py reads the paged cache either through a gathered
+# contiguous view (bit-for-bit identical to the slotted path — the parity
+# contract tests/test_paging.py pins) or the paged Pallas kernel
+# (ops/pallas_decode.py).
+
+
+def _with_page_table(cache, page_table, executor, depth):
+    """Inject the [B, n_pages] table into every layer's attention cache
+    (depth-stacked for the scan executor, which slices it per layer)."""
+    pt = jnp.asarray(page_table, jnp.int32)
+    if executor == "scan":
+        ptd = jnp.broadcast_to(pt, (depth,) + pt.shape)
+        return {**cache, "attn": {**cache["attn"], "page_table": ptd}}
+    return {
+        name: {**layer, "attn": {**layer["attn"], "page_table": pt}}
+        for name, layer in cache.items()
+    }
+
+
+def _without_page_table(cache, executor):
+    if executor == "scan":
+        attn = {k: v for k, v in cache["attn"].items() if k != "page_table"}
+        return {**cache, "attn": attn}
+    return {
+        name: {
+            **layer,
+            "attn": {
+                k: v for k, v in layer["attn"].items() if k != "page_table"
+            },
+        }
+        for name, layer in cache.items()
+    }
+
+
+def init_paged_slot_state(
+    model: DALLE, max_batch: int, n_pages: int, page_size: int, dtype=None
+) -> dict:
+    """Persistent paged decode state: same per-row control state as
+    `init_slot_state`, with K/V in a page pool instead of per-slot lanes.
+    Page 0 is the serving layer's reserved garbage page (never allocated),
+    so the pool must be sized n_pages >= usable pages + 1."""
+    from dalle_pytorch_tpu.models.transformer import make_paged_decode_cache
+
+    s = int(max_batch)
+    return {
+        "cache": make_paged_decode_cache(
+            depth=model.depth,
+            batch=s,
+            n_pages=int(n_pages),
+            page_size=int(page_size),
+            heads=model.heads,
+            dim_head=model.dim_head,
+            dim=model.dim,
+            image_fmap_size=model.image_fmap_size,
+            shift_tokens=model.shift_tokens,
+            dtype=model.dtype if dtype is None else dtype,
+            executor=model.executor,
+        ),
+        "row": jnp.zeros((s, model.total_tokens), jnp.float32),
+        "img_tokens": jnp.zeros((s, model.image_seq_len), jnp.int32),
+        "img_pos": jnp.zeros((s,), jnp.int32),
+        "active": jnp.zeros((s,), jnp.bool_),
+        "seeds": jnp.zeros((s,), jnp.int32),
+        "temps": jnp.ones((s,), jnp.float32),
+        "keep_k": jnp.ones((s,), jnp.int32),
+    }
+
+
+def _extract_rings(cache_r, executor):
+    """Row-major token-shift-ring sidecar from a fresh prefill cache: the
+    part of a prefix's post-prefill state that is NOT page-addressable
+    (plus the pending logits, captured separately). Empty dict when the
+    model doesn't shift tokens."""
+    if executor == "scan":
+        return {
+            name: jnp.moveaxis(cache_r[name], 1, 0)  # [R, depth, fmap, dim]
+            for name in ("shift_attn", "shift_ff")
+            if name in cache_r
+        }
+    out = {}
+    for lname, layer in cache_r.items():
+        rings = {
+            n: layer[n] for n in ("shift_attn", "shift_ff") if n in layer
+        }
+        if rings:
+            out[lname] = rings
+    return out
+
+
+def prefill_into_slots_paged(
+    model: DALLE,
+    variables,
+    state: dict,
+    texts: jnp.ndarray,
+    slots,
+    seeds,
+    temperatures,
+    keep_ks,
+    page_rows,
+    partial_dst,
+    page_size: int,
+):
+    """Paged-layout batched admission: the same batch-R text prefill as
+    `prefill_into_slots`, scattered into PAGES instead of slot lanes.
+
+    `page_rows` is [R, n_text_pages] — the physical page for each of row
+    r's text blocks (host-allocated; shared prefix blocks may point at
+    pages other rows/the prefix cache also map, in which case this dispatch
+    rewrites them with bit-identical content — prefill K/V is a
+    deterministic, batch-composition-invariant function of the text).
+    `partial_dst` is [R]: an EXTRA destination page for each row's last
+    text block — the prefix cache's immutable snapshot of the divergence
+    block, which the row goes on to mutate in its own copy while the cache
+    keeps this one (copy-on-write at registration time). Page 0 (garbage)
+    disables the extra write for rows the host isn't registering.
+
+    Returns (state, sidecar): `state` donated/replaced as usual; `sidecar`
+    is {"row": [R, V] pending logits, "rings": row-major shift rings} —
+    everything a later full-prefix admission needs to skip the transformer
+    entirely (`admit_cached_prefix`).
+    """
+    texts = jnp.asarray(texts, jnp.int32)
+    prefill_batch = int(texts.shape[0])
+    page_rows = jnp.asarray(page_rows, jnp.int32)
+    n_text_pages = int(page_rows.shape[1])
+    return _jit_sample(
+        _prefill_slots_paged_builder, model,
+        (prefill_batch, int(page_size), n_text_pages),
+        variables, state, texts,
+        jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
+        jnp.asarray(temperatures, jnp.float32), jnp.asarray(keep_ks, jnp.int32),
+        page_rows, jnp.asarray(partial_dst, jnp.int32),
+    )
+
+
+def _prefill_slots_paged_builder(model, key):
+    prefill_batch, page_size, n_text_pages = key
+    batch_axis = 1 if model.executor == "scan" else 0
+
+    def block_of(p_leaf, r, j):
+        """Row r's K/V slice for text block j, zero-padded to page_size
+        past the prefill cache's end (static shapes throughout)."""
+        if batch_axis == 1:
+            row_kv = p_leaf[:, r]  # [depth, H, max_len, D]
+            seq_ax = 2
+        else:
+            row_kv = p_leaf[r]  # [H, max_len, D]
+            seq_ax = 1
+        max_len = row_kv.shape[seq_ax]
+        lo = j * page_size
+        hi = min(lo + page_size, max_len)
+        blk = jax.lax.slice_in_dim(row_kv, lo, hi, axis=seq_ax)
+        if hi - lo < page_size:
+            pad = [(0, 0)] * row_kv.ndim
+            pad[seq_ax] = (0, page_size - (hi - lo))
+            blk = jnp.pad(blk, pad)
+        return blk
+
+    def fn(variables, state, texts, slots, seeds, temperatures, keep_ks,
+           page_rows, partial_dst):
+        rows, cache_r = model.apply(
+            variables,
+            texts,
+            init_decode_cache(model, prefill_batch),
+            method=DALLE.decode_prefill,
+        )
+
+        def write(path, s_leaf, p_leaf):
+            key_ = getattr(path[-1], "key", None)
+            if key_ == "index":
+                # stamped from per-slot img_pos every chunk step
+                return s_leaf
+            if key_ in ("k", "v"):
+                out = s_leaf
+                for r in range(prefill_batch):
+                    for j in range(n_text_pages):
+                        blk = block_of(p_leaf, r, j).astype(out.dtype)
+                        if batch_axis == 1:
+                            out = jax.lax.dynamic_update_slice(
+                                out, blk[:, None],
+                                (0, page_rows[r, j], 0, 0, 0),
+                            )
+                        else:
+                            out = jax.lax.dynamic_update_slice(
+                                out, blk[None], (page_rows[r, j], 0, 0, 0)
+                            )
+                    # prefix-cache snapshot of the divergence block (page 0
+                    # = not registering; the garbage page absorbs it)
+                    blk = block_of(p_leaf, r, n_text_pages - 1).astype(
+                        out.dtype
+                    )
+                    if batch_axis == 1:
+                        out = jax.lax.dynamic_update_slice(
+                            out, blk[:, None], (0, partial_dst[r], 0, 0, 0)
+                        )
+                    else:
+                        out = jax.lax.dynamic_update_slice(
+                            out, blk[None], (partial_dst[r], 0, 0, 0)
+                        )
+                return out
+            # shift rings: per-slot row scatter, same as the slotted path
+            out = s_leaf
+            for r in range(prefill_batch):
+                p_row = jax.lax.dynamic_slice_in_dim(
+                    p_leaf, r, 1, axis=batch_axis
+                )
+                out = jax.lax.dynamic_update_slice_in_dim(
+                    out, p_row.astype(out.dtype), slots[r], axis=batch_axis
+                )
+            return out
+
+        new_cache = jax.tree_util.tree_map_with_path(
+            write, state["cache"], cache_r
+        )
+        out = dict(state)
+        out["cache"] = new_cache
+        row_buf = state["row"]
+        tok_buf = state["img_tokens"]
+        zero_row = jnp.zeros((1, model.image_seq_len), jnp.int32)
+        for r in range(prefill_batch):
+            row_buf = jax.lax.dynamic_update_slice(
+                row_buf, rows[r : r + 1].astype(row_buf.dtype), (slots[r], 0)
+            )
+            tok_buf = jax.lax.dynamic_update_slice(
+                tok_buf, zero_row, (slots[r], 0)
+            )
+        out["row"] = row_buf
+        out["img_tokens"] = tok_buf
+        out["img_pos"] = state["img_pos"].at[slots].set(0)
+        out["active"] = state["active"].at[slots].set(True)
+        out["seeds"] = state["seeds"].at[slots].set(seeds)
+        out["temps"] = state["temps"].at[slots].set(temperatures)
+        out["keep_k"] = state["keep_k"].at[slots].set(keep_ks)
+        sidecar = {
+            "row": rows.astype(jnp.float32),
+            "rings": _extract_rings(cache_r, model.executor),
+        }
+        return out, sidecar
+
+    return fn
+
+
+_prefill_slots_paged_builder._donate_argnums = (1,)  # state
+
+
+def slice_prefix_sidecar(model: DALLE, sidecar: dict, r: int):
+    """Row `r` of a batched prefill sidecar (all leaves are row-major) —
+    ONE compiled program per sidecar structure, so registering a prefix on
+    a warm server never compiles."""
+    return _jit_sample(
+        _slice_sidecar_builder, model, (), sidecar, jnp.int32(r)
+    )
+
+
+def _slice_sidecar_builder(model, key):
+    del model, key
+
+    def fn(sidecar, r):
+        return jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, r, axis=0, keepdims=False),
+            sidecar,
+        )
+
+    return fn
+
+
+def admit_cached_prefix(
+    model: DALLE,
+    state: dict,
+    slot: int,
+    sidecar: dict,
+    seed,
+    temperature,
+    keep_k,
+    partial_src,
+    partial_dst,
+    page_size: int,
+):
+    """Admit a FULL prefix-cache hit into `slot` with zero transformer
+    dispatches: the prefix's K/V pages are already mapped into the row's
+    page table by the host; this op restores the non-page-addressable
+    remainder — pending logits + shift rings from the cached sidecar, the
+    per-slot sampling params — and copy-on-writes the divergence block
+    (`partial_src` = the cache's immutable snapshot page, `partial_dst` =
+    the row's private copy the decode will mutate; configs whose text
+    prefix ends exactly on a page boundary skip the copy statically).
+
+    `state` is DONATED — replace your reference with the return value.
+    """
+    return _jit_sample(
+        _admit_prefix_builder, model, (int(page_size),),
+        state, jnp.int32(slot), sidecar,
+        jnp.int32(seed), jnp.float32(temperature), jnp.int32(keep_k),
+        jnp.int32(partial_src), jnp.int32(partial_dst),
+    )
+
+
+def _admit_prefix_builder(model, key):
+    (page_size,) = key
+    batch_axis = 1 if model.executor == "scan" else 0
+    page_axis = batch_axis  # pages leaf: optional depth axis, then pages
+    has_partial = (model.text_seq_len + 1) % page_size != 0
+
+    def fn(state, slot, sidecar, seed, temperature, keep_k,
+           partial_src, partial_dst):
+        rings = sidecar["rings"]
+
+        def upd(path, leaf):
+            key_ = getattr(path[-1], "key", None)
+            if key_ in ("k", "v"):
+                if not has_partial:
+                    return leaf
+                blk = jax.lax.dynamic_slice_in_dim(
+                    leaf, partial_src, 1, axis=page_axis
+                )
+                return jax.lax.dynamic_update_slice_in_dim(
+                    leaf, blk, partial_dst, axis=page_axis
+                )
+            if key_ in ("shift_attn", "shift_ff"):
+                node = rings
+                for p in path:
+                    node = node[p.key]
+                return jax.lax.dynamic_update_slice_in_dim(
+                    leaf,
+                    jnp.expand_dims(node, batch_axis).astype(leaf.dtype),
+                    slot,
+                    axis=batch_axis,
+                )
+            return leaf  # index: stamped from img_pos every chunk
+
+        out = dict(state)
+        out["cache"] = jax.tree_util.tree_map_with_path(
+            upd, state["cache"]
+        )
+        out["row"] = jax.lax.dynamic_update_slice(
+            state["row"],
+            sidecar["row"][None].astype(state["row"].dtype),
+            (slot, 0),
+        )
+        out["img_tokens"] = jax.lax.dynamic_update_slice(
+            state["img_tokens"],
+            jnp.zeros((1, model.image_seq_len), jnp.int32),
+            (slot, 0),
+        )
+        out["img_pos"] = state["img_pos"].at[slot].set(0)
+        out["active"] = state["active"].at[slot].set(True)
+        out["seeds"] = state["seeds"].at[slot].set(seed)
+        out["temps"] = state["temps"].at[slot].set(temperature)
+        out["keep_k"] = state["keep_k"].at[slot].set(keep_k)
+        return out
+
+    return fn
+
+
+_admit_prefix_builder._donate_argnums = (0,)  # state
+
+
+def decode_image_chunk_paged(
+    model: DALLE, variables, state: dict, chunk: int, page_table
+):
+    """Paged-layout chunk step: identical decode semantics to
+    `decode_image_chunk` (one shared program body — see `_make_chunk_fn`),
+    with every row's K/V reads and writes indirected through `page_table`
+    [max_batch, n_pages] (host numpy, traced data: ONE compiled program no
+    matter which pages are mapped). `state` is DONATED; the page table is
+    not (it is host-owned and tiny)."""
+    return _jit_sample(
+        _chunk_paged_builder, model, (int(chunk),),
+        variables, state, jnp.asarray(page_table, jnp.int32),
+    )
+
+
+def _chunk_paged_builder(model, key):
+    (chunk,) = key
+    return _make_chunk_fn(model, chunk, paged=True)
+
+
+_chunk_paged_builder._donate_argnums = (1,)  # state
 
 
 def forward_with_cond_scale(

@@ -999,6 +999,66 @@ def make_decode_cache(
     return cache
 
 
+def make_paged_decode_cache(
+    depth: int,
+    batch: int,
+    n_pages: int,
+    page_size: int,
+    heads: int,
+    dim_head: int,
+    dim: int,
+    image_fmap_size: Optional[int] = None,
+    shift_tokens: bool = False,
+    dtype=jnp.float32,
+    executor: str = "unrolled",
+) -> dict:
+    """Block-paged decode cache: K/V live in a physical page pool
+    [n_pages, heads, page_size, dim_head] shared by all `batch` rows
+    instead of per-row [max_len] lanes; a host-side page table (passed as
+    a traced argument per dispatch, NOT stored here) maps each row's
+    logical blocks to pages. Same tree keys as `make_decode_cache` so the
+    scatter/gather model ops tree-map across both layouts; shift rings and
+    the per-row `index` stay row-indexed (they are small — paging them
+    would buy nothing).
+    """
+    if executor == "scan":
+        cache = {
+            "attn": {
+                "k": jnp.zeros(
+                    (depth, n_pages, heads, page_size, dim_head), dtype
+                ),
+                "v": jnp.zeros(
+                    (depth, n_pages, heads, page_size, dim_head), dtype
+                ),
+                "index": jnp.zeros((depth, batch), jnp.int32),
+            }
+        }
+        if shift_tokens:
+            assert image_fmap_size is not None
+            cache["shift_attn"] = jnp.zeros(
+                (depth, batch, image_fmap_size, dim), dtype
+            )
+            cache["shift_ff"] = jnp.zeros(
+                (depth, batch, image_fmap_size, dim), dtype
+            )
+        return cache
+    cache = {}
+    for i in range(depth):
+        layer = {
+            "attn": {
+                "k": jnp.zeros((n_pages, heads, page_size, dim_head), dtype),
+                "v": jnp.zeros((n_pages, heads, page_size, dim_head), dtype),
+                "index": jnp.zeros((batch,), jnp.int32),
+            }
+        }
+        if shift_tokens:
+            assert image_fmap_size is not None
+            layer["shift_attn"] = jnp.zeros((batch, image_fmap_size, dim), dtype)
+            layer["shift_ff"] = jnp.zeros((batch, image_fmap_size, dim), dtype)
+        cache[f"layer_{i}"] = layer
+    return cache
+
+
 def set_decode_cache_index(cache: dict, pos: jnp.ndarray, executor: str) -> dict:
     """Overwrite every layer's cache `index` with `pos`.
 

@@ -43,6 +43,7 @@ so CPU tests exercise the real kernel logic.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -200,3 +201,155 @@ def flash_decode_attention(
         interpret=interp,
     )(lengths, qp, kp, vp)
     return out[:, :, :n, :]
+
+
+# ------------------------------------------------------------ paged KV cache
+#
+# The continuous engine's paged layout stores K/V as a pool of fixed-size
+# pages [P, H, page_size, D] plus a per-row page table [B, n_pages] mapping
+# logical block j of row b to a physical page (serving/paging.py owns the
+# allocation; models/dalle.py the scatter/gather ops). Two decode-attention
+# implementations sit behind `paged_decode_attention`:
+#
+#   "gather"  materialize each row's logical view with one gather and run
+#             the EXACT `flash_decode_attention` kernel above. Same tile
+#             boundaries, same online-softmax accumulation order — so the
+#             paged engine is bit-for-bit identical to the slotted one
+#             (the parity contract tests/test_paging.py pins). Costs one
+#             transient contiguous copy of the virtual cache per dispatch.
+#   "kernel"  the true paged kernel: the page table rides scalar prefetch
+#             and the K/V index maps dereference it per grid step, so a row
+#             at position p streams only its ceil(p/page_size) live pages
+#             out of HBM — no contiguous copy ever materializes. Tile size
+#             equals the page size, so its accumulation ORDER differs from
+#             the slotted kernel's; it matches the gather oracle to fp32
+#             tolerance (pinned), not bit-for-bit.
+#
+# Default is "gather" (bit-exactness is the serving stack's contract and
+# CPU-hosted tests exercise it end to end); flip `PAGED_DECODE_IMPL` or set
+# DALLE_PAGED_DECODE_IMPL=kernel to arm the bandwidth-optimal path on TPU.
+
+PAGED_DECODE_IMPL = os.environ.get("DALLE_PAGED_DECODE_IMPL", "gather")
+
+
+def paged_gather(pages: jnp.ndarray, page_table: jnp.ndarray, vlen: int):
+    """Contiguous per-row view of a paged K/V pool.
+
+    pages: [P, H, page_size, D]; page_table: [B, n_pages] int32 physical
+    page per logical block. Returns [B, H, vlen, D] — the first `vlen`
+    positions of each row's logical sequence (positions no table entry was
+    ever written for come from the garbage page; callers mask them).
+    """
+    b, n_pages = page_table.shape
+    _, h, bs, d = pages.shape
+    g = pages[page_table]  # [B, n_pages, H, bs, D]
+    g = g.transpose(0, 2, 1, 3, 4).reshape(b, h, n_pages * bs, d)
+    return g[:, :, :vlen, :]
+
+
+def _paged_decode_kernel(lengths_ref, pt_ref, *refs, **kw):
+    """Same online-softmax body as `_decode_kernel`; the page table ref is
+    consumed by the K/V BlockSpec index maps, not the body."""
+    del pt_ref
+    _decode_kernel(lengths_ref, *refs, **kw)
+
+
+def paged_flash_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_table: jnp.ndarray,
+    *,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash decode directly over the paged pool: grid step (b, h, j) DMAs
+    physical page `page_table[b, j]`, and steps past the row's last live
+    block re-index that block so Pallas elides the copy (the same dead-tile
+    trick as the contiguous kernel). The causal-over-prefix mask is
+    identical to `flash_decode_attention`'s.
+
+    q: [B, H, n, D]; k_pages/v_pages: [P, H, page_size, D]; lengths: [B]
+    live positions including the current chunk; page_table: [B, n_pages].
+    Tile size == page_size (TPU wants page_size a multiple of 8 and D of
+    128 off interpret mode). fp32 accumulation; decode-only, no VJP.
+    """
+    b, h, n, d = q.shape
+    p_total, hk, page_size, dk = k_pages.shape
+    assert k_pages.shape == v_pages.shape and (hk, dk) == (h, d), (
+        q.shape, k_pages.shape, v_pages.shape,
+    )
+    n_pages = page_table.shape[1]
+    assert page_table.shape == (b, n_pages), (page_table.shape, b)
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    interp = _use_interpret() if interpret is None else interpret
+
+    qp = _pad_to(q, 2, _MIN_BLOCK_Q)
+    bq = qp.shape[2]
+    lengths = jnp.clip(lengths.astype(jnp.int32), 0, n_pages * page_size)
+    page_table = page_table.astype(jnp.int32)
+
+    kernel = functools.partial(
+        _paged_decode_kernel,
+        sm_scale=scale,
+        block_k=page_size,
+        n_real_q=n,
+        nk_blocks=n_pages,
+    )
+    qspec = pl.BlockSpec(
+        (1, 1, bq, d), lambda b_, h_, j, lens, pt: (b_, h_, 0, 0)
+    )
+
+    def kv_idx(b_, h_, j, lens, pt):
+        # dead steps re-index the row's last live page -> copy elided
+        jc = jnp.minimum(j, _last_live_block(lens[b_], page_size))
+        return (pt[b_, jc], h_, 0, 0)
+
+    kvspec = pl.BlockSpec((1, 1, page_size, d), kv_idx)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, n_pages),
+            in_specs=[qspec, kvspec, kvspec],
+            out_specs=qspec,
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interp,
+    )(lengths, page_table, qp, k_pages, v_pages)
+    return out[:, :, :n, :]
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_table: jnp.ndarray,
+    vlen: int,
+    *,
+    impl: Optional[str] = None,
+    sm_scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Flash-path dispatch for the paged cache — see the section comment
+    above for the "gather" (bit-exact) vs "kernel" (bandwidth-optimal)
+    trade. `vlen` is the virtual contiguous length the gather path crops
+    to (the slotted cache's max_len, so tile boundaries match exactly)."""
+    impl = PAGED_DECODE_IMPL if impl is None else impl
+    if impl == "gather":
+        k = paged_gather(k_pages, page_table, vlen)
+        v = paged_gather(v_pages, page_table, vlen)
+        return flash_decode_attention(q, k, v, lengths, sm_scale=sm_scale)
+    assert impl == "kernel", f"unknown paged decode impl {impl!r}"
+    return paged_flash_decode_attention(
+        q, k_pages, v_pages, lengths, page_table, sm_scale=sm_scale
+    )

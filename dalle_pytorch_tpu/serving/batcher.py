@@ -116,6 +116,11 @@ class GenRequest:
         # (micro-batch engine — its tokens only materialize at scan end).
         # Benches read it for time-to-first-token percentiles.
         self.first_token_at: Optional[float] = None
+        # True when EVERY row of this request admitted via the prefix
+        # cache (paged engine, zero prefill dispatches); None when the
+        # engine doesn't report admission stats. Rides into the structured
+        # request log so per-request traces explain cheap vs full prefills.
+        self.prefix_hit: Optional[bool] = None
 
     @property
     def rows(self) -> int:
@@ -299,6 +304,15 @@ class MicroBatcher:
                 raise QueueFullError(
                     f"request of {req.rows} rows exceeds max batch "
                     f"{self.max_batch}"
+                )
+            can_ever = getattr(self.engine, "can_ever_admit", None)
+            if can_ever is not None and not can_ever(req.specs):
+                # paged engine: the request's worst case exceeds the WHOLE
+                # block pool — it would queue forever, so reject now
+                self._m_rejected.inc()
+                raise QueueFullError(
+                    f"request of {req.rows} rows exceeds the engine's KV "
+                    "block pool capacity"
                 )
             if self._pending_rows + req.rows > self.max_queue_rows:
                 self._m_rejected.inc()
@@ -582,10 +596,45 @@ class ContinuousBatcher(MicroBatcher):
                     # submit/shutdown notifies (no busy-poll)
                     self._cond.wait()
                 # all-or-nothing admission in arrival order (no starvation:
-                # a wide request blocks later narrow ones until slots free)
+                # a wide request blocks later narrow ones until slots free).
+                # Paged engines gate on free KV blocks too: block
+                # exhaustion keeps the request queued (backpressure) until
+                # releases return pages, exactly like slot exhaustion. The
+                # check covers the WHOLE wave popped so far, not each
+                # request in isolation — pages are only reserved at
+                # prefill, so two requests that fit alone could jointly
+                # overrun the pool and break the allocator's reservation
+                # invariant mid-decode.
+                can_admit = getattr(self.engine, "can_admit", None)
+                demand_fn = getattr(self.engine, "admission_demand", None)
+                headroom_fn = getattr(
+                    self.engine, "admission_headroom", None
+                )
+                incremental = (
+                    demand_fn is not None and headroom_fn is not None
+                )
+                # headroom is fixed while this worker holds the queue
+                # (pages move only at prefill/release, on this thread),
+                # so each head's demand is summed ONCE against a per-wave
+                # snapshot instead of re-deriving the whole wave's demand
+                # on every pop; engines exposing only `can_admit` get the
+                # equivalent union check
+                budget = headroom_fn() if incremental else 0
+                wave_demand = 0
+                wave_specs: List = []
                 while head is not None and self.allocator.n_free >= head.rows:
+                    if incremental:
+                        head_demand = demand_fn(head.specs)
+                        if wave_demand + head_demand > budget:
+                            break
+                        wave_demand += head_demand
+                    elif can_admit is not None and not can_admit(
+                        wave_specs + list(head.specs)
+                    ):
+                        break
                     self._pending.popleft()
                     self._pending_rows -= head.rows
+                    wave_specs.extend(head.specs)
                     partial[head] = {
                         "tokens": [None] * head.rows,
                         "remaining": head.rows,
@@ -620,14 +669,54 @@ class ContinuousBatcher(MicroBatcher):
                     tp0 = time.monotonic()
                     stage_name, stage_t0 = "prefill", tp0
                     dispatches = 0
+                    # paged-engine admission stats (prefix-cache hits admit
+                    # with zero prefill dispatches): aggregated over the
+                    # wave's splits for span metadata + per-request flags
+                    hit_slots: set = set()
+                    blocks_reused = suffix_tokens = 0
+                    have_stats = False
                     prefill_slots = getattr(self.engine, "prefill_slots", None)
                     if prefill_slots is not None:
                         pb = max(
                             1, int(getattr(self.engine, "prefill_batch", 1))
                         )
-                        for i in range(0, len(admitted), pb):
-                            prefill_slots(admitted[i : i + pb])
-                            dispatches += 1
+                        # The wave was budgeted against ONE headroom
+                        # snapshot but dispatches in prefill_batch splits;
+                        # pin its prefix-cache hit entries across ALL
+                        # splits so an earlier split's eviction cascade
+                        # can't demote a later split's budgeted hit and
+                        # overdraw the block-pool reservation
+                        wave_guard = getattr(
+                            self.engine, "protect_admission_wave", None
+                        )
+                        wave_keys = (
+                            wave_guard(admitted)
+                            if wave_guard is not None
+                            else None
+                        )
+                        try:
+                            for i in range(0, len(admitted), pb):
+                                prefill_slots(admitted[i : i + pb])
+                                st = getattr(
+                                    self.engine, "last_admission_stats", None
+                                )
+                                if st is not None:
+                                    have_stats = True
+                                    dispatches += st.get("dispatches", 1)
+                                    hit_slots.update(st.get("hit_slots", ()))
+                                    blocks_reused += st.get(
+                                        "prefix_blocks_reused", 0
+                                    )
+                                    suffix_tokens += st.get(
+                                        "suffix_tokens_computed", 0
+                                    )
+                                else:
+                                    dispatches += 1
+                        finally:
+                            if wave_keys:
+                                self.engine.unprotect_admission_wave(
+                                    wave_keys
+                                )
                     else:
                         for slot, spec in admitted:
                             self.engine.prefill_slot(slot, spec)
@@ -638,10 +727,25 @@ class ContinuousBatcher(MicroBatcher):
                         inflight[slot][0] for slot, _ in admitted
                     )
                     for req in wave_reqs:
+                        extra = {}
+                        if have_stats:
+                            req_slots = [
+                                s for s, _ in admitted
+                                if inflight[s][0] is req
+                            ]
+                            req.prefix_hit = all(
+                                s in hit_slots for s in req_slots
+                            )
+                            extra = dict(
+                                prefix_blocks_reused=blocks_reused,
+                                suffix_tokens_computed=suffix_tokens,
+                                prefix_hit=req.prefix_hit,
+                            )
                         req.trace.end(
                             req._stage_span,
                             wave_rows=len(admitted),
                             dispatches=dispatches,
+                            **extra,
                         )
                     self.stage_seconds.labels("prefill").observe(
                         prefill_s, exemplar=_first_trace_id(wave_reqs)

@@ -394,9 +394,7 @@ class ContinuousEngine(GenerationEngine):
         # admission never spans more slots than exist; 1 degrades to the
         # per-row admission of PR 2
         self.prefill_batch = max(1, min(int(prefill_batch), self.max_batch))
-        from dalle_pytorch_tpu.models.dalle import init_slot_state
-
-        self._state = init_slot_state(model, self.max_batch)
+        self._state = self._fresh_state()
         self._m_slots = self.registry.gauge(
             "dalle_serving_slots_active",
             "continuous-engine cache slots currently decoding",
@@ -424,18 +422,23 @@ class ContinuousEngine(GenerationEngine):
     # All device work is serialized under the inherited engine lock; the
     # continuous batcher's single worker thread is the only caller.
 
+    def _fresh_state(self):
+        """Clean empty slot state — the subclass hook the paged engine
+        overrides (rebuilding its host-side page tables alongside)."""
+        from dalle_pytorch_tpu.models.dalle import init_slot_state
+
+        return init_slot_state(self.model, self.max_batch)
+
     def _replace_state(self, op) -> None:
         """Run one state-transforming dispatch. The slot ops DONATE the
         state buffers (models/dalle.py), so on failure the old state is
         unusable — rebuild a clean empty one rather than bricking the
         engine (the batcher fails the in-flight requests either way).
         Caller holds the lock."""
-        from dalle_pytorch_tpu.models.dalle import init_slot_state
-
         try:
             self._state = op(self._state)
         except BaseException:
-            self._state = init_slot_state(self.model, self.max_batch)
+            self._state = self._fresh_state()
             raise
 
     def prefill_slots(  # tracelint: hotloop
@@ -479,17 +482,29 @@ class ContinuousEngine(GenerationEngine):
         (padded to the fixed prefill shape; no extra compiled program)."""
         self.prefill_slots([(slot, spec)], _warmup=_warmup)
 
+    def _pre_chunk(self) -> None:
+        """Subclass hook before the chunk dispatch (the paged engine tops
+        up decode pages here)."""
+
+    def _chunk_op(self, s):
+        from dalle_pytorch_tpu.models.dalle import decode_image_chunk
+
+        return decode_image_chunk(
+            self.model, self.variables, s, self.chunk_tokens
+        )
+
+    def _post_chunk(self, pos, act) -> None:
+        """Subclass hook after the host snapshot (the paged engine mirrors
+        positions and block gauges here)."""
+
     def step_chunk(self, _warmup: bool = False):  # tracelint: hotloop
         """Advance all live slots by `chunk_tokens`; returns the post-chunk
         (img_pos, active) host snapshot the batcher retires against."""
         import jax
 
-        from dalle_pytorch_tpu.models.dalle import decode_image_chunk
-
+        self._pre_chunk()
         with self._lock:
-            self._replace_state(lambda s: decode_image_chunk(
-                self.model, self.variables, s, self.chunk_tokens
-            ))
+            self._replace_state(self._chunk_op)
             if not _warmup:
                 self._m_chunks.inc()
                 self.chunk_index += 1
@@ -497,9 +512,11 @@ class ContinuousEngine(GenerationEngine):
             # the chunk boundary IS the designed sync point: retirement
             # decisions need the positions on the host, and fusing both
             # small arrays into one transfer keeps it to a single round trip
-            return jax.device_get(  # tracelint: disable=TL002 -- chunk-boundary snapshot is the one designed sync of the decode loop (single fused transfer)
+            pos, act = jax.device_get(  # tracelint: disable=TL002 -- chunk-boundary snapshot is the one designed sync of the decode loop (single fused transfer)
                 (self._state["img_pos"], self._state["active"])
             )
+        self._post_chunk(pos, act)
+        return pos, act
 
     def harvest(self, slots: Sequence[int]) -> np.ndarray:  # tracelint: hotloop
         """Finished slots' tokens [len(slots), image_seq_len] (host copy)."""
@@ -581,8 +598,6 @@ class ContinuousEngine(GenerationEngine):
         included — is load-bearing: tests/test_continuous.py pins with
         `assert_no_recompiles` that a post-warmup serve cycle compiles
         nothing."""
-        from dalle_pytorch_tpu.models.dalle import init_slot_state
-
         t0 = time.perf_counter()
         dummy = SampleSpec(
             np.zeros(self.model.text_seq_len, np.int32), seed=0
@@ -595,11 +610,455 @@ class ContinuousEngine(GenerationEngine):
             np.zeros((1, self.image_seq_len), np.int32)
         )
         with self._lock:
-            self._state = init_slot_state(self.model, self.max_batch)
+            # _fresh_state, not init_slot_state directly: subclasses
+            # rebuild host-side managers alongside the device state
+            self._state = self._fresh_state()
             self.stats.warmup_batches += 1
             self._compile_seconds.observe(time.perf_counter() - t0)
             self._warm.add(self.max_batch)
             self.stats.compiled_shapes = tuple(sorted(self._warm))
+
+
+class PagedContinuousEngine(ContinuousEngine):
+    """Continuous batching over a BLOCK-PAGED KV cache with prefix caching.
+
+    Same serving surface and decode semantics as `ContinuousEngine` (one
+    shared chunk-program body — `models/dalle.py:_make_chunk_fn` — keeps
+    paged output bit-for-bit identical to slotted, pinned by
+    tests/test_paging.py), but K/V lives in a pool of `kv_pages` pages of
+    `page_size` tokens with host-owned per-row page tables
+    (`serving/paging.py`):
+
+      * HBM follows tokens actually held, not `max_batch` worst-case
+        lanes — `kv_pages` can be sized below the slotted footprint and
+        concurrency is then bounded by real occupancy (admission reserves
+        a row's worst case so lazy per-chunk allocation never deadlocks;
+        the batcher keeps requests queued while `can_admit` is false).
+      * identical caption prefixes share immutable prefill pages
+        (content-hash chain lookup, refcounted, copy-on-write at the
+        divergence block), and a FULL-prompt hit admits with ZERO
+        transformer dispatches — the cached sidecar (pending logits +
+        shift rings) restores the row via one tiny fixed-shape program
+        (`admit_cached_prefix`), so repeat prompts cost near-zero TTFT.
+
+    Compiled-program set (all warmed, zero recompiles on a warm server):
+    paged batched prefill, sidecar slice, cached-prefix admit, paged
+    chunk, slot release, pixel decode. Page tables enter every dispatch as
+    traced host data, so no allocation decision ever compiles.
+    """
+
+    def __init__(
+        self,
+        model,
+        variables,
+        vae=None,
+        vae_params=None,
+        max_batch: int = 8,
+        chunk_tokens: int = 4,
+        prefill_batch: int = 4,
+        cond_scale: float = 1.0,
+        clip=None,
+        clip_params=None,
+        tokenizer=None,
+        registry=None,
+        cfg=None,
+        page_size: int = 32,
+        kv_pages: Optional[int] = None,
+        prefix_entries: int = 64,
+    ):
+        self.page_size = int(page_size)
+        assert self.page_size >= 1
+        max_positions = model.total_seq_len + 1
+        pages_per_row = -(-max_positions // self.page_size)
+        if kv_pages is None:
+            # worst case (every slot at full length, nothing shared) plus
+            # the garbage page and one row of prefix-cache headroom: the
+            # DEFAULT never admits worse than slotted; the HBM win comes
+            # from sizing kv_pages down and from prefix sharing
+            kv_pages = int(max_batch) * pages_per_row + 1 + pages_per_row
+        self.kv_pages = int(kv_pages)
+        self.prefix_entries = int(prefix_entries)
+        self._text_positions = model.text_seq_len + 1
+        super().__init__(
+            model=model,
+            variables=variables,
+            vae=vae,
+            vae_params=vae_params,
+            max_batch=max_batch,
+            chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch,
+            cond_scale=cond_scale,
+            clip=clip,
+            clip_params=clip_params,
+            tokenizer=tokenizer,
+            registry=registry,
+            cfg=cfg,
+        )
+        assert self.kv.can_ever_admit(1), (
+            f"kv_pages={self.kv_pages} cannot hold a single row "
+            f"({self.kv.pages_per_row} pages + the garbage page)"
+        )
+        self._m_blocks_active = self.registry.gauge(
+            "dalle_serving_blocks_active",
+            "KV pages currently allocated (tokens actually held, incl. "
+            "prefix-cache snapshots)",
+        )
+        self._m_blocks_free = self.registry.gauge(
+            "dalle_serving_blocks_free", "KV pages free in the pool"
+        )
+        self._m_prefix_hits = self.registry.counter(
+            "dalle_serving_prefix_cache_hits_total",
+            "admissions served from the prefix cache with zero prefill "
+            "dispatches",
+        )
+        self._m_prefix_misses = self.registry.counter(
+            "dalle_serving_prefix_cache_misses_total",
+            "admissions that ran a prefill dispatch",
+        )
+        self._m_prefix_evictions = self.registry.counter(
+            "dalle_serving_prefix_cache_evictions_total",
+            "prefix-cache entries evicted (LRU)",
+        )
+        #: per-wave admission stats the batcher reads for span metadata /
+        #: per-request prefix_hit flags ({"prefix_hits", "hit_slots",
+        #: "prefix_blocks_reused", "suffix_tokens_computed", "dispatches"})
+        self.last_admission_stats: Optional[dict] = None
+        self._update_block_gauges()
+
+    # ------------------------------------------------------- host plumbing
+
+    def _fresh_state(self):
+        """Paged device state + rebuilt host managers, together: after a
+        failed donated dispatch the pages buffer is gone, so every page
+        table, refcount, and cached prefix referring into it is garbage
+        too."""
+        from dalle_pytorch_tpu.models.dalle import init_paged_slot_state
+        from dalle_pytorch_tpu.serving.paging import PagedKVManager
+
+        self.kv = PagedKVManager(
+            n_rows=self.max_batch,
+            page_size=self.page_size,
+            max_positions=self.model.total_seq_len + 1,
+            text_positions=self._text_positions,
+            n_pages=self.kv_pages,
+            max_entries=self.prefix_entries,
+            on_evict=lambda: self._m_prefix_evictions.inc(),
+        )
+        self._host_pos = np.zeros(self.max_batch, np.int64)
+        self._host_active = np.zeros(self.max_batch, bool)
+        return init_paged_slot_state(
+            self.model, self.max_batch, self.kv_pages, self.page_size
+        )
+
+    def _update_block_gauges(self) -> None:
+        self._m_blocks_active.set(self.kv.blocks_active)
+        self._m_blocks_free.set(self.kv.blocks_free)
+
+    def can_admit(self, specs: Sequence[SampleSpec]) -> bool:
+        """Free + evictable pages cover this request's worst case on top
+        of live rows' reservations (the batcher keeps it queued
+        otherwise — block exhaustion is backpressure, not corruption)."""
+        return self.kv.can_admit(
+            [np.asarray(s.text_ids, np.int32) for s in specs]
+        )
+
+    def admission_headroom(self) -> int:
+        """Pages available for new admissions — the batcher snapshots
+        this once per wave and debits `admission_demand` per popped head
+        (same verdict as a union `can_admit`, without re-deriving earlier
+        heads' demand on every pop)."""
+        return self.kv.admission_headroom()
+
+    def admission_demand(self, specs: Sequence[SampleSpec]) -> int:
+        """Worst-case page demand of one request's rows."""
+        return sum(
+            self.kv.row_demand(np.asarray(s.text_ids, np.int32))
+            for s in specs
+        )
+
+    def can_ever_admit(self, specs: Sequence[SampleSpec]) -> bool:
+        """False when the request could not fit an EMPTY pool — submit
+        should reject it outright rather than queue it forever."""
+        return self.kv.can_ever_admit(len(specs))
+
+    def kv_detail(self) -> dict:
+        """Block-pool + prefix-cache snapshot for /healthz."""
+        cache = self.kv.cache
+        return {
+            "layout": "paged",
+            "page_size": self.page_size,
+            "pages_per_row": self.kv.pages_per_row,
+            "blocks_total": self.kv.pool.n_pages - 1,
+            "blocks_active": self.kv.blocks_active,
+            "blocks_free": self.kv.blocks_free,
+            "prefix_cache": {
+                "entries": len(cache),
+                "hits": cache.hits,
+                "misses": cache.misses,
+                "evictions": cache.evictions,
+            },
+        }
+
+    # ------------------------------------------------------------ slot ops
+
+    def protect_admission_wave(self, assignments) -> set:
+        """Pin every full-prompt hit entry of one budgeted admission wave
+        against eviction until `unprotect_admission_wave`. The batcher
+        budgets the WHOLE wave against one headroom snapshot but
+        dispatches it in `prefill_batch`-sized `prefill_slots` splits; an
+        earlier split's allocation cascade evicting an entry a later
+        split's request was budgeted against (at `pages_per_row - saved`)
+        would demote that hit to a full prefill and overdraw the
+        reservation by `saved` pages. Returns the keys actually added
+        (pass them back verbatim)."""
+        if not self.kv.cache.enabled:
+            return set()
+        keys = []
+        for _slot, spec in assignments:
+            entry = self.kv.cache.peek_full(
+                np.asarray(spec.text_ids, np.int32)
+            )
+            if entry is not None:
+                keys.append(entry.key)
+        return self.kv.cache.protect(keys)
+
+    def unprotect_admission_wave(self, keys) -> None:
+        self.kv.cache.unprotect(keys)
+
+    def prefill_slots(  # tracelint: hotloop
+        self,
+        assignments: Sequence[Tuple[int, SampleSpec]],
+        _warmup: bool = False,
+    ) -> None:
+        """Paged admission wave: full-prompt prefix hits admit via the
+        cached sidecar (zero prefill dispatches); the rest run ONE batched
+        paged prefill, mapping any cached prefix blocks into their page
+        tables instead of allocating (the dispatch rewrites shared pages
+        with bit-identical content — prefill K/V is batch-composition
+        invariant) and registering fresh prompts into the cache."""
+        n = len(assignments)
+        assert 1 <= n <= self.prefill_batch, (
+            f"{n} assignments exceed prefill_batch={self.prefill_batch}; "
+            "the batcher must split admission waves"
+        )
+        stats = {
+            "wave_rows": n,
+            "prefix_hits": 0,
+            "hit_slots": [],
+            "prefix_blocks_reused": 0,
+            "suffix_tokens_computed": 0,
+            "dispatches": 0,
+        }
+        hits, misses = [], []
+        for slot, spec in assignments:
+            entry = (
+                self.kv.cache.lookup_full(np.asarray(spec.text_ids, np.int32))
+                if self.kv.cache.enabled
+                else None
+            )
+            if entry is not None:
+                hits.append((slot, spec, entry))
+            else:
+                misses.append((slot, spec))
+
+        # Hit entries are PROTECTED for the rest of the wave: the batcher
+        # budgeted each hit at `pages_per_row - saved`, so another row's
+        # allocation cascade evicting the entry mid-wave would demote the
+        # hit to a full prefill that consumes `saved` more pages than
+        # were charged — the reservation invariant would be short by
+        # exactly that, and a later `ensure` would hit the allocator's
+        # exhaustion assert mid-decode. A batcher wave larger than
+        # `prefill_batch` arrives as several `prefill_slots` calls but was
+        # budgeted as ONE wave, so the batcher pins the whole wave's hit
+        # entries via `protect_admission_wave` around the splits; this
+        # per-split pin (unprotecting only what IT added) covers direct
+        # callers. Hits also run BEFORE the miss batch so no dispatch
+        # ever reads a page its entry no longer owns; the revalidation
+        # below is a backstop for unbudgeted callers racing the
+        # protection (it cannot fire for waves admitted through
+        # can_admit/admission_headroom and wave-protected end to end).
+        added = self.kv.cache.protect(entry.key for _, _, entry in hits)
+        try:
+            self._admit_wave(hits, misses, stats, _warmup)
+        finally:
+            self.kv.cache.unprotect(added)
+
+        self.last_admission_stats = stats
+        self._update_block_gauges()
+
+    def _admit_wave(self, hits, misses, stats, _warmup) -> None:
+        from dalle_pytorch_tpu.models.dalle import (
+            admit_cached_prefix,
+            prefill_into_slots_paged,
+            slice_prefix_sidecar,
+        )
+
+        for slot, spec, entry in hits:
+            ids = np.asarray(spec.text_ids, np.int32)
+            if self.kv.cache.lookup_full(ids) is not entry:
+                misses.append((slot, spec))  # evicted mid-wave: full prefill
+                continue
+            partial_src, pdst = self.kv.admit_hit(slot, entry)
+            with self._lock:
+                self._replace_state(
+                    lambda s, slot=slot, spec=spec, entry=entry,
+                    partial_src=partial_src, pdst=pdst: admit_cached_prefix(
+                        self.model, s, slot, entry.sidecar,
+                        int(spec.seed) & 0x7FFFFFFF, spec.temperature,
+                        self._keep_k(spec.top_k), partial_src, pdst,
+                        self.page_size,
+                    )
+                )
+                if not _warmup:
+                    self._m_prefix_hits.inc()
+            self._host_pos[slot] = 0
+            self._host_active[slot] = True
+            if not _warmup:
+                self.kv.cache.hits += 1
+            stats["prefix_hits"] += 1
+            stats["hit_slots"].append(slot)
+            stats["prefix_blocks_reused"] += self.kv.n_full_blocks
+
+        if misses:
+            rows = list(misses) + [misses[0]] * (self.prefill_batch - len(misses))
+            texts, slots, seeds, temps, keep = _pack_prefill_rows(
+                rows, self._keep_k
+            )
+            assert texts.shape == (
+                self.prefill_batch, self.model.text_seq_len,
+            ), f"prompt rows must be [{self.model.text_seq_len}] token ids"
+            page_rows = np.zeros(
+                (self.prefill_batch, self.kv.n_text_pages), np.int32
+            )
+            partial_dst = np.zeros(self.prefill_batch, np.int32)
+            pending = []  # (prefill row index, registration token)
+            reg_seen = set()  # same prompt twice in ONE wave registers once
+            # wave-local {chain hash: page}: rows admitted later in this
+            # wave map earlier rows' pages for identical leading blocks
+            # instead of allocating twins (which the registration index
+            # could not content-address)
+            wave_blocks: dict = {}
+            for i, (slot, spec) in enumerate(misses):
+                ids = np.asarray(spec.text_ids, np.int32)
+                ids_key = ids.tobytes()
+                row_pages, pdst, shared_n, token = self.kv.admit_miss(
+                    slot, ids, register=ids_key not in reg_seen,
+                    pending_blocks=wave_blocks,
+                )
+                reg_seen.add(ids_key)
+                page_rows[i] = row_pages
+                partial_dst[i] = pdst
+                if token is not None:
+                    pending.append((i, token))
+                stats["prefix_blocks_reused"] += shared_n
+                stats["suffix_tokens_computed"] += (
+                    self._text_positions - shared_n * self.page_size
+                )
+            # padding rows rewrite row 0's pages with identical content;
+            # their snapshot write goes to the garbage page
+            for i in range(len(misses), self.prefill_batch):
+                page_rows[i] = page_rows[0]
+
+            sidecars = {}
+
+            def op(s):
+                new_s, sidecar = prefill_into_slots_paged(
+                    self.model, self.variables, s, texts, slots, seeds,
+                    temps, keep, page_rows, partial_dst, self.page_size,
+                )
+                sidecars["wave"] = sidecar
+                return new_s
+
+            with self._lock:
+                # on failure _replace_state rebuilds state AND (via
+                # _fresh_state) the kv manager, so the half-done host
+                # mappings above are discarded wholesale
+                self._replace_state(op)
+                if not _warmup:
+                    self._m_prefills.inc(len(misses))
+                    self._m_prefill_dispatches.inc()
+                    self._m_prefix_misses.inc(len(misses))
+            for i, token in pending:
+                self.kv.finish_register(
+                    token,
+                    slice_prefix_sidecar(self.model, sidecars["wave"], i),
+                )
+            for slot, _spec in misses:
+                self._host_pos[slot] = 0
+                self._host_active[slot] = True
+            if not _warmup:
+                self.kv.cache.misses += len(misses)
+            stats["dispatches"] += 1
+
+    def _pre_chunk(self) -> None:
+        # lazy decode-page allocation: the table must cover every live
+        # row's writes for this chunk before the dispatch reads it
+        # (reserved at admission, so this cannot fail mid-decode)
+        for slot in range(self.max_batch):
+            if self._host_active[slot]:
+                end = min(
+                    self._text_positions
+                    + int(self._host_pos[slot])
+                    + self.chunk_tokens,
+                    self.kv.max_positions,
+                )
+                self.kv.ensure(slot, -(-end // self.page_size))
+
+    def _chunk_op(self, s):
+        from dalle_pytorch_tpu.models.dalle import decode_image_chunk_paged
+
+        return decode_image_chunk_paged(
+            self.model, self.variables, s, self.chunk_tokens, self.kv.table
+        )
+
+    def _post_chunk(self, pos, act) -> None:
+        self._host_pos[: len(pos)] = pos
+        self._update_block_gauges()
+
+    def release(self, slots: Sequence[int]) -> None:  # tracelint: hotloop
+        super().release(slots)
+        for s in slots:
+            s = int(s)
+            if self._host_active[s]:
+                self.kv.release(s)
+                self._host_active[s] = False
+                self._host_pos[s] = 0
+        self._update_block_gauges()
+
+    # ------------------------------------------------------------- warmup
+
+    def warmup(self, shapes: Optional[Sequence[int]] = None) -> None:
+        """Compile the paged program set: batched prefill (+ the sidecar
+        slice its registration runs), the cached-prefix admit, chunk,
+        release, pixel decode — then reset device AND host paging state.
+        The second dummy wave is a deliberate full-prefix hit so the admit
+        program is warm before the first real repeat prompt."""
+        t0 = time.perf_counter()
+        dummy = SampleSpec(
+            np.zeros(self.model.text_seq_len, np.int32), seed=0
+        )
+        self._compile_miss.inc()
+        self.prefill_slots([(0, dummy)], _warmup=True)
+        if self.kv.cache.enabled:
+            # the hit-admit program warms in slot 1 when there is one; a
+            # 1-slot engine recycles slot 0 (released first — a live slot
+            # can't be mapped twice)
+            hit_slot = 1 if self.max_batch > 1 else 0
+            if hit_slot == 0:
+                self.release([0])
+            self.prefill_slots([(hit_slot, dummy)], _warmup=True)  # prefix hit
+        self.step_chunk(_warmup=True)
+        self.release([s for s in (0, 1) if s < self.max_batch])
+        self.decode_pixels(
+            np.zeros((1, self.image_seq_len), np.int32)
+        )
+        with self._lock:
+            self._state = self._fresh_state()
+            self.stats.warmup_batches += 1
+            self._compile_seconds.observe(time.perf_counter() - t0)
+            self._warm.add(self.max_batch)
+            self.stats.compiled_shapes = tuple(sorted(self._warm))
+        self._update_block_gauges()
 
 
 def engine_from_checkpoint(
@@ -611,12 +1070,20 @@ def engine_from_checkpoint(
     mode: str = "micro",
     chunk_tokens: int = 4,
     prefill_batch: int = 4,
+    kv_layout: str = "slot",
+    page_size: int = 32,
+    kv_pages: Optional[int] = None,
+    prefix_entries: int = 64,
 ):
     """Build a serving engine from a single-file DALLE checkpoint.
 
     `mode="micro"` (default) returns the padded-micro-batch
     `GenerationEngine`; `mode="continuous"` returns a `ContinuousEngine`
-    whose slot count is the largest entry of `batch_shapes`. The loading
+    whose slot count is the largest entry of `batch_shapes` —
+    `kv_layout="paged"` upgrades it to the block-paged
+    `PagedContinuousEngine` (`page_size` tokens per page, `kv_pages` pool
+    size or None for the slotted-equivalent worst case, `prefix_entries`
+    cached prompts). The loading
     sequence (VAE reconstruction, tokenizer, ring-attention downgrade for
     decode) was lifted from `generate.py`, which now calls this instead —
     CLI and server share one code path by construction.
@@ -675,10 +1142,22 @@ def engine_from_checkpoint(
         cfg=cfg,
     )
     if mode == "continuous":
-        return ContinuousEngine(
+        assert kv_layout in ("slot", "paged"), f"unknown kv_layout {kv_layout!r}"
+        cls = PagedContinuousEngine if kv_layout == "paged" else ContinuousEngine
+        paged_kw = (
+            dict(
+                page_size=page_size,
+                kv_pages=kv_pages,
+                prefix_entries=prefix_entries,
+            )
+            if kv_layout == "paged"
+            else {}
+        )
+        return cls(
             max_batch=max(int(b) for b in batch_shapes),
             chunk_tokens=chunk_tokens,
             prefill_batch=prefill_batch,
+            **paged_kw,
             **common,
         )
     return GenerationEngine(batch_shapes=batch_shapes, **common)

@@ -351,7 +351,10 @@ class _Handler(BaseHTTPRequestHandler):
         owner.batcher.stage_seconds.labels("respond").observe(
             time.monotonic() - tr0, exemplar=trace.trace_id or None
         )
-        closed_out("ok", 200)
+        # paged engine: whether this request admitted via the prefix cache
+        # — the request-log field that explains cheap vs full prefills
+        extra = {} if req.prefix_hit is None else {"prefix_hit": req.prefix_hit}
+        closed_out("ok", 200, **extra)
         self._reply(200, payload)
 
 
@@ -489,6 +492,11 @@ class ServingServer:
             detail["engine"] = "continuous"
             detail["slots_active"] = self.batcher.allocator.n_active
             detail["chunk_tokens"] = self.engine.chunk_tokens
+            kv_detail = getattr(self.engine, "kv_detail", None)
+            if kv_detail is not None:
+                # paged engine: block-pool occupancy + prefix-cache size,
+                # the new resource axis a capacity dashboard needs
+                detail["kv"] = kv_detail()
         if err is not None:
             detail["last_error"] = repr(err)
             if err_age is not None:

@@ -49,6 +49,25 @@ def parse_args(argv=None):
                    "dispatch (R pending requests cost ceil(R/prefill_batch) "
                    "dispatches at a chunk boundary; clamped to the slot "
                    "count)")
+    p.add_argument(
+        "--kv_layout", choices=("slot", "paged"), default="slot",
+        help="continuous engine cache layout. slot: one full-length KV "
+        "lane per slot (HBM = max_batch worst case); paged: block-paged "
+        "pool + per-row page tables with content-hash prefix caching "
+        "(HBM follows tokens actually held; repeat prompts admit with "
+        "zero prefill dispatches)",
+    )
+    p.add_argument("--page_size", type=int, default=32,
+                   help="paged layout: tokens per KV page (TPU wants a "
+                   "multiple of 8 for the paged Pallas kernel)")
+    p.add_argument("--kv_pages", type=int, default=None,
+                   help="paged layout: physical pages in the pool "
+                   "(default sizes the slotted worst case + one row of "
+                   "prefix-cache headroom; size it DOWN to cap HBM — "
+                   "admission then backpressures on free pages)")
+    p.add_argument("--prefix_entries", type=int, default=64,
+                   help="paged layout: prompts kept in the prefix cache "
+                   "(0 disables prefix caching; LRU eviction)")
     p.add_argument("--max_queue", type=int, default=64,
                    help="queue bound in rows; beyond it requests get 503")
     p.add_argument("--request_timeout_s", type=float, default=120.0)
@@ -104,6 +123,10 @@ def main(argv=None):
         mode=args.engine,
         chunk_tokens=args.chunk_tokens,
         prefill_batch=args.prefill_batch,
+        kv_layout=args.kv_layout,
+        page_size=args.page_size,
+        kv_pages=args.kv_pages,
+        prefix_entries=args.prefix_entries,
     )
     if not args.no_warmup:
         log.event("warmup_start", batch_shapes=list(engine.batch_shapes))

@@ -1,0 +1,46 @@
+"""TL001 cross-procedural negative: helpers that must NOT inherit
+tracedness — a host call site exists, only static values flow in, or the
+helper sits two hops from the jit (outside the one-hop frontier)."""
+
+import jax
+
+
+def _helper(x):
+    if x > 0:  # also called from host code below: no inheritance
+        return x
+    return -x
+
+
+@jax.jit
+def entry(x):
+    return _helper(x)
+
+
+def host_path(v):
+    return _helper(v)  # the host call site that disables inheritance
+
+
+def _static_impl(x, n):
+    if n > 2:  # n only receives shape facts — static under tracing
+        return x[:n]
+    return x
+
+
+@jax.jit
+def entry2(x):
+    return _static_impl(x, x.shape[0])
+
+
+def _two_hops(x):
+    if x > 0:  # only reachable THROUGH an inherited helper: out of range
+        return x
+    return -x
+
+
+def _one_hop(x):
+    return _two_hops(x)
+
+
+@jax.jit
+def entry3(x):
+    return _one_hop(x)

@@ -1,0 +1,39 @@
+"""TL001 cross-procedural positive: `_*_impl` bodies whose only call
+sites are jitted functions inherit tracedness (one hop). Never executed —
+tracelint parses it; pytest ignores non-test_ files."""
+
+import jax
+
+
+def _branch_impl(x):
+    if x > 0:  # x is traced at the only (jitted) call site
+        return x
+    return -x
+
+
+@jax.jit
+def entry(x):
+    return _branch_impl(x)
+
+
+def _mixed_impl(y, n):
+    if n > 2:  # n only ever receives a host constant: static, fine
+        y = y * n
+    if y.sum() > 0:  # y receives `x + 1` — traced
+        return y
+    return -y
+
+
+@jax.jit
+def entry2(x):
+    return _mixed_impl(x + 1, 4)
+
+
+class Stepper:
+    def _step_impl(self, state):
+        assert state.sum() > 0  # traced via the method call below
+        return state * 2
+
+    @jax.jit
+    def step(self, state):
+        return self._step_impl(state)

@@ -1,0 +1,28 @@
+"""TL002 cross-procedural negative: helpers with a host call site, or
+fed only static values, stay host functions — their numpy work is not a
+sync hazard."""
+
+import jax
+import numpy as np
+
+
+def _save_impl(x):
+    return np.asarray(x)  # legitimately host: called from save() below
+
+
+@jax.jit
+def entry(x):
+    return x * 2
+
+
+def save(x):
+    return _save_impl(x)
+
+
+def _table_impl(n):
+    return np.asarray(range(n))  # n only receives static shape facts
+
+
+@jax.jit
+def entry2(x):
+    return x[: len(_table_impl(x.shape[0]))]

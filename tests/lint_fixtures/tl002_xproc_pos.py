@@ -1,0 +1,20 @@
+"""TL002 cross-procedural positive: host syncs inside `_*_impl` bodies
+called only from jitted code — the sync fires on every traced call even
+though the helper itself carries no jit decorator."""
+
+import jax
+import numpy as np
+
+
+def _pull_impl(x):
+    v = np.asarray(x)  # host pull under inherited tracing
+    return x + v.mean()
+
+
+def _item_impl(x):
+    return x.item()  # forces a sync under inherited tracing
+
+
+@jax.jit
+def entry(x):
+    return _pull_impl(x) + _item_impl(x)

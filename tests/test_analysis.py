@@ -39,7 +39,9 @@ class TestRuleCorpus:
         "fixture, code, expected",
         [
             ("tl001_pos.py", "TL001", 5),
+            ("tl001_xproc_pos.py", "TL001", 3),
             ("tl002_pos.py", "TL002", 7),
+            ("tl002_xproc_pos.py", "TL002", 2),
             ("tl003_pos.py", "TL003", 3),
             ("tl004_pos.py", "TL004", 3),
             ("models/tl005_pos.py", "TL005", 3),
@@ -61,7 +63,9 @@ class TestRuleCorpus:
         "fixture",
         [
             "tl001_neg.py",
+            "tl001_xproc_neg.py",
             "tl002_neg.py",
+            "tl002_xproc_neg.py",
             "tl003_neg.py",
             "tl004_neg.py",
             "models/tl005_neg.py",

@@ -8,6 +8,7 @@ generation reranker (`dalle_pytorch.py:569-571`).
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from dalle_pytorch_tpu.models.clip import CLIP, clip_scores, rerank
 
@@ -45,6 +46,8 @@ class TestCLIP:
         assert scores.shape == (3,)
         assert np.all(np.isfinite(np.asarray(scores)))
 
+    @pytest.mark.slow  # ~26 s: the CLIP grad compile (tier-1 budget);
+    # forward coverage stays fast via test_scores_shape_and_finite
     def test_loss_scalar_and_grad(self):
         clip = tiny_clip()
         variables, text, image = init_clip(clip)

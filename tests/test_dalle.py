@@ -349,6 +349,9 @@ class TestFusedCE:
         )
         return DALLE(fused_ce=False, **kw), DALLE(fused_ce=True, **kw)
 
+    @pytest.mark.slow  # ~22 s/param: dense + fused grads compile two big
+    # programs (tier-1 budget); TestFusedCEMultiStep keeps fused-CE
+    # training covered in the fast tier
     @pytest.mark.parametrize("share_emb", [False, True])
     def test_loss_and_grad_parity(self, share_emb):
         dense, fused = self._pair(share_emb)
@@ -375,6 +378,8 @@ class TestFusedCE:
         g_fused = jax.grad(loss_of(fused))(params)
         self._assert_grad_parity(g_dense, g_fused)
 
+    @pytest.mark.slow  # ~17 s/param: same two-program compile as above
+    # for the inverse path (tier-1 budget)
     @pytest.mark.parametrize("share_emb", [False, True])
     def test_fused_inverse_parity(self, share_emb):
         """The fused inverse path (vocab-chunked CE + [B,3,V] dense

@@ -127,6 +127,8 @@ def test_pipelines_real_transformer_trunk(rotary, attn_types):
     )
 
 
+@pytest.mark.slow  # ~21 s: remat + bf16 variants re-compile the pipelined
+# trunk twice (tier-1 budget)
 def test_trunk_remat_and_bf16():
     """Deployment settings: (a) reversible=True + remat policy — the
     pipelined trunk wraps layers in jax.checkpoint, values and grads
@@ -254,6 +256,9 @@ def test_pipelines_unrolled_checkpoint_via_converter():
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
+@pytest.mark.slow  # ~32 s: full DALLE loss + grads through the pipelined
+# trunk (tier-1 budget); test_pipelines_real_transformer_trunk keeps the
+# fast-tier pipeline-parity signal
 def test_dalle_loss_with_pipelined_trunk():
     """End-to-end DALLE training loss with the trunk run pipeline-
     parallel (trunk_fn override): loss AND grads match the plain

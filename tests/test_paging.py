@@ -1,0 +1,778 @@
+"""Paged KV cache + prefix caching: allocator/page-table units, the
+paged-vs-slotted parity contract, prefix-hit admissions, and batcher
+backpressure on block exhaustion.
+
+The load-bearing contract extends PR 2's decode-composition invariance
+across CACHE LAYOUTS: a request's tokens are bit-identical whether its
+K/V lives in a per-slot lane (`ContinuousEngine`) or in pool pages behind
+a page table (`PagedContinuousEngine`), because the paged read path
+gathers each row's logical view and runs the IDENTICAL dense/flash
+kernels (models/attention.py), and both layouts share one chunk-program
+body (models/dalle.py:_make_chunk_fn). Prefix-cache hits must also be
+invisible in the tokens: an admission served from cached prefill pages +
+sidecar decodes the same stream a cold prefill would.
+
+Host-side allocator logic (BlockPool / PrefixCache / PagedKVManager) is
+plain numpy — those tests cost microseconds. Device tests share one
+module-scoped toy model and engine pair to stay fast-tier-cheap.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.models.dalle import DALLE
+from dalle_pytorch_tpu.serving.batcher import ContinuousBatcher, QueueFullError
+from dalle_pytorch_tpu.serving.engine import (
+    ContinuousEngine,
+    PagedContinuousEngine,
+    SampleSpec,
+)
+from dalle_pytorch_tpu.serving.paging import (
+    GARBAGE_PAGE,
+    BlockPool,
+    PagedKVManager,
+    chain_hashes,
+)
+from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+TEXT_SEQ = 8
+FMAP = 4
+IMG_SEQ = FMAP * FMAP
+PAGE = 4
+
+
+def spec(seed, head=(5, 6, 7), temperature=1.0, top_k=0.9):
+    ids = np.zeros(TEXT_SEQ, np.int32)
+    ids[: len(head)] = head
+    return SampleSpec(ids, seed=seed, temperature=temperature, top_k=top_k)
+
+
+def _drain(eng, max_chunks=32):
+    for _ in range(max_chunks):
+        pos, act = eng.step_chunk()
+        if (pos[act] >= eng.image_seq_len).all():
+            return pos, act
+    raise AssertionError("decode never finished")
+
+
+# ------------------------------------------------------------- block pool
+
+
+class TestBlockPool:
+    def test_exhaustion_returns_none(self):
+        p = BlockPool(4)  # garbage + 3 usable
+        assert [p.alloc() for _ in range(3)] == [1, 2, 3]
+        assert p.alloc() is None  # exhausted -> caller backpressures
+        assert p.n_free == 0 and p.n_allocated == 3
+
+    def test_free_then_realloc_reuses_lowest(self):
+        p = BlockPool(5)
+        pages = [p.alloc() for _ in range(4)]
+        p.release(pages[0])
+        p.release(pages[2])
+        assert p.alloc() == pages[0]  # deterministic lowest-first
+        assert p.alloc() == pages[2]
+
+    def test_refcount_share_release(self):
+        p = BlockPool(3)
+        pg = p.alloc()
+        p.share(pg)
+        p.share(pg)
+        assert p.refcount(pg) == 3
+        p.release(pg)
+        p.release(pg)
+        assert p.n_free == 1  # still held by one reference
+        p.release(pg)
+        assert p.n_free == 2 and p.refcount(pg) == 0
+
+    def test_garbage_page_never_allocated(self):
+        p = BlockPool(3)
+        assert GARBAGE_PAGE not in {p.alloc(), p.alloc()}
+        with pytest.raises(AssertionError):
+            p.release(GARBAGE_PAGE)
+
+    def test_double_free_asserts(self):
+        p = BlockPool(3)
+        pg = p.alloc()
+        p.release(pg)
+        with pytest.raises(AssertionError):
+            p.release(pg)
+
+    def test_peak_watermark(self):
+        p = BlockPool(6)
+        a, b = p.alloc(), p.alloc()
+        p.release(a)
+        p.release(b)
+        p.alloc()
+        assert p.peak_allocated == 2
+
+
+# ----------------------------------------------------------- chain hashes
+
+
+class TestChainHashes:
+    def test_prefix_property(self):
+        """Block j's hash is a function of ids through that block's last
+        K/V-relevant position only — shared prefixes produce equal hash
+        chains up to the divergence block."""
+        a = np.arange(1, 17, dtype=np.int32)
+        b = a.copy()
+        b[9:] += 100  # diverges inside block 2 (page 4: positions 8..11)
+        ha = chain_hashes(a, 4, 4)
+        hb = chain_hashes(b, 4, 4)
+        assert ha[:2] == hb[:2]
+        assert ha[2] != hb[2] and ha[3] != hb[3]
+
+    def test_bos_offset(self):
+        """Position 0 is <bos>: block 0 covers ids [:page_size-1], so two
+        prompts differing only at id page_size-1 share hash 0."""
+        a = np.arange(1, 17, dtype=np.int32)
+        b = a.copy()
+        b[3] = 99  # id 3 first matters to block 1 (position 4)
+        assert chain_hashes(a, 4, 4)[0] == chain_hashes(b, 4, 4)[0]
+        assert chain_hashes(a, 4, 4)[1] != chain_hashes(b, 4, 4)[1]
+
+
+# --------------------------------------------------- manager + prefix cache
+
+
+def _mk(n_pages=32, n_rows=2, max_entries=8):
+    # text 9 positions / page 4 -> 3 text pages (2 full + partial);
+    # 25 total positions -> 7 pages per row
+    return PagedKVManager(
+        n_rows=n_rows, page_size=4, max_positions=25, text_positions=9,
+        n_pages=n_pages, max_entries=max_entries,
+    )
+
+
+def _ids(*head):
+    ids = np.zeros(8, np.int32)
+    ids[: len(head)] = head
+    return ids
+
+
+class TestPagedKVManager:
+    def test_admit_miss_maps_and_reserves(self):
+        kv = _mk()
+        pages, pdst, shared, token = kv.admit_miss(0, _ids(1), register=True)
+        assert len(pages) == kv.n_text_pages == 3 and shared == 0
+        assert pdst != GARBAGE_PAGE  # snapshot page for the partial block
+        assert (kv.table[0, :3] == pages).all()
+        assert (kv.table[0, 3:] == GARBAGE_PAGE).all()
+        assert kv._debt[0] == kv.pages_per_row - 3
+        kv.finish_register(token, sidecar={"row": None})
+        assert len(kv.cache) == 1
+
+    def test_ensure_allocates_decode_pages(self):
+        kv = _mk()
+        kv.admit_miss(0, _ids(1), register=False)
+        free0 = kv.pool.n_free
+        kv.ensure(0, 5)
+        assert (kv.table[0, :5] != GARBAGE_PAGE).all()
+        assert kv.pool.n_free == free0 - 2
+        assert kv._debt[0] == kv.pages_per_row - 5
+
+    def test_release_returns_pages_and_garbage_fills(self):
+        kv = _mk()
+        kv.admit_miss(0, _ids(1), register=False)
+        kv.ensure(0, kv.pages_per_row)
+        free_before = kv.pool.n_free
+        kv.release(0)
+        assert (kv.table[0] == GARBAGE_PAGE).all()
+        assert kv.pool.n_free == free_before + kv.pages_per_row
+
+    def test_exhaustion_backpressure_then_recovers(self):
+        """can_admit goes False when free + reclaimable pages cannot cover
+        reserved debt + the new row's worst case — and comes back after a
+        release, the batcher's queue-and-wait contract."""
+        kv = _mk(n_pages=1 + 8, max_entries=0)  # 8 usable, 7 per row
+        assert kv.can_admit([_ids(1)])
+        kv.admit_miss(0, _ids(1), register=False)
+        assert not kv.can_admit([_ids(2)])  # 1 free + 0 reclaimable < 7
+        kv.release(0)
+        assert kv.can_admit([_ids(2)])
+
+    def test_same_wave_shared_block_registration(self):
+        """Wave-local `pending_blocks`: two DISTINCT prompts sharing
+        their leading full block admit onto ONE page and both register —
+        without the overlay their twin pages would content-address one
+        chain hash to two pages and trip `register`'s invariant."""
+        kv = _mk()
+        a, b = _ids(1, 2, 3), _ids(1, 2, 3, 9)
+        wave: dict = {}
+        pa, _, sa, ta = kv.admit_miss(0, a, register=True, pending_blocks=wave)
+        pb, _, sb, tb = kv.admit_miss(1, b, register=True, pending_blocks=wave)
+        assert sa == 0 and sb == 1  # b mapped a's leading page
+        assert pb[0] == pa[0] and pb[1] != pa[1]
+        assert kv.pool.refcount(pa[0]) >= 2  # both rows reference it
+        kv.finish_register(ta, sidecar=None)
+        kv.finish_register(tb, sidecar=None)  # same hash, same page: ok
+        assert len(kv.cache) == 2
+        kv.release(0)
+        kv.release(1)
+        assert kv.cache.evict_lru()  # drops the older entry (prompt a)
+        assert kv.cache.peek_full(a) is None
+        # both of b's blocks stay addressable through its own entry —
+        # including the page it shared with the evicted prompt a
+        assert kv.cache.shared_prefix_pages(b) == [pb[0], pb[1]]
+
+    def test_capacity_probe_does_not_bump_lru(self):
+        """`row_demand`/`can_admit` run on every worker wake for queued
+        requests — they must not refresh the probed prompt's recency, or
+        a queued-but-unadmittable prompt pins its cache entry while
+        entries for prompts actually being served get evicted."""
+        kv = _mk(max_entries=2)
+        for i, ids in enumerate((_ids(1), _ids(2))):
+            _, _, _, t = kv.admit_miss(i, ids, register=True)
+            kv.finish_register(t, sidecar=None)
+            kv.release(i)
+        for _ in range(3):  # a parked request's repeated capacity probes
+            kv.row_demand(_ids(1))
+            kv.can_admit([_ids(1)])
+        _, _, _, t = kv.admit_miss(0, _ids(3), register=True)
+        kv.finish_register(t, sidecar=None)  # evicts the TRUE LRU: 1
+        assert kv.cache.peek_full(_ids(1)) is None
+        assert kv.cache.peek_full(_ids(2)) is not None
+
+    def test_admission_headroom_matches_union_can_admit(self):
+        """The batcher's O(W) accounting — one headroom snapshot debited
+        by per-head `row_demand` — must reach the same verdict as the
+        union `can_admit` for every wave size."""
+        kv = _mk(n_pages=1 + 15, max_entries=0)  # 15 usable, 7 per row
+        waves = [
+            [_ids(1)],
+            [_ids(1), _ids(2)],
+            [_ids(1), _ids(2), _ids(3)],  # 21 > 15: must refuse
+        ]
+        for texts in waves:
+            incremental = kv.admission_headroom() >= sum(
+                kv.row_demand(t) for t in texts
+            )
+            assert incremental == kv.can_admit(texts)
+        assert not kv.can_admit(waves[2])
+
+    def test_can_ever_admit_bounds_request_size(self):
+        kv = _mk(n_pages=1 + 8)
+        assert kv.can_ever_admit(1)
+        assert not kv.can_ever_admit(2)  # 14 pages can never fit 8
+
+    def test_prefix_reuse_and_refcounts(self):
+        """A second admission of the same prompt maps the cached FULL
+        blocks (refcount++) instead of allocating; only the partial CoW
+        page and decode pages are new."""
+        kv = _mk()
+        pages, pdst, _, token = kv.admit_miss(0, _ids(1), register=True)
+        kv.finish_register(token, sidecar="s")
+        entry = kv.cache.lookup_full(_ids(1))
+        assert entry is not None and entry.sidecar == "s"
+        free0 = kv.pool.n_free
+        psrc, pdst2 = kv.admit_hit(1, entry)
+        assert psrc == entry.partial_page and pdst2 not in pages
+        assert kv.pool.n_free == free0 - 1  # ONLY the CoW page allocated
+        for pg in entry.full_pages:
+            assert kv.pool.refcount(pg) == 3  # row 0 + cache + row 1
+        # releasing both rows leaves the cache's own references intact
+        kv.release(0)
+        kv.release(1)
+        for pg in entry.full_pages:
+            assert kv.pool.refcount(pg) == 1
+
+    def test_shared_prefix_blocks_across_prompts(self):
+        """Two different prompts sharing the first FULL block splice the
+        cached page for it (chain-hash dedup), then allocate their own."""
+        kv = _mk()
+        a = np.arange(1, 9, dtype=np.int32)
+        b = a.copy()
+        b[6:] += 50  # diverge in the LAST block only
+        _, _, _, token = kv.admit_miss(0, a, register=True)
+        kv.finish_register(token, sidecar=None)
+        _, _, shared, _ = kv.admit_miss(1, b, register=True)
+        assert shared == 1  # block 0 mapped from cache, block 1 fresh
+        assert kv.table[1, 0] == kv.table[0, 0]
+        assert kv.table[1, 1] != kv.table[0, 1]
+
+    def test_lru_eviction_order(self):
+        kv = _mk(max_entries=2)
+        for i, ids in enumerate((_ids(1), _ids(2))):
+            _, _, _, t = kv.admit_miss(i, ids, register=True)
+            kv.finish_register(t, sidecar=None)
+            kv.release(i)
+        kv.cache.lookup_full(_ids(1))  # bump: 1 becomes most-recent
+        _, _, _, t = kv.admit_miss(0, _ids(3), register=True)
+        kv.finish_register(t, sidecar=None)  # evicts LRU = prompt 2
+        assert kv.cache.lookup_full(_ids(2)) is None
+        assert kv.cache.lookup_full(_ids(1)) is not None
+        assert kv.cache.evictions == 1
+
+    def test_eviction_reclaims_pages_for_admission(self):
+        """A full pool whose headroom is all cache-only pages still
+        admits: allocation evicts LRU entries on demand."""
+        kv = _mk(n_pages=1 + 9, max_entries=8)  # 9 usable, 7 per row
+        _, _, _, t = kv.admit_miss(0, _ids(1), register=True)
+        kv.finish_register(t, sidecar=None)
+        kv.release(0)  # cache retains 2 full + 1 partial page
+        assert kv.pool.n_allocated == 3
+        assert kv.can_admit([_ids(2)])  # 6 free + 3 reclaimable >= 7
+        kv.admit_miss(1, _ids(2), register=False)
+        kv.ensure(1, kv.pages_per_row)  # forces eviction of prompt 1
+        assert kv.cache.lookup_full(_ids(1)) is None
+        assert kv.cache.evictions == 1
+
+    def test_nested_protect_preserves_outer_pins(self):
+        """`protect` returns only NEWLY pinned keys: the batcher pins a
+        whole multi-split wave's hit entries, then each `prefill_slots`
+        split pins (and in its finally unpins) its own — the inner unpin
+        must not strip the outer wave guard, or an earlier split's
+        eviction cascade could demote a later split's budgeted hit."""
+        kv = _mk(max_entries=8)
+        for i, ids in enumerate((_ids(1), _ids(2))):
+            _, _, _, t = kv.admit_miss(i, ids, register=True)
+            kv.finish_register(t, sidecar=None)
+            kv.release(i)
+        e1 = kv.cache.peek_full(_ids(1))
+        outer = kv.cache.protect([e1.key])  # batcher's whole-wave pin
+        assert outer == {e1.key}
+        inner = kv.cache.protect([e1.key])  # split re-pins the same key
+        assert inner == set()
+        kv.cache.unprotect(inner)  # the split's finally
+        assert kv.cache.evict_lru()  # skips pinned 1, takes 2
+        assert kv.cache.peek_full(_ids(1)) is not None
+        assert kv.cache.peek_full(_ids(2)) is None
+        assert not kv.cache.evict_lru()  # only the pinned entry remains
+        kv.cache.unprotect(outer)
+        assert kv.cache.evict_lru()
+
+
+# ------------------------------------------------------ device toy engines
+
+
+@pytest.fixture(scope="module")
+def toy():
+    model = DALLE(
+        dim=32, depth=2, heads=2, dim_head=8,
+        num_image_tokens=32, image_fmap_size=FMAP,
+        num_text_tokens=64, text_seq_len=TEXT_SEQ,
+        shift_tokens=True, rotary_emb=True,
+    )
+    text = jnp.zeros((1, TEXT_SEQ), jnp.int32)
+    toks = jnp.zeros((1, IMG_SEQ), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(42), text, toks)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def slotted(toy):
+    model, params = toy
+    return ContinuousEngine(
+        model=model, variables=params, max_batch=4, chunk_tokens=4,
+        prefill_batch=2, registry=MetricsRegistry(),
+    )
+
+
+@pytest.fixture(scope="module")
+def paged(toy):
+    model, params = toy
+    return PagedContinuousEngine(
+        model=model, variables=params, max_batch=4, chunk_tokens=4,
+        prefill_batch=2, page_size=PAGE, registry=MetricsRegistry(),
+    )
+
+
+def _tokens(eng, n):
+    return jax.device_get(eng._state["img_tokens"])[:n]
+
+
+class TestPagedParity:
+    def test_same_wave_bit_for_bit(self, slotted, paged):
+        """One admission wave through both layouts: identical tokens."""
+        wave = [(0, spec(1)), (1, spec(2, (9, 9)))]
+        slotted.prefill_slots(wave)
+        _drain(slotted)
+        ref = _tokens(slotted, 2)
+        slotted.release([0, 1])
+        paged.prefill_slots(wave)
+        _drain(paged)
+        got = _tokens(paged, 2)
+        paged.release([0, 1])
+        assert (ref == got).all()
+
+    def test_same_wave_shared_leading_block(self, slotted, paged):
+        """Two DISTINCT prompts sharing their first full text block in
+        ONE admission wave — the prefix cache's headline workload
+        (shared template/system text) — must admit, register both, and
+        stay bit-for-bit with the slotted engine."""
+        wave = [(0, spec(31, (21, 22, 23))), (1, spec(32, (21, 22, 23, 31)))]
+        slotted.prefill_slots(wave)
+        _drain(slotted)
+        ref = _tokens(slotted, 2)
+        slotted.release([0, 1])
+        paged.prefill_slots(wave)
+        _drain(paged)
+        got = _tokens(paged, 2)
+        paged.release([0, 1])
+        assert (ref == got).all()
+        e1 = paged.kv.cache.peek_full(
+            np.asarray(wave[0][1].text_ids, np.int32)
+        )
+        e2 = paged.kv.cache.peek_full(
+            np.asarray(wave[1][1].text_ids, np.int32)
+        )
+        assert e1 is not None and e2 is not None
+        assert e1.full_pages[0] == e2.full_pages[0]  # one shared page
+        assert e1.full_pages[1] != e2.full_pages[1]  # divergent block
+
+    def test_staggered_admission_parity(self, slotted, paged):
+        """Mid-flight admission puts rows at DIFFERENT lengths (different
+        live page counts per row): every row still matches the slotted
+        engine's staggered decode bit-for-bit."""
+        a, b = spec(11, (3, 1)), spec(12, (8, 2, 6))
+        for eng in (slotted, paged):
+            eng.prefill_slots([(0, a)])
+            eng.step_chunk()  # row 0 advances 4 tokens alone
+            eng.prefill_slots([(1, b)])  # row 1 admitted mid-flight
+            _drain(eng)
+        ref = _tokens(slotted, 2)
+        got = _tokens(paged, 2)
+        slotted.release([0, 1])
+        paged.release([0, 1])
+        assert (ref == got).all()
+        # sanity: the two rows decode different streams (the parity is not
+        # vacuous equality of constants)
+        assert (ref[0] != ref[1]).any()
+
+
+class TestPrefixCache:
+    def test_hit_serves_identical_tokens(self, paged):
+        """A prefix-cache admission (zero prefill dispatches) decodes the
+        SAME tokens as the cold prefill of that (prompt, seed)."""
+        s = spec(77, (4, 2))
+        paged.prefill_slots([(0, s)])
+        assert paged.last_admission_stats["prefix_hits"] == 0
+        _drain(paged)
+        cold = _tokens(paged, 1)[0]
+        paged.release([0])
+        disp0 = paged.registry.get(
+            "dalle_serving_prefill_dispatches_total"
+        ).value
+        paged.prefill_slots([(2, s)])  # different slot, same prompt+seed
+        st = paged.last_admission_stats
+        assert st["prefix_hits"] == 1 and st["dispatches"] == 0
+        assert st["hit_slots"] == [2]
+        assert paged.registry.get(
+            "dalle_serving_prefill_dispatches_total"
+        ).value == disp0  # ZERO transformer dispatches for the admission
+        _drain(paged)
+        hit = jax.device_get(paged._state["img_tokens"])[2]
+        paged.release([2])
+        assert (cold == hit).all()
+
+    def test_snapshot_survives_hit_decode(self, paged):
+        """Copy-on-write at the divergence block: a hit's decode mutates
+        its PRIVATE copy, so a later hit of the same prompt still serves
+        identical tokens (a shared mutable page would corrupt here)."""
+        s = spec(33, (7, 7, 7))
+        paged.prefill_slots([(0, s)])
+        _drain(paged)
+        first = _tokens(paged, 1)[0].copy()
+        paged.release([0])
+        for _ in range(2):  # two consecutive hit-admissions
+            paged.prefill_slots([(1, s)])
+            assert paged.last_admission_stats["prefix_hits"] == 1
+            _drain(paged)
+            again = jax.device_get(paged._state["img_tokens"])[1]
+            paged.release([1])
+            assert (first == again).all()
+
+    def test_block_gauges_and_healthz_detail(self, paged):
+        det = paged.kv_detail()
+        assert det["layout"] == "paged" and det["page_size"] == PAGE
+        assert det["blocks_active"] == paged.kv.blocks_active
+        assert (
+            det["blocks_active"] + det["blocks_free"] == det["blocks_total"]
+        )
+        assert paged.registry.get(
+            "dalle_serving_blocks_active"
+        ).value == paged.kv.blocks_active
+        assert paged.registry.get(
+            "dalle_serving_blocks_free"
+        ).value == paged.kv.blocks_free
+        hits = paged.registry.get("dalle_serving_prefix_cache_hits_total")
+        assert hits.value == paged.kv.cache.hits > 0
+
+
+class TestWarmServer:
+    def test_full_cycle_zero_recompiles(self, paged):
+        """After warmup, a complete admit(miss)→chunk→mid-flight admit→
+        harvest→release→admit(hit) cycle compiles NOTHING."""
+        from dalle_pytorch_tpu.utils.compile_guard import assert_no_recompiles
+
+        paged.warmup()  # also resets device + paging state
+        with assert_no_recompiles():
+            paged.prefill_slots([(0, spec(1)), (1, spec(2, (9, 9)))])
+            paged.step_chunk()
+            paged.prefill_slots([(2, spec(3, (4, 4)))])
+            _drain(paged)
+            toks = paged.harvest([0, 1, 2])
+            paged.release([0, 1, 2])
+            paged.prefill_slots([(3, spec(9))])  # warm prefix hit
+            assert paged.last_admission_stats["prefix_hits"] == 1
+            _drain(paged)
+            paged.release([3])
+        assert toks.shape == (3, IMG_SEQ)
+
+
+# ------------------------------------------------- batcher block gating
+
+
+class FakePagedEngine:
+    """Block-pool surface double: the batcher's admission gate must hold
+    requests while `can_admit` is False and reject at submit when
+    `can_ever_admit` is False — without any device work."""
+
+    image_seq_len = 8
+    max_batch = 4
+    chunk = 4
+
+    def __init__(self, admit_ok=True, ever_ok=True):
+        self.registry = MetricsRegistry()
+        self.admit_ok = admit_ok
+        self.ever_ok = ever_ok
+        self.admit_checks = threading.Event()
+        self.pos = np.zeros(self.max_batch, np.int64)
+        self.active = np.zeros(self.max_batch, bool)
+        self.seeds = np.zeros(self.max_batch, np.int64)
+
+    def can_admit(self, specs):
+        self.admit_checks.set()
+        return self.admit_ok
+
+    def can_ever_admit(self, specs):
+        return self.ever_ok
+
+    def prefill_slot(self, slot, sp):
+        self.pos[slot] = 0
+        self.active[slot] = True
+        self.seeds[slot] = sp.seed
+
+    def step_chunk(self):
+        live = self.active & (self.pos < self.image_seq_len)
+        self.pos[live] += self.chunk
+        return self.pos.copy(), self.active.copy()
+
+    def harvest(self, slots):
+        return np.stack([
+            np.full(self.image_seq_len, self.seeds[s], np.int32)
+            for s in slots
+        ])
+
+    def release(self, slots):
+        for s in slots:
+            self.active[s] = False
+
+    def decode_pixels(self, tokens):
+        return None
+
+    def slots_active_gauge(self, n):
+        self.registry.gauge("dalle_serving_slots_active").set(n)
+
+
+class FakeIncrementalEngine(FakePagedEngine):
+    """Exposes the O(W) admission hooks (`admission_headroom` /
+    `admission_demand`) the real paged engine publishes, so the batcher
+    takes the incremental path instead of the union-`can_admit`
+    fallback."""
+
+    def __init__(self, budget=10, demand=7, **kw):
+        super().__init__(**kw)
+        self.budget = budget
+        self.demand = demand
+        self.live = 0
+        self.peak_live = 0
+
+    def admission_headroom(self):
+        return self.budget - self.live * self.demand
+
+    def admission_demand(self, specs):
+        return self.demand * len(specs)
+
+    def prefill_slot(self, slot, sp):
+        super().prefill_slot(slot, sp)
+        self.live += 1
+        self.peak_live = max(self.peak_live, self.live)
+
+    def release(self, slots):
+        super().release(slots)
+        self.live -= len(slots)
+
+
+class FakePrefixEngine(FakePagedEngine):
+    """Adds the paged admission-stats surface: batched `prefill_slots`
+    publishing `last_admission_stats`, alternating miss then hit."""
+
+    prefill_batch = 2
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.admissions = 0
+
+    def prefill_slots(self, assignments):
+        hit = self.admissions > 0  # first wave misses, later ones hit
+        self.admissions += 1
+        for slot, sp in assignments:
+            self.prefill_slot(slot, sp)
+        self.last_admission_stats = {
+            "wave_rows": len(assignments),
+            "prefix_hits": len(assignments) if hit else 0,
+            "hit_slots": [s for s, _ in assignments] if hit else [],
+            "prefix_blocks_reused": 2 * len(assignments) if hit else 0,
+            "suffix_tokens_computed": 0 if hit else 9 * len(assignments),
+            "dispatches": 0 if hit else 1,
+        }
+
+
+class FakeWaveGuardEngine(FakePrefixEngine):
+    """Splits every multi-row wave (prefill_batch=1) and checks each
+    split dispatch runs under a protection that covers the WHOLE wave."""
+
+    prefill_batch = 1
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.protected = None
+        self.guard_events = []
+        self.split_wave_sizes = []
+
+    def protect_admission_wave(self, assignments):
+        self.protected = {int(sp.seed) for _, sp in assignments}
+        self.guard_events.append(("protect", len(assignments)))
+        return set(self.protected)
+
+    def unprotect_admission_wave(self, keys):
+        self.guard_events.append(("unprotect", len(keys)))
+        self.protected = None
+
+    def prefill_slots(self, assignments):
+        assert self.protected is not None, "split dispatched unguarded"
+        self.split_wave_sizes.append(len(self.protected))
+        super().prefill_slots(assignments)
+
+
+class TestBatcherBlockGating:
+    def test_wave_guard_spans_all_splits(self):
+        """A wave budgeted once but dispatched in prefill_batch-sized
+        splits keeps its prefix-cache protection for EVERY split — the
+        guard is taken before split 1 and dropped only after the last."""
+        eng = FakeWaveGuardEngine()
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        r = b.submit([spec(1), spec(2)])  # one 2-row wave, 2 splits
+        r.future.result(timeout=10)
+        b.shutdown()
+        assert eng.split_wave_sizes == [2, 2]  # both splits saw the wave
+        assert eng.guard_events == [("protect", 2), ("unprotect", 2)]
+
+    def test_prefill_span_and_prefix_hit_flag(self):
+        """The obs contract: the prefill span carries the admission
+        stats (prefix_blocks_reused / suffix_tokens_computed) and each
+        request learns whether it admitted via the prefix cache."""
+        from dalle_pytorch_tpu.obs.tracing import Tracer
+
+        eng = FakePrefixEngine()
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        tracer = Tracer(enabled=True)
+        reqs = []
+        for i in range(2):
+            t = tracer.start_trace("request")
+            r = b.submit([spec(i)], trace=t)
+            r.future.result(timeout=10)
+            t.finish()
+            reqs.append((r, t))
+        b.shutdown()
+        assert reqs[0][0].prefix_hit is False
+        assert reqs[1][0].prefix_hit is True
+        for r, t in reqs:
+            (pf,) = [s for s in t.spans if s.name == "prefill"]
+            assert pf.args["prefix_hit"] is r.prefix_hit
+            if r.prefix_hit:
+                assert pf.args["prefix_blocks_reused"] == 2
+                assert pf.args["suffix_tokens_computed"] == 0
+                assert pf.args["dispatches"] == 0
+            else:
+                assert pf.args["suffix_tokens_computed"] == 9
+                assert pf.args["dispatches"] == 1
+
+    def test_block_exhaustion_queues_until_free(self):
+        """can_admit False parks the request (backpressure, not failure);
+        flipping it True lets the SAME worker admit it — no deadlock."""
+        eng = FakePagedEngine(admit_ok=False)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        r = b.submit([spec(5)])
+        assert eng.admit_checks.wait(10.0)  # worker saw it and held it
+        assert not r.future.done()
+        eng.admit_ok = True
+        with b._cond:  # poke the worker the way submit/release do
+            b._cond.notify_all()
+        toks, _ = r.future.result(timeout=10)
+        assert int(toks[0, 0]) == 5
+        b.shutdown()
+
+    def test_incremental_joint_overrun_not_coadmitted(self):
+        """Two requests that each fit alone must not be co-admitted when
+        they jointly overrun the block budget — through the incremental
+        headroom/demand hooks, not the union fallback. Both still finish
+        (the second waits for the first's release)."""
+        eng = FakeIncrementalEngine(budget=10, demand=7)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        with b._cond:  # hold the worker so both requests queue together
+            r1 = b.submit([spec(1)])
+            r2 = b.submit([spec(2)])
+        for r, want in ((r1, 1), (r2, 2)):
+            toks, _ = r.future.result(timeout=10)
+            assert int(toks[0, 0]) == want
+        assert eng.peak_live == 1  # never both live at once
+        b.shutdown()
+
+    def test_oversized_request_rejected_at_submit(self):
+        eng = FakePagedEngine(ever_ok=False)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        with pytest.raises(QueueFullError, match="block pool"):
+            b.submit([spec(1)])
+        b.shutdown()
+
+
+# ----------------------------------------------------------- scan executor
+
+
+@pytest.mark.slow
+class TestScanExecutorParity:
+    def test_paged_matches_slotted_scan(self):
+        """The depth-stacked scan-executor cache pages identically (the
+        page table is broadcast across the depth axis)."""
+        model = DALLE(
+            dim=32, depth=2, heads=2, dim_head=8,
+            num_image_tokens=32, image_fmap_size=FMAP,
+            num_text_tokens=64, text_seq_len=TEXT_SEQ,
+            shift_tokens=True, rotary_emb=True, executor="scan",
+        )
+        text = jnp.zeros((1, TEXT_SEQ), jnp.int32)
+        toks = jnp.zeros((1, IMG_SEQ), jnp.int32)
+        params = jax.jit(model.init)(jax.random.PRNGKey(42), text, toks)
+        slot = ContinuousEngine(
+            model=model, variables=params, max_batch=2, chunk_tokens=4,
+            prefill_batch=2, registry=MetricsRegistry(),
+        )
+        paged = PagedContinuousEngine(
+            model=model, variables=params, max_batch=2, chunk_tokens=4,
+            prefill_batch=2, page_size=PAGE, registry=MetricsRegistry(),
+        )
+        wave = [(0, spec(1)), (1, spec(2, (9, 9)))]
+        slot.prefill_slots(wave)
+        _drain(slot)
+        ref = _tokens(slot, 2)
+        paged.prefill_slots(wave)
+        _drain(paged)
+        assert (ref == _tokens(paged, 2)).all()
