@@ -28,7 +28,10 @@ amortization is visible in the output. Both open-loop lines carry a
 `stages` per-stage breakdown ({stage: {mean_ms, count}} deltas from the
 `dalle_serving_stage_seconds` family over the measured window only), so
 a TTFT regression is attributable to queue vs prefill vs chunk without
-re-running under a tracer.
+re-running under a tracer. The continuous line additionally carries a
+`vitals` block (obs/vitals.py sampler over the measured window only:
+mean/peak slots_active — blocks too on the paged layout — plus per-
+program MFU where the cost table measured a synced dispatch).
 
 Paged KV cache (`--kv_layout paged`, SERVE_PAGE_SIZE / SERVE_KV_PAGES):
 the continuous engine becomes `PagedContinuousEngine` and its line gains
@@ -418,6 +421,11 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
             max_batch=max_batch, chunk_tokens=chunk_tokens,
             prefill_batch=prefill_batch, registry=MetricsRegistry(),
         )
+    # per-program cost capture (obs/vitals.py) before warmup so the
+    # continuous line can report live MFU over the measured window
+    from dalle_pytorch_tpu.obs import EngineVitals, ProgramCostTable
+
+    cont.cost_table = ProgramCostTable(registry=cont.registry)
     cont.warmup()
     cb = ContinuousBatcher(
         cont, max_queue_rows=max(64, 4 * max_batch), registry=cont.registry,
@@ -492,6 +500,11 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
         "dalle_serving_prefill_dispatches_total"
     ).value
     cont_stages0 = _stage_snapshot(cont.registry)
+    # vitals sampled over the MEASURED window only: the ring starts empty
+    # here (after calibration), stops before the JSON line renders
+    vitals = EngineVitals(interval_s=0.05, max_samples=4096)
+    vitals.bind(engine=cont, batcher=cb)
+    vitals.start()
     if kv_layout == "paged":
         # measured-window occupancy: the saturation-calibration flood above
         # already pushed the pool to ITS peak, so restart the watermark (and
@@ -500,7 +513,17 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
         hits0, misses0 = cont.kv.cache.hits, cont.kv.cache.misses
         evictions0 = cont.kv.cache.evictions
     cont_stats = run_open_loop(cb, text_ids, arrivals, seeds, texts=texts)
+    vitals.stop()
     cb.shutdown(drain=True)
+    # mean/peak occupancy + per-program MFU over the measured window
+    vitals_block = vitals.window_summary()
+    mfu = {
+        row["program"]: row["mfu"]
+        for row in cont.cost_table.rows()
+        if row.get("mfu") is not None
+    }
+    if mfu:
+        vitals_block["mfu"] = mfu
     pf_rows = (
         cont.registry.get("dalle_serving_prefills_total").value - pf_rows0
     )
@@ -520,6 +543,7 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
         ),
         **cont_stats,
         "stages": _stage_breakdown(cont.registry, cont_stages0),
+        "vitals": vitals_block,
     }
     if kv_layout == "paged":
         # HBM story: pages the measured window ACTUALLY peaked at vs the

@@ -22,6 +22,14 @@ Prometheus counters can't answer that; these modules can:
     behind `POST /debug/profile?seconds=N` (root-gated, single-flight,
     writes a TensorBoard trace dir) so a TPU hotspot can be captured
     from a live server without a restart.
+  * `vitals.py`   — device telemetry and self-diagnosis: per-program
+    `ProgramCostTable` (XLA cost/memory analysis captured at warmup →
+    live MFU/bandwidth gauges and `GET /debug/programs`), the
+    `EngineVitals` background sampler (`GET /debug/vitals` time-series),
+    the `StallWatchdog` (stuck dispatch / stale queue head / frozen
+    decode → structured `stall` events with a full `/debug/state` dump
+    and worker stacks), and the `SLOTracker` (declarative latency
+    targets, rolling-window burn rate, the /healthz `degraded` tier).
 
 Stage timings also feed the `dalle_serving_stage_seconds{stage=}`
 histogram family (`training/metrics.py`), so `/metrics` and the traces
@@ -31,12 +39,26 @@ agree on where the time went.
 from dalle_pytorch_tpu.obs.tracing import NULL_TRACE, Span, Trace, Tracer
 from dalle_pytorch_tpu.obs.logging import StructuredLog
 from dalle_pytorch_tpu.obs.profiler import ProfilerBusy, ProfilerCapture
+from dalle_pytorch_tpu.obs.vitals import (
+    NULL_VITALS,
+    EngineVitals,
+    ProgramCostTable,
+    SLOTarget,
+    SLOTracker,
+    StallWatchdog,
+)
 
 __all__ = [
+    "EngineVitals",
     "NULL_TRACE",
+    "NULL_VITALS",
     "ProfilerBusy",
     "ProfilerCapture",
+    "ProgramCostTable",
+    "SLOTarget",
+    "SLOTracker",
     "Span",
+    "StallWatchdog",
     "StructuredLog",
     "Trace",
     "Tracer",

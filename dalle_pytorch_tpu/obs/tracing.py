@@ -285,12 +285,24 @@ class Tracer:
             traces = list(self._ring)
         return traces if n is None else traces[-n:]
 
+    def find(self, trace_id: str) -> Optional[Trace]:
+        """Exact-ID lookup in the retained ring (newest first — a reused
+        ID, which uuid4 makes cosmically unlikely, resolves to the most
+        recent trace). None once evicted: the ring is bounded, and the
+        HTTP layer turns that into a 404 rather than pretending."""
+        with self._lock:
+            for trace in reversed(self._ring):
+                if trace.trace_id == trace_id:
+                    return trace
+        return None
+
     def __len__(self) -> int:
         return len(self._ring)
 
     # ------------------------------------------------------------ export
 
-    def trace_events(self, n: Optional[int] = None) -> Dict:
+    def trace_events(self, n: Optional[int] = None,
+                     traces: Optional[List[Trace]] = None) -> Dict:
         """Chrome/Perfetto `trace_event` JSON object for the ring buffer.
 
         One `ph: "X"` (complete) event per closed span; each trace gets
@@ -298,10 +310,13 @@ class Tracer:
         so concurrent requests render as parallel tracks with the trace
         ID as the row label. Timestamps are microseconds since the
         tracer's epoch (Perfetto only needs them mutually consistent).
+        `traces` overrides the ring selection (the `?trace_id=` exact
+        lookup exports a single trace through the same serializer).
         """
         pid = os.getpid()
         events: List[Dict] = []
-        for tid, trace in enumerate(self.recent(n), start=1):
+        selected = self.recent(n) if traces is None else traces
+        for tid, trace in enumerate(selected, start=1):
             events.append({
                 "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
                 "args": {"name": f"req {trace.trace_id}"},

@@ -17,13 +17,15 @@ queue feeding fixed-shape compiled sampler programs.
     drain. `ContinuousBatcher`: same queue surface, but an
     admit→chunk→retire worker loop over the slot cache.
   * `server.py`   — stdlib-only JSON HTTP API: POST /generate,
-    GET /healthz, GET /metrics (Prometheus text format;
-    `?exemplars=1` for OpenMetrics exemplars), GET /debug/traces
-    (Perfetto export of recent request traces), POST /debug/profile
-    (on-demand jax.profiler capture). Requests are traced end-to-end
-    through the batcher by `dalle_pytorch_tpu/obs/` — trace ID minted
-    at ingress, one span per stage, one structured JSON log line per
-    completed request.
+    GET /healthz (ok / degraded / 503 tiers), GET /metrics (Prometheus
+    text format; `?exemplars=1` for OpenMetrics exemplars),
+    GET /debug/traces (Perfetto export; `?trace_id=` exact lookup),
+    GET /debug/vitals + /debug/programs + /debug/state (device
+    telemetry, per-program cost/MFU table, engine-state dump —
+    `obs/vitals.py`), POST /debug/profile (on-demand jax.profiler
+    capture). Requests are traced end-to-end through the batcher by
+    `dalle_pytorch_tpu/obs/` — trace ID minted at ingress, one span per
+    stage, one structured JSON log line per completed request.
 
 `serve.py` at the repo root is the CLI entrypoint; `generate.py` drives
 the same `GenerationEngine` for one-shot CLI batches, so the two paths

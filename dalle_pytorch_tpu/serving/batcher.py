@@ -330,6 +330,42 @@ class MicroBatcher:
     def queue_depth_rows(self) -> int:
         return self._pending_rows
 
+    def head_age_s(self) -> Optional[float]:
+        """Age of the oldest queued request (None when empty) — the
+        vitals sampler's queue-staleness signal. Taking the queue lock at
+        sampler cadence (~1 Hz) is noise next to the worker's own
+        per-wave acquisitions."""
+        with self._cond:
+            if not self._pending:
+                return None
+            return time.monotonic() - self._pending[0].enqueued_at
+
+    def state_summary(self) -> dict:
+        """Queue-side state for `/debug/state` and stall reports."""
+        with self._cond:
+            pending = len(self._pending)
+            rows = self._pending_rows
+            head_age = (
+                time.monotonic() - self._pending[0].enqueued_at
+                if self._pending else None
+            )
+            queued_traces = [
+                req.trace.trace_id for req in self._pending if req.trace
+            ][:16]
+        out = {
+            "queue_requests": pending,
+            "queue_depth_rows": rows,
+            "max_queue_rows": self.max_queue_rows,
+            "queue_head_age_s": (
+                round(head_age, 3) if head_age is not None else None
+            ),
+            "queued_trace_ids": queued_traces,
+            "closed": self._closed,
+        }
+        if self.last_error is not None:
+            out["last_error"] = repr(self.last_error)
+        return out
+
     def error_age_s(self) -> Optional[float]:
         """Seconds since the most recent failed flush; None if the last
         flush succeeded (or none has failed yet). Lets health checks decay
@@ -576,12 +612,44 @@ class ContinuousBatcher(MicroBatcher):
         # fallback chunk index for span metadata when the engine doesn't
         # keep its own (`ContinuousEngine.chunk_index`; test fakes don't)
         self._chunks_dispatched = 0
+        # instance-visible so /debug/state can render the in-flight table;
+        # mutated only by the worker thread (readers snapshot, see
+        # state_summary)
+        self._inflight: dict = {}
+        self._partial: dict = {}
+
+    def state_summary(self) -> dict:
+        """Queue summary plus the slot → in-flight request table. The
+        worker mutates `_inflight` without a lock (it is the only
+        writer), so the snapshot copy retries around concurrent resize —
+        a point-in-time debug view, not a linearizable read."""
+        out = super().state_summary()
+        now = time.monotonic()
+        snap = {}
+        for _ in range(4):
+            try:
+                snap = dict(self._inflight)
+                break
+            except RuntimeError:  # resized mid-iteration; retry
+                continue
+        out["slots_inflight"] = {
+            int(slot): {
+                "trace_id": req.trace.trace_id if req.trace else None,
+                "rows": req.rows,
+                "row_index": idx,
+                "age_s": round(now - req.enqueued_at, 3),
+            }
+            for slot, (req, idx) in snap.items()
+        }
+        out["slots_active"] = self.allocator.n_active
+        out["slots_free"] = self.allocator.n_free
+        return out
 
     # ------------------------------------------------------------- worker
 
     def _run(self) -> None:  # tracelint: hotloop
-        inflight: dict = {}  # slot -> (request, row index within request)
-        partial: dict = {}  # request -> {"tokens": [rows], "remaining": n}
+        inflight = self._inflight  # slot -> (request, row index)
+        partial = self._partial  # request -> {"tokens": [rows], "remaining"}
         while True:
             admitted: List = []  # (slot, spec) prefills owed this iteration
             with self._cond:

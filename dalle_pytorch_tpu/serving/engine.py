@@ -101,6 +101,15 @@ class GenerationEngine:
         self._warm = set()
         self._lock = threading.Lock()  # one sampler dispatch at a time
         self.stats = EngineStats(compiled_shapes=())
+        # device-telemetry seams (obs/vitals.py), both inert by default:
+        # `vitals` is the dispatch clock the sampler thread reads (the
+        # shared no-op singleton until an EngineVitals binds itself);
+        # `cost_table` opts warmup into per-program cost capture (one
+        # extra AOT compile per program) — attach BEFORE warmup()
+        from dalle_pytorch_tpu.obs.vitals import NULL_VITALS
+
+        self.vitals = NULL_VITALS
+        self.cost_table = None
         if registry is None:
             from dalle_pytorch_tpu.training.metrics import MetricsRegistry
 
@@ -118,6 +127,38 @@ class GenerationEngine:
             "dalle_serving_engine_compile_seconds",
             "wall time of compiling (warmup) dispatches",
         )
+
+    # -------------------------------------------------------------- vitals
+
+    def _capture_cost(self, name: str, fn, *args) -> None:
+        """Record `fn(*args)`'s XLA cost/memory analysis into the attached
+        `ProgramCostTable` under `name` (no-op without one, or once
+        captured). AOT lowering wraps the already-jitted model op in an
+        outer `jax.jit` — params/state ride as REAL arguments, never
+        closure constants, so the lowered HLO matches the dispatched
+        program's traffic. Warmup-only by construction (every call site is
+        gated on its `_warmup` flag): the `.compile()` inside
+        `ProgramCostTable.capture` is one extra backend compile that must
+        never land on the serving path."""
+        table = self.cost_table
+        if table is None or table.has(name):
+            return
+        import jax
+
+        table.capture(name, lambda: jax.jit(fn).lower(*args))
+
+    def state_dump(self) -> dict:
+        """Host-side engine state for `/debug/state` and stall reports.
+        Lock-free reads of host counters — a stalled engine holds its
+        dispatch lock, and the dump must still render."""
+        return {
+            "engine": type(self).__name__,
+            "batch_shapes": list(self.batch_shapes),
+            "compiled_shapes": list(self.stats.compiled_shapes),
+            "batches": self.stats.batches,
+            "rows_generated": self.stats.rows_generated,
+            "warmup_batches": self.stats.warmup_batches,
+        }
 
     # ------------------------------------------------------------- shapes
 
@@ -191,29 +232,57 @@ class GenerationEngine:
         keep = np.asarray([self._keep_k(s.top_k) for s in rows], np.int32)
 
         fused = isinstance(self.vae, DiscreteVAE)
+        prog = f"generate:{shape}"
         with self._lock:
             is_warm = shape in self._warm
             (self._compile_hit if is_warm else self._compile_miss).inc()
             t0 = time.perf_counter()
-            out = generate_images_cached_batched(
-                self.model, self.variables, jnp.asarray(text),
-                seeds, temps, keep,
-                cond_scale=self.cond_scale,
-                vae=self.vae if fused else None,
-                vae_params=self.vae_params if fused else None,
-            )
-            if fused:
-                toks, pixels = out
-                toks = np.asarray(toks)
-                pixels = np.asarray(pixels) * 0.5 + 0.5  # un-normalize
-            else:
-                toks = np.asarray(out)
-                pixels = None
+            self.vitals.dispatch_begin(prog)
+            try:
+                out = generate_images_cached_batched(
+                    self.model, self.variables, jnp.asarray(text),
+                    seeds, temps, keep,
+                    cond_scale=self.cond_scale,
+                    vae=self.vae if fused else None,
+                    vae_params=self.vae_params if fused else None,
+                )
+                if fused:
+                    toks, pixels = out
+                    toks = np.asarray(toks)
+                    pixels = np.asarray(pixels) * 0.5 + 0.5  # un-normalize
+                else:
+                    toks = np.asarray(out)
+                    pixels = None
+            finally:
+                wall = time.perf_counter() - t0
+                self.vitals.dispatch_end(prog, wall)
+            if is_warm and self.cost_table is not None:
+                # the np.asarray above synced the dispatch, so this wall
+                # is real execution time — MFU-grade. Compiling (cold)
+                # dispatches are excluded: their wall is compile latency.
+                self.cost_table.record_wall(prog, wall)
             if not is_warm:
                 self._compile_seconds.observe(time.perf_counter() - t0)
                 self._warm.add(shape)
                 self.stats.compiled_shapes = tuple(sorted(self._warm))
             if _warmup:
+                # AFTER the dispatch, never before: lowering the sampler
+                # inside an outer trace before its closure cache is
+                # populated would bake tracers into `_jitted_sampler`'s
+                # lru_cache (builders materialize constants at
+                # closure-build time)
+                self._capture_cost(
+                    prog,
+                    lambda v, vp, t, s, tm, k: (
+                        generate_images_cached_batched(
+                            self.model, v, t, s, tm, k,
+                            cond_scale=self.cond_scale,
+                            vae=self.vae if fused else None, vae_params=vp,
+                        )
+                    ),
+                    self.variables, self.vae_params if fused else None,
+                    jnp.asarray(text), seeds, temps, keep,
+                )
                 self.stats.warmup_batches += 1
             else:
                 self.stats.batches += 1
@@ -467,11 +536,32 @@ class ContinuousEngine(GenerationEngine):
             f"got batch {texts.shape}"
         )
         with self._lock:
-            self._replace_state(lambda s: prefill_into_slots(
-                self.model, self.variables, s, texts, slots, seeds, temps,
-                keep,
-            ))
+            t0 = time.perf_counter()
+            self.vitals.dispatch_begin("prefill")
+            try:
+                self._replace_state(lambda s: prefill_into_slots(
+                    self.model, self.variables, s, texts, slots, seeds, temps,
+                    keep,
+                ))
+            finally:
+                wall = time.perf_counter() - t0
+                self.vitals.dispatch_end("prefill", wall)
+            if _warmup:
+                # after the dispatch (see GenerationEngine.generate: a
+                # pre-dispatch lowering would poison the sampler cache)
+                self._capture_cost(
+                    "prefill",
+                    lambda v, s, t, sl, se, tm, k: prefill_into_slots(
+                        self.model, v, s, t, sl, se, tm, k,
+                    ),
+                    self.variables, self._state, texts, slots, seeds,
+                    temps, keep,
+                )
             if not _warmup:
+                if self.cost_table is not None:
+                    # async dispatch: this wall is host-side only, kept
+                    # for the watchdog baseline but never exported as MFU
+                    self.cost_table.record_wall("prefill", wall, synced=False)
                 self._m_prefills.inc(n)
                 self._m_prefill_dispatches.inc()
 
@@ -504,31 +594,66 @@ class ContinuousEngine(GenerationEngine):
 
         self._pre_chunk()
         with self._lock:
-            self._replace_state(self._chunk_op)
-            if not _warmup:
-                self._m_chunks.inc()
-                self.chunk_index += 1
-                self.stats.batches += 1
-            # the chunk boundary IS the designed sync point: retirement
-            # decisions need the positions on the host, and fusing both
-            # small arrays into one transfer keeps it to a single round trip
-            pos, act = jax.device_get(  # tracelint: disable=TL002 -- chunk-boundary snapshot is the one designed sync of the decode loop (single fused transfer)
-                (self._state["img_pos"], self._state["active"])
-            )
+            t0 = time.perf_counter()
+            self.vitals.dispatch_begin("chunk")
+            try:
+                self._replace_state(self._chunk_op)
+                if not _warmup:
+                    self._m_chunks.inc()
+                    self.chunk_index += 1
+                    self.stats.batches += 1
+                # the chunk boundary IS the designed sync point: retirement
+                # decisions need the positions on the host, and fusing both
+                # small arrays into one transfer keeps it to a single round trip
+                pos, act = jax.device_get(  # tracelint: disable=TL002 -- chunk-boundary snapshot is the one designed sync of the decode loop (single fused transfer)
+                    (self._state["img_pos"], self._state["active"])
+                )
+            finally:
+                wall = time.perf_counter() - t0
+                self.vitals.dispatch_end("chunk", wall)
+            if _warmup:
+                # after the dispatch (see GenerationEngine.generate: a
+                # pre-dispatch lowering would poison the sampler cache)
+                self._capture_chunk_cost()
+            elif self.cost_table is not None:
+                # the device_get above synced the chunk program, so this
+                # wall is MFU-grade execution time
+                self.cost_table.record_wall("chunk", wall)
         self._post_chunk(pos, act)
         return pos, act
+
+    def _capture_chunk_cost(self) -> None:
+        """Warmup-time cost capture of the chunk program (subclass hook —
+        the paged engine lowers its paged variant). Caller holds the
+        lock."""
+        from dalle_pytorch_tpu.models.dalle import decode_image_chunk
+
+        self._capture_cost(
+            "chunk",
+            lambda v, s: decode_image_chunk(
+                self.model, v, s, self.chunk_tokens
+            ),
+            self.variables, self._state,
+        )
 
     def harvest(self, slots: Sequence[int]) -> np.ndarray:  # tracelint: hotloop
         """Finished slots' tokens [len(slots), image_seq_len] (host copy)."""
         import jax
 
         with self._lock:
-            # one explicit fixed-shape transfer of the whole token buffer,
-            # sliced on the host: a device-side gather of just the finished
-            # rows would compile one program PER finished-count (1..max_batch)
-            # and break the exactly-the-warmup-set compile discipline that
-            # tests/test_continuous.py pins with assert_no_recompiles
-            toks = jax.device_get(self._state["img_tokens"])  # tracelint: disable=TL002 -- retirement harvest is a designed sync; fixed-shape transfer beats a per-count compiled gather
+            t0 = time.perf_counter()
+            self.vitals.dispatch_begin("harvest")
+            try:
+                # one explicit fixed-shape transfer of the whole token buffer,
+                # sliced on the host: a device-side gather of just the finished
+                # rows would compile one program PER finished-count (1..max_batch)
+                # and break the exactly-the-warmup-set compile discipline that
+                # tests/test_continuous.py pins with assert_no_recompiles
+                toks = jax.device_get(self._state["img_tokens"])  # tracelint: disable=TL002 -- retirement harvest is a designed sync; fixed-shape transfer beats a per-count compiled gather
+            finally:
+                self.vitals.dispatch_end(
+                    "harvest", time.perf_counter() - t0
+                )
             self.stats.rows_generated += len(list(slots))
         return toks[list(slots)].astype(np.int32)
 
@@ -541,9 +666,16 @@ class ContinuousEngine(GenerationEngine):
         mask = np.zeros(self.max_batch, bool)
         mask[list(slots)] = True
         with self._lock:
-            self._replace_state(
-                lambda s: release_slots(self.model, s, mask)
-            )
+            t0 = time.perf_counter()
+            self.vitals.dispatch_begin("release")
+            try:
+                self._replace_state(
+                    lambda s: release_slots(self.model, s, mask)
+                )
+            finally:
+                self.vitals.dispatch_end(
+                    "release", time.perf_counter() - t0
+                )
 
     def decode_pixels(self, tokens: np.ndarray) -> Optional[np.ndarray]:  # tracelint: hotloop
         """Pixels [n, H, W, 3] in [0, 1] for harvested token rows, via ONE
@@ -572,14 +704,24 @@ class ContinuousEngine(GenerationEngine):
         )
         outs = []
         with self._lock:
-            for i in range(0, len(padded), self.max_batch):
-                outs.append(
-                    np.asarray(  # tracelint: disable=TL002 -- pixel harvest is the terminal sync of the retire path; rows leave the device here by design
-                        self._decode_pixels_jit(
-                            jnp.asarray(padded[i : i + self.max_batch])
+            t0 = time.perf_counter()
+            self.vitals.dispatch_begin("decode_pixels")
+            try:
+                for i in range(0, len(padded), self.max_batch):
+                    outs.append(
+                        np.asarray(  # tracelint: disable=TL002 -- pixel harvest is the terminal sync of the retire path; rows leave the device here by design
+                            self._decode_pixels_jit(
+                                jnp.asarray(padded[i : i + self.max_batch])
+                            )
                         )
                     )
-                )
+            finally:
+                wall = time.perf_counter() - t0
+                self.vitals.dispatch_end("decode_pixels", wall)
+            if self.cost_table is not None and len(padded) == self.max_batch:
+                # np.asarray synced; single-dispatch calls only, so the
+                # wall maps to ONE program execution
+                self.cost_table.record_wall("decode_pixels", wall)
         pixels = np.concatenate(outs)[:n] * 0.5 + 0.5
         return np.clip(pixels, 0.0, 1.0)
 
@@ -606,9 +748,13 @@ class ContinuousEngine(GenerationEngine):
         self.prefill_slot(0, dummy, _warmup=True)
         self.step_chunk(_warmup=True)
         self.release([0])
+        # cost capture AFTER each program's first dispatch (a pre-dispatch
+        # lowering would poison the sampler closure cache with tracers)
+        self._capture_release_cost()
         self.decode_pixels(
             np.zeros((1, self.image_seq_len), np.int32)
         )
+        self._capture_decode_pixels_cost()
         with self._lock:
             # _fresh_state, not init_slot_state directly: subclasses
             # rebuild host-side managers alongside the device state
@@ -617,6 +763,49 @@ class ContinuousEngine(GenerationEngine):
             self._compile_seconds.observe(time.perf_counter() - t0)
             self._warm.add(self.max_batch)
             self.stats.compiled_shapes = tuple(sorted(self._warm))
+
+    def _capture_release_cost(self) -> None:
+        from dalle_pytorch_tpu.models.dalle import release_slots
+
+        mask = np.zeros(self.max_batch, bool)
+        mask[0] = True
+        self._capture_cost(
+            "release",
+            lambda s, m: release_slots(self.model, s, m),
+            self._state, mask,
+        )
+
+    def _capture_decode_pixels_cost(self) -> None:
+        """The pixel-decode jit exists only after the warmup decode built
+        it (and only for the fused DiscreteVAE path)."""
+        if self.cost_table is None or self._decode_pixels_jit is None:
+            return
+        import jax.numpy as jnp
+
+        self.cost_table.capture(
+            "decode_pixels",
+            lambda: self._decode_pixels_jit.lower(
+                jnp.zeros((self.max_batch, self.image_seq_len), jnp.int32)
+            ),
+        )
+
+    # -------------------------------------------------------- observability
+
+    def state_dump(self) -> dict:
+        """Host-side engine state for `/debug/state` and stall reports —
+        deliberately lock-free (a stalled engine is holding its dispatch
+        lock, and the dump must still render)."""
+        out = super().state_dump()
+        out.update(
+            max_batch=self.max_batch,
+            chunk_tokens=self.chunk_tokens,
+            prefill_batch=self.prefill_batch,
+            chunk_index=self.chunk_index,
+            dispatch_inflight=(
+                self.vitals.inflight() if self.vitals else None
+            ),
+        )
+        return out
 
 
 class PagedContinuousEngine(ContinuousEngine):
@@ -878,10 +1067,16 @@ class PagedContinuousEngine(ContinuousEngine):
         # protection (it cannot fire for waves admitted through
         # can_admit/admission_headroom and wave-protected end to end).
         added = self.kv.cache.protect(entry.key for _, _, entry in hits)
+        t0 = time.perf_counter()
+        self.vitals.dispatch_begin("prefill")
         try:
             self._admit_wave(hits, misses, stats, _warmup)
         finally:
+            wall = time.perf_counter() - t0
+            self.vitals.dispatch_end("prefill", wall)
             self.kv.cache.unprotect(added)
+        if not _warmup and self.cost_table is not None:
+            self.cost_table.record_wall("prefill", wall, synced=False)
 
         self.last_admission_stats = stats
         self._update_block_gauges()
@@ -911,6 +1106,21 @@ class PagedContinuousEngine(ContinuousEngine):
                 )
                 if not _warmup:
                     self._m_prefix_hits.inc()
+            if _warmup:
+                # after the dispatch (see GenerationEngine.generate: a
+                # pre-dispatch lowering would poison the sampler cache)
+                self._capture_cost(
+                    "admit_hit",
+                    lambda s, sl, sc, se, tm, k, src, dst: (
+                        admit_cached_prefix(
+                            self.model, s, sl, sc, se, tm, k, src, dst,
+                            self.page_size,
+                        )
+                    ),
+                    self._state, slot, entry.sidecar,
+                    int(spec.seed) & 0x7FFFFFFF, spec.temperature,
+                    self._keep_k(spec.top_k), partial_src, pdst,
+                )
             self._host_pos[slot] = 0
             self._host_active[slot] = True
             if not _warmup:
@@ -978,6 +1188,20 @@ class PagedContinuousEngine(ContinuousEngine):
                     self._m_prefills.inc(len(misses))
                     self._m_prefill_dispatches.inc()
                     self._m_prefix_misses.inc(len(misses))
+            if _warmup:
+                # after the dispatch (see GenerationEngine.generate: a
+                # pre-dispatch lowering would poison the sampler cache)
+                self._capture_cost(
+                    "prefill",
+                    lambda v, s, t, sl, se, tm, k, pr, pd: (
+                        prefill_into_slots_paged(
+                            self.model, v, s, t, sl, se, tm, k, pr, pd,
+                            self.page_size,
+                        )
+                    ),
+                    self.variables, self._state, texts, slots, seeds,
+                    temps, keep, page_rows, partial_dst,
+                )
             for i, token in pending:
                 self.kv.finish_register(
                     token,
@@ -1049,9 +1273,13 @@ class PagedContinuousEngine(ContinuousEngine):
             self.prefill_slots([(hit_slot, dummy)], _warmup=True)  # prefix hit
         self.step_chunk(_warmup=True)
         self.release([s for s in (0, 1) if s < self.max_batch])
+        # capture after the first release dispatch, like the other
+        # programs (pre-dispatch lowering poisons the sampler cache)
+        self._capture_release_cost()
         self.decode_pixels(
             np.zeros((1, self.image_seq_len), np.int32)
         )
+        self._capture_decode_pixels_cost()
         with self._lock:
             self._state = self._fresh_state()
             self.stats.warmup_batches += 1
@@ -1059,6 +1287,22 @@ class PagedContinuousEngine(ContinuousEngine):
             self._warm.add(self.max_batch)
             self.stats.compiled_shapes = tuple(sorted(self._warm))
         self._update_block_gauges()
+
+    def _capture_chunk_cost(self) -> None:
+        from dalle_pytorch_tpu.models.dalle import decode_image_chunk_paged
+
+        self._capture_cost(
+            "chunk",
+            lambda v, s, t: decode_image_chunk_paged(
+                self.model, v, s, self.chunk_tokens, t
+            ),
+            self.variables, self._state, self.kv.table,
+        )
+
+    def state_dump(self) -> dict:
+        out = super().state_dump()
+        out["kv"] = self.kv.debug_dump()
+        return out
 
 
 def engine_from_checkpoint(

@@ -81,6 +81,12 @@ class BlockPool:
     def refcount(self, page: int) -> int:
         return int(self._ref[page])
 
+    def refcounts(self) -> Dict[int, int]:
+        """{page: refcount} of every live page — the `/debug/state` view
+        of who is pinning HBM (rows vs prefix-cache references)."""
+        (live,) = np.nonzero(self._ref)
+        return {int(p): int(self._ref[p]) for p in live}
+
     def alloc(self) -> Optional[int]:
         if not self._free:
             return None
@@ -530,3 +536,38 @@ class PagedKVManager:
     @property
     def blocks_free(self) -> int:
         return self.pool.n_free
+
+    def debug_dump(self) -> Dict:
+        """JSON-ready paging state for `/debug/state` and stall reports:
+        per-row page tables + debt, live-page refcounts, prefix-cache
+        entries. Plain host reads on the worker-owned structures — a
+        point-in-time view, consistent enough for postmortems (the one
+        writer is the batcher worker, and a stalled worker isn't
+        writing)."""
+        rows = []
+        for slot in range(self.n_rows):
+            pages = self._row_pages[slot]
+            if not pages and not self._debt[slot]:
+                continue
+            rows.append({
+                "slot": slot,
+                "pages": [int(p) for p in pages],
+                "blocks_mapped": int(self._mapped[slot]),
+                "pages_reserved": int(self._debt[slot]),
+            })
+        return {
+            "page_size": self.page_size,
+            "pages_per_row": self.pages_per_row,
+            "blocks_total": self.pool.n_pages - 1,
+            "blocks_active": self.blocks_active,
+            "blocks_free": self.blocks_free,
+            "page_refcounts": self.pool.refcounts(),
+            "rows": rows,
+            "prefix_cache": {
+                "entries": len(self.cache),
+                "protected": len(self.cache._protected),
+                "hits": self.cache.hits,
+                "misses": self.cache.misses,
+                "evictions": self.cache.evictions,
+            },
+        }

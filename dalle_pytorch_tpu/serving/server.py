@@ -18,7 +18,21 @@ Endpoints:
                    per histogram).
   GET  /debug/traces -> Chrome/Perfetto `trace_event` JSON of the most
                    recent request traces (`obs/tracing.py` ring buffer);
-                   load the body in ui.perfetto.dev.
+                   load the body in ui.perfetto.dev. `?n=` bounds the
+                   export; `?trace_id=` exact-looks-up one retained
+                   trace (404 once evicted from the ring).
+  GET  /debug/vitals -> vitals time-series ring (`obs/vitals.py`
+                   sampler): queue depth, slots/blocks active,
+                   dispatch-in-flight age, device memory stats, recent
+                   watchdog stalls, SLO burn status. `?n=` tails it.
+  GET  /debug/programs -> per-program XLA cost/memory table captured at
+                   warmup (FLOPs, bytes accessed, HBM footprint) plus
+                   live MFU / achieved bandwidth where measured.
+  GET  /debug/state -> full engine-state dump for postmortems: slot
+                   table with in-flight trace IDs, page tables +
+                   refcounts (paged engine), queue summary, recent
+                   compile events, worker-thread stacks. The same dump
+                   rides every watchdog `stall` log event.
   POST /debug/profile?seconds=N -> on-demand `jax.profiler` capture of N
                    seconds of live traffic (root-gated -> 403,
                    single-flight -> 409); returns the TensorBoard trace
@@ -55,6 +69,8 @@ import numpy as np
 from dalle_pytorch_tpu.obs.logging import StructuredLog
 from dalle_pytorch_tpu.obs.profiler import ProfilerBusy, ProfilerCapture
 from dalle_pytorch_tpu.obs.tracing import Tracer
+from dalle_pytorch_tpu.obs.vitals import EngineVitals, thread_stacks
+from dalle_pytorch_tpu.utils import compile_guard
 from dalle_pytorch_tpu.serving.batcher import (
     ContinuousBatcher,
     MicroBatcher,
@@ -96,7 +112,9 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ helpers
 
     def _reply(self, code: int, payload: dict, extra_headers=()) -> None:
-        body = json.dumps(payload).encode("utf-8")
+        # default=str: debug dumps carry numpy scalars and Paths; a
+        # diagnostics endpoint must degrade to strings, not 500
+        body = json.dumps(payload, default=str).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -113,6 +131,15 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         except (BrokenPipeError, ConnectionResetError):
             pass
+
+    def _parse_n(self, params) -> Optional[int]:
+        """Shared `?n=` tail bound of the debug ring exports; raises
+        ValueError on anything but a positive integer."""
+        n_param = params.get("n", [None])[0]
+        n = None if n_param is None else int(n_param)
+        if n is not None and n <= 0:
+            raise ValueError(n)
+        return n
 
     # -------------------------------------------------------------- GETs
 
@@ -146,16 +173,46 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/debug/traces":
             # ?n= bounds the payload: a small-chunk continuous config
             # holds one chunk span per decode chunk, so the full ring
-            # can serialize to megabytes
+            # can serialize to megabytes. ?trace_id= is the exact lookup
+            # of ONE retained trace (the request log / response payload
+            # hands clients the ID); 404 once the ring evicted it.
+            params = parse_qs(query)
+            trace_id = params.get("trace_id", [None])[0]
+            if trace_id is not None:
+                trace = owner.tracer.find(trace_id)
+                if trace is None:
+                    self._reply(404, {
+                        "error": f"trace {trace_id} not retained "
+                        "(evicted from the ring or never minted)"
+                    })
+                    return
+                self._reply(200, owner.tracer.trace_events(traces=[trace]))
+                return
             try:
-                n_param = parse_qs(query).get("n", [None])[0]
-                n = None if n_param is None else int(n_param)
-                if n is not None and n <= 0:
-                    raise ValueError(n)
+                n = self._parse_n(params)
             except ValueError:
                 self._reply(400, {"error": "n must be a positive integer"})
                 return
             self._reply(200, owner.tracer.trace_events(n))
+        elif path == "/debug/vitals":
+            try:
+                n = self._parse_n(parse_qs(query))
+            except ValueError:
+                self._reply(400, {"error": "n must be a positive integer"})
+                return
+            self._reply(200, owner.vitals.detail(n))
+        elif path == "/debug/programs":
+            table = getattr(owner.engine, "cost_table", None)
+            if table is None:
+                self._reply(200, {
+                    "programs": [],
+                    "note": "no ProgramCostTable attached "
+                    "(set engine.cost_table before warmup)",
+                })
+            else:
+                self._reply(200, table.detail())
+        elif path == "/debug/state":
+            self._reply(200, owner.state_dump())
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -265,12 +322,17 @@ class _Handler(BaseHTTPRequestHandler):
             prompt_chars=len(prompt),
         )
 
+        # submit-time load context (queue depth, slots, free blocks):
+        # stamped just before the submit call so the log line records the
+        # admission conditions this request actually faced
+        admission: dict = {}
+
         def closed_out(outcome: str, status: int, **fields):
             trace.finish(outcome=outcome)
             owner.log_request(
                 trace, outcome=outcome, status=status,
                 latency_ms=(time.monotonic() - t0) * 1000.0,
-                rows=num_images, **fields,
+                rows=num_images, **admission, **fields,
             )
 
         try:
@@ -289,6 +351,7 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 for i in range(num_images)
             ]
+            admission.update(owner.admission_context())
             req = owner.batcher.submit(
                 specs, timeout_s=timeout_s, trace=trace
             )
@@ -390,11 +453,17 @@ class ServingServer:
         log_requests: bool = True,
         profiler: Optional[ProfilerCapture] = None,
         trace_dump_path: Optional[str] = None,
+        vitals: Optional[EngineVitals] = None,
     ):
         self.engine = engine
         self.registry = engine.registry
         self.request_timeout_s = float(request_timeout_s)
         self.verbose = verbose
+        # vitals default OFF (the inert, counter-gated zero-allocation
+        # object) — serve.py passes an enabled sampler; tests opt in
+        self.vitals = (
+            vitals if vitals is not None else EngineVitals(enabled=False)
+        )
         # tracing defaults ON: the ring buffer is bounded and span
         # bookkeeping is host-side clock reads — pass
         # Tracer(enabled=False) to get the pinned zero-allocation path
@@ -424,11 +493,18 @@ class ServingServer:
                 max_queue_rows=max_queue_rows,
                 registry=self.registry,
             )
+        # wire the sampler's host-state sources and launch it (no-op when
+        # disabled); binding also hands the engine its dispatch clock
+        self.vitals.bind(
+            engine=engine, batcher=self.batcher, log=log,
+            state_dump_fn=self.state_dump,
+        ).start()
         try:
             self._httpd = _Server((host, port), self)
         except OSError:
             # bind failure (port in use, bad host): don't leak the batcher
-            # worker thread the line above just started
+            # worker thread (or the vitals sampler) just started above
+            self.vitals.stop()
             self.batcher.shutdown(drain=False)
             raise
         self._thread: Optional[threading.Thread] = None
@@ -481,13 +557,27 @@ class ServingServer:
         err_age = self.batcher.error_age_s()
         erroring = err_age is not None and err_age < self.error_window_s
         healthy = not self._draining and not erroring
+        # the degraded tier sits BETWEEN ok and 503: the replica still
+        # serves (200 — a health-gated router must not pull it), but a
+        # recent watchdog stall or a burning SLO budget says "shed load /
+        # investigate". Hard failures (draining, engine errors) stay 503.
+        status = "ok" if healthy else "unhealthy"
+        degraded_reasons = []
+        if healthy:
+            degraded_reasons = self.vitals.degraded_reasons()
+            if degraded_reasons:
+                status = "degraded"
         detail = {
-            "status": "ok" if healthy else "unhealthy",
+            "status": status,
             "uptime_s": round(time.time() - self._started_at, 1),
             "queue_depth_rows": self.batcher.queue_depth_rows,
             "compiled_shapes": list(self.engine.stats.compiled_shapes),
             "batch_shapes": list(self.engine.batch_shapes),
         }
+        if degraded_reasons:
+            detail["degraded_reasons"] = degraded_reasons
+        if self.vitals.slo is not None:
+            detail["slo"] = self.vitals.slo.status()
         if isinstance(self.batcher, ContinuousBatcher):
             detail["engine"] = "continuous"
             detail["slots_active"] = self.batcher.allocator.n_active
@@ -504,6 +594,43 @@ class ServingServer:
         if self._draining:
             detail["draining"] = True
         return healthy, detail
+
+    def state_dump(self) -> dict:
+        """Full engine-state dump for `GET /debug/state` and the
+        watchdog's `stall` events: engine internals (slot/page tables,
+        refcounts), queue summary with in-flight trace IDs, recent
+        compile events, and the worker threads' Python stacks. Host-side
+        reads only — safe to call while the engine is wedged, which is
+        precisely when it matters."""
+        dump = {
+            "ts": round(time.time(), 3),
+            "uptime_s": round(time.time() - self._started_at, 1),
+            "draining": self._draining,
+        }
+        engine_dump = getattr(self.engine, "state_dump", None)
+        dump["engine"] = (
+            engine_dump() if engine_dump is not None
+            else {"engine": type(self.engine).__name__}
+        )
+        summary = getattr(self.batcher, "state_summary", None)
+        dump["batcher"] = summary() if summary is not None else {}
+        dump["recent_compiles"] = compile_guard.recent_events()
+        dump["worker_stacks"] = thread_stacks("batcher")
+        return dump
+
+    def admission_context(self) -> dict:
+        """Submit-time load context stamped onto every request log line
+        (`queue_depth_rows`, `slots_active`, `blocks_free` where the
+        engine has them) so an overload postmortem reads off the log
+        instead of correlating against the vitals ring."""
+        ctx = {"queue_depth_rows": self.batcher.queue_depth_rows}
+        alloc = getattr(self.batcher, "allocator", None)
+        if alloc is not None:
+            ctx["slots_active"] = alloc.n_active
+        kv = getattr(self.engine, "kv", None)
+        if kv is not None:
+            ctx["blocks_free"] = kv.blocks_free
+        return ctx
 
     def start(self) -> "ServingServer":
         assert self._thread is None, "already started"
@@ -549,6 +676,7 @@ class ServingServer:
 
     def shutdown(self, drain: bool = True) -> None:
         self._draining = True
+        self.vitals.stop()
         self.batcher.shutdown(drain=drain)
         with self._state_lock:
             first_close = not self._closed

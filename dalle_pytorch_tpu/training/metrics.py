@@ -158,6 +158,13 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def bucket_counts(self):
+        """Consistent snapshot for rolling-window readers (the SLO burn-rate
+        tracker diffs these between ticks): (bucket bounds, per-bucket
+        counts with +Inf last, total count, sum) under the lock."""
+        with self._lock:
+            return self.buckets, tuple(self._counts), self._count, self._sum
+
     def percentile(self, q: float) -> float:
         """Nearest-rank percentile over the recent-observation reservoir
         (0.0 when nothing has been observed yet)."""
@@ -320,21 +327,36 @@ class MetricsRegistry:
     ) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
 
+    def _family(self, cls, name: str, help: str, label_name: str, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = Family(cls, name, help, label_name, **kw)
+                self._instruments[name] = inst
+            assert isinstance(inst, Family) and inst.cls is cls, (
+                f"metric {name!r} already registered as {type(inst).__name__}"
+            )
+            return inst
+
     def histogram_family(
         self, name: str, help: str = "", label_name: str = "shape",
         buckets: Sequence[float] = _DEFAULT_BUCKETS,
     ) -> Family:
-        with self._lock:
-            inst = self._instruments.get(name)
-            if inst is None:
-                inst = Family(
-                    Histogram, name, help, label_name, buckets=buckets
-                )
-                self._instruments[name] = inst
-            assert isinstance(inst, Family) and inst.cls is Histogram, (
-                f"metric {name!r} already registered as {type(inst).__name__}"
-            )
-            return inst
+        return self._family(
+            Histogram, name, help, label_name, buckets=buckets
+        )
+
+    def gauge_family(
+        self, name: str, help: str = "", label_name: str = "name"
+    ) -> Family:
+        """Labeled gauge series (per-program MFU, per-SLO burn rate)."""
+        return self._family(Gauge, name, help, label_name)
+
+    def counter_family(
+        self, name: str, help: str = "", label_name: str = "name"
+    ) -> Family:
+        """Labeled counter series (stall events by reason)."""
+        return self._family(Counter, name, help, label_name)
 
     def get(self, name: str):
         return self._instruments.get(name)

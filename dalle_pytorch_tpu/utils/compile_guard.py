@@ -56,8 +56,12 @@ def _install_listener() -> None:
             # '/jax/core/compile/backend_compile_duration' et al.
             if _COMPILE_EVENT_SUFFIX in name:
                 global _compile_count
-                _compile_count += 1
-                _recent_events.append(name)
+                # the deque append is guarded so `recent_events()` can
+                # snapshot from other threads (the vitals state dump);
+                # compiles are rare, the lock is noise
+                with _lock:
+                    _compile_count += 1
+                    _recent_events.append(name)
 
         jax.monitoring.register_event_duration_secs_listener(_on_event)
         _installed = True
@@ -77,6 +81,16 @@ def compile_count() -> int:
     listener; 0 forever before that — readers treat it as a delta
     source, not an absolute truth)."""
     return _compile_count
+
+
+def recent_events() -> List[str]:
+    """The most recent compile event names (bounded window) — engine-state
+    dumps (`/debug/state`) and stall reports include them so an unexpected
+    mid-serve compile is identifiable without a guard block in place.
+    Snapshot under the lock: the listener appends from whichever thread
+    compiles."""
+    with _lock:
+        return list(_recent_events)
 
 
 class RecompileError(AssertionError):

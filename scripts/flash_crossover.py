@@ -39,8 +39,11 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-V5E_PEAK_FLOPS = 197e12
-V5E_HBM_BPS = 819e9
+# shared with the live serving-side accounting (obs/vitals.py:
+# ProgramCostTable) so offline and live rooflines cannot drift
+from dalle_pytorch_tpu.obs.vitals import (  # noqa: E402
+    V5E_HBM_BPS, V5E_PEAK_FLOPS, extract_cost,
+)
 #: in-program Mosaic kernel overhead per pallas_call (grid setup; NOT a
 #: host dispatch — the kernel runs inside the jitted step)
 KERNEL_OVERHEAD_S = 5e-6
@@ -67,8 +70,7 @@ def measured_dense(seq, dtype):
         .lower(q, q, q)
         .compile()
     )
-    cost = compiled.cost_analysis()
-    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    cost = extract_cost(compiled)
     return float(cost["flops"]), float(cost["bytes accessed"])
 
 

@@ -34,8 +34,11 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-V5E_PEAK_FLOPS = 197e12
-V5E_HBM_BPS = 819e9  # ~819 GB/s
+# shared with the live serving-side accounting (obs/vitals.py:
+# ProgramCostTable) so offline and live rooflines cannot drift
+from dalle_pytorch_tpu.obs.vitals import (  # noqa: E402
+    V5E_HBM_BPS, V5E_PEAK_FLOPS, extract_cost,
+)
 
 DIM, DEPTH, HEADS, DIM_HEAD = 1024, 12, 16, 64
 TEXT_SEQ, FMAP, BATCH = 256, 32, 16
@@ -92,9 +95,7 @@ def analyze(name, mode, remat_policy):
     compiled = jax.jit(step, donate_argnums=0).lower(
         state, batch, jax.random.PRNGKey(1)
     ).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):  # older jax returns [dict]
-        cost = cost[0]
+    cost = extract_cost(compiled)
     flops = float(cost.get("flops", 0.0))
     nbytes = float(cost.get("bytes accessed", 0.0))
     ai, mfu = ceiling(flops, nbytes)
@@ -143,10 +144,7 @@ def measure_attention_chain():
         return dense_attention(q, k, v, mask=mask).astype(jnp.float32).sum()
 
     compiled = jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(q, q, q).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    total = float(cost.get("bytes accessed", 0.0))
+    total = float(extract_cost(compiled).get("bytes accessed", 0.0))
     # flash's true per-layer traffic for the same math: q/k/v in, o out
     # (fwd), q/k/v/o/do in, dq/dk/dv out (bwd) + lse/delta rows
     linear = 12 * BATCH * HEADS * SEQ * DIM_HEAD * 2 + 3 * BATCH * HEADS * SEQ * 4
@@ -191,10 +189,7 @@ def decode_step_floor(batch=4):
         params, jnp.zeros((batch,), jnp.int32), jnp.zeros((), jnp.int32),
         cache,
     ).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0]
-    nbytes = float(cost.get("bytes accessed", 0.0))
+    nbytes = float(extract_cost(compiled).get("bytes accessed", 0.0))
     n_img = FMAP * FMAP
     floor_s = n_img * nbytes / V5E_HBM_BPS
     emit({

@@ -40,7 +40,10 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 # flagship geometry + v5e roofline constants: one source of truth with
-# the op-level model (hbm_model.py's module level is jax-free)
+# the op-level model (hbm_model re-exports the roofline anchors from
+# dalle_pytorch_tpu.obs.vitals — the same numbers the live serving MFU
+# gauges use; importing it pulls jax, but backends initialize lazily so
+# this stays side-effect-free)
 from hbm_model import (  # noqa: E402
     BATCH, DEPTH, DIM, DIM_HEAD, HEADS, SEQ, V5E_HBM_BPS, V5E_PEAK_FLOPS,
     VOCAB,

@@ -93,7 +93,38 @@ def parse_args(argv=None):
     p.add_argument("--no_request_log", action="store_true",
                    help="suppress the structured JSON log line per "
                    "completed request")
-    return p.parse_args(argv)
+    p.add_argument("--no_vitals", action="store_true",
+                   help="disable the engine-vitals sampler (and with it "
+                   "the stall watchdog and SLO burn tracking); "
+                   "/debug/vitals then serves an empty ring")
+    p.add_argument("--vitals_interval_s", type=float, default=1.0,
+                   help="seconds between vitals snapshots / watchdog "
+                   "checks")
+    p.add_argument("--no_program_costs", action="store_true",
+                   help="skip per-program XLA cost capture at warmup "
+                   "(saves one extra AOT compile per program; "
+                   "/debug/programs and the MFU gauges then stay empty)")
+    p.add_argument("--slo_ttft_ms", type=float, default=None,
+                   help="time-to-first-token SLO target in ms "
+                   "(continuous engine); burn rate over the rolling "
+                   "window drives the /healthz degraded tier and "
+                   "dalle_slo_burn_rate{slo=\"ttft\"}")
+    p.add_argument("--slo_request_ms", type=float, default=None,
+                   help="end-to-end request latency SLO target in ms")
+    p.add_argument("--slo_objective", type=float, default=0.99,
+                   help="fraction of requests that must meet each SLO "
+                   "target (error budget = 1 - objective)")
+    p.add_argument("--slo_window_s", type=float, default=300.0,
+                   help="rolling window for SLO burn-rate computation")
+    args = p.parse_args(argv)
+    if args.no_vitals and (
+        args.slo_ttft_ms is not None or args.slo_request_ms is not None
+    ):
+        # the sampler thread drives SLO burn updates; without it the
+        # gauge would sit at 0 forever — fail loudly, not silently
+        p.error("--slo_ttft_ms/--slo_request_ms need the vitals sampler; "
+                "drop --no_vitals")
+    return args
 
 
 def main(argv=None):
@@ -104,7 +135,10 @@ def main(argv=None):
     if _os.environ.get("DALLE_TPU_FORCE_PLATFORM"):
         jax.config.update("jax_platforms", _os.environ["DALLE_TPU_FORCE_PLATFORM"])
 
-    from dalle_pytorch_tpu.obs import ProfilerCapture, StructuredLog, Tracer
+    from dalle_pytorch_tpu.obs import (
+        EngineVitals, ProfilerCapture, ProgramCostTable, SLOTarget,
+        SLOTracker, StallWatchdog, StructuredLog, Tracer,
+    )
     from dalle_pytorch_tpu.serving import ServingServer, engine_from_checkpoint
 
     # structured JSONL on stdout replaces the old ad-hoc status prints;
@@ -128,6 +162,11 @@ def main(argv=None):
         kv_pages=args.kv_pages,
         prefix_entries=args.prefix_entries,
     )
+    if not args.no_program_costs:
+        # attach BEFORE warmup: capture happens while the ladder compiles
+        # (one extra AOT compile per program — the price of
+        # /debug/programs rows and live MFU gauges)
+        engine.cost_table = ProgramCostTable(registry=engine.registry)
     if not args.no_warmup:
         log.event("warmup_start", batch_shapes=list(engine.batch_shapes))
         engine.warmup()
@@ -135,6 +174,39 @@ def main(argv=None):
             "warmup_done",
             compiled_shapes=list(engine.stats.compiled_shapes),
         )
+
+    slo_targets = []
+    if args.slo_ttft_ms is not None:
+        slo_targets.append(SLOTarget(
+            "ttft", args.slo_ttft_ms / 1000.0,
+            histogram="dalle_serving_ttft_seconds",
+            objective=args.slo_objective,
+        ))
+    if args.slo_request_ms is not None:
+        slo_targets.append(SLOTarget(
+            "request", args.slo_request_ms / 1000.0,
+            histogram="dalle_serving_request_latency_seconds",
+            objective=args.slo_objective,
+        ))
+    vitals = EngineVitals(
+        enabled=not args.no_vitals,
+        interval_s=args.vitals_interval_s,
+        registry=engine.registry,
+        log=log,
+        watchdog=StallWatchdog(
+            registry=engine.registry,
+            # a queued head older than the request timeout should already
+            # have been failed by the worker; half of it is "stale"
+            queue_age_budget_s=args.request_timeout_s / 2.0,
+        ),
+        slo=(
+            SLOTracker(
+                slo_targets, registry=engine.registry,
+                window_s=args.slo_window_s,
+            )
+            if slo_targets else None
+        ),
+    )
 
     server = ServingServer(
         engine,
@@ -151,6 +223,7 @@ def main(argv=None):
         log_requests=not args.no_request_log,
         profiler=ProfilerCapture(out_dir=args.profile_dir),
         trace_dump_path=args.trace_dump,
+        vitals=vitals,
     )
 
     import threading
