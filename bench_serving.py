@@ -41,6 +41,13 @@ hit-vs-cold TTFT splits. `--prompt_reuse P` (SERVE_PROMPT_REUSE) makes P
 of the arrivals repeat a prompt from a Zipf-ish popularity pool — the
 workload on which prefix caching turns repeat admissions into
 near-zero-cost TTFT; both engines replay the identical prompt schedule.
+
+Mesh-sharded serving (`--mesh tp=2`, SERVE_MESH): the continuous side
+runs as `ShardedContinuousEngine` (slot layout) over a `make_mesh`
+device mesh, and its JSON line gains a `mesh` block — axis sizes,
+per-device state-buffer bytes, and the per-device memory PEAK over the
+measured window. On CPU pair it with
+XLA_FLAGS=--xla_force_host_platform_device_count=8.
 """
 
 from __future__ import annotations
@@ -365,7 +372,7 @@ def _sustained_rps(batcher, text_ids, seconds=2.5, clients=16,
     return len(done) / max(time.monotonic() - t0, 1e-9)
 
 
-def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
+def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None):
     import jax
     import numpy as np
 
@@ -374,6 +381,11 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
         ContinuousEngine, GenerationEngine, PagedContinuousEngine, SampleSpec,
     )
     from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+    assert mesh is None or kv_layout == "slot", (
+        "--mesh benches the sharded slot engine; the paged pool's mesh "
+        "split is the ROADMAP follow-on"
+    )
 
     # open-loop defaults use a LARGER toy than the closed-loop sweep
     # (dim 128 / depth 3 / 8x8 grid = 64 image tokens): on the tiny model
@@ -414,6 +426,15 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
             prefill_batch=prefill_batch, registry=MetricsRegistry(),
             page_size=page_size,
             kv_pages=int(kv_pages_env) if kv_pages_env else None,
+        )
+    elif mesh is not None:
+        from dalle_pytorch_tpu.serving.sharded import ShardedContinuousEngine
+
+        cont = ShardedContinuousEngine(
+            model=model, variables=params, vae=vae, vae_params=vae_params,
+            max_batch=max_batch, chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch, registry=MetricsRegistry(),
+            mesh_shape=mesh,
         )
     else:
         cont = ContinuousEngine(
@@ -545,6 +566,23 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot"):
         "stages": _stage_breakdown(cont.registry, cont_stages0),
         "vitals": vitals_block,
     }
+    if mesh is not None:
+        # mesh shape + per-device memory PEAK over the measured window
+        # (from the sampler's per-device memory_stats; empty on backends
+        # without memory stats — the live state-buffer split from
+        # mesh_detail still names each shard's share)
+        peaks = {}
+        for snap in vitals.recent():
+            for dev, stats in (
+                snap.get("memory_stats_per_device") or {}
+            ).items():
+                peaks[dev] = max(
+                    peaks.get(dev, 0), stats.get("bytes_in_use", 0)
+                )
+        cont_line["mesh"] = {
+            **cont.mesh_detail(),
+            "per_device_peak_bytes": peaks,
+        }
     if kv_layout == "paged":
         # HBM story: pages the measured window ACTUALLY peaked at vs the
         # slotted layout's always-resident worst case (max_batch full-length
@@ -640,10 +678,18 @@ def main():
         "splits to its JSON line; SERVE_PAGE_SIZE / SERVE_KV_PAGES size "
         "the pool)",
     )
+    p.add_argument(
+        "--mesh", type=str, default=os.environ.get("SERVE_MESH") or None,
+        help="open-loop: run the continuous side as a mesh-sharded "
+        "engine (axis=size pairs over dp/fsdp/tp/sp, e.g. 'tp=2'); the "
+        "JSON line gains a `mesh` block with axis sizes and per-device "
+        "memory peaks (slot layout only)",
+    )
     args = p.parse_args()
     if args.mode == "open-loop":
         main_open_loop(
-            prompt_reuse=args.prompt_reuse, kv_layout=args.kv_layout
+            prompt_reuse=args.prompt_reuse, kv_layout=args.kv_layout,
+            mesh=args.mesh,
         )
     else:
         main_closed_loop()

@@ -35,6 +35,17 @@ TL007  `jnp.asarray`/`jnp.array` of a LARGE host constant inside a
        once outside the loop. Size heuristic (estimated element count from
        the numpy constructor expression or a module-level constant) keeps
        small iotas/eye-size constants out of the findings.
+TL008  `shard_map` in_specs/out_specs (or a `NamedSharding` spec) naming
+       a mesh axis the enclosing mesh does not define: jax rejects the
+       spec at trace time on the real mesh — or, when specs drift after
+       an axis rename, silently stops sharding what the author thinks is
+       sharded. The typo class the mesh-sharded serving stack
+       (`serving/sharded.py`, `parallel/serving_partition.py`) makes
+       easy to write. Resolves meshes bound from literal
+       `Mesh(..., ("a", "b"))` constructors and the repo's known
+       factories (`make_mesh`, `build_serving_mesh`, `make_pp_mesh`);
+       anything else stays silent (false-negative bias, like the rest of
+       the pack).
 """
 
 from __future__ import annotations
@@ -647,6 +658,135 @@ class ScanConstUploadRule(Rule):
         return None
 
 
+#: the 4-axis `make_mesh` vocabulary (parallel/mesh.py MESH_AXES) — kept
+#: in lockstep by tests/test_analysis.py; re-declared here because the
+#: linter must never pay a jax import (analysis/core.py docstring)
+_MAKE_MESH_AXES = ("dp", "fsdp", "tp", "sp")
+#: known mesh factories -> the axis vocabulary of the mesh they build
+_MESH_FACTORY_AXES = {
+    "make_mesh": _MAKE_MESH_AXES,
+    "build_serving_mesh": _MAKE_MESH_AXES,
+    "make_pp_mesh": ("pp",),
+}
+
+
+class MeshAxisRule(Rule):
+    code = "TL008"
+    name = "mesh-axis-unknown"
+    description = (
+        "shard_map/NamedSharding partition spec naming an axis the "
+        "enclosing mesh does not define — trace-time rejection on the "
+        "real mesh, or a silent no-op shard after an axis rename"
+    )
+
+    @staticmethod
+    def _literal_axes(call: ast.Call) -> Optional[Set[str]]:
+        """Axis vocabulary of a mesh-constructing call: a literal
+        `Mesh(devs, ("a", "b"))` / `Mesh(..., axis_names=(...))`, or one
+        of the repo's known factories. None = unresolvable (silent)."""
+        fname = terminal_name(call.func)
+        if fname in _MESH_FACTORY_AXES:
+            return set(_MESH_FACTORY_AXES[fname])
+        if fname != "Mesh":
+            return None
+        cands = []
+        if len(call.args) >= 2:
+            cands.append(call.args[1])
+        cands.extend(
+            kw.value for kw in call.keywords if kw.arg == "axis_names"
+        )
+        for cand in cands:
+            if isinstance(cand, (ast.Tuple, ast.List)) and cand.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in cand.elts
+            ):
+                return {e.value for e in cand.elts}
+        return None
+
+    def _mesh_bindings(self, tree: ast.Module) -> Dict[str, Set[str]]:
+        """name -> union of axis vocabularies it was ever bound to (a
+        name rebound to different meshes unions rather than guesses —
+        conservative toward silence)."""
+        axes_of: Dict[str, Set[str]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            axes = self._literal_axes(node.value)
+            if axes is None:
+                continue
+            for t in node.targets:
+                for n in _assign_targets(t):
+                    axes_of.setdefault(n.id, set()).update(axes)
+        return axes_of
+
+    def _resolve_mesh(self, expr, axes_of) -> Optional[Set[str]]:
+        if isinstance(expr, ast.Name):
+            return axes_of.get(expr.id)
+        if isinstance(expr, ast.Call):
+            return self._literal_axes(expr)
+        return None  # attribute/param meshes: silent
+
+    @staticmethod
+    def _spec_calls(expr: ast.AST) -> Iterator[ast.Call]:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and terminal_name(node.func) in (
+                "P", "PartitionSpec",
+            ):
+                yield node
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        axes_of = self._mesh_bindings(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = terminal_name(node.func)
+            if fname == "shard_map":
+                mesh_expr = next(
+                    (kw.value for kw in node.keywords if kw.arg == "mesh"),
+                    None,
+                )
+                spec_exprs = [
+                    kw.value for kw in node.keywords
+                    if kw.arg in ("in_specs", "out_specs")
+                ]
+            elif fname == "NamedSharding":
+                mesh_expr = node.args[0] if node.args else next(
+                    (kw.value for kw in node.keywords if kw.arg == "mesh"),
+                    None,
+                )
+                spec_exprs = list(node.args[1:]) + [
+                    kw.value for kw in node.keywords if kw.arg == "spec"
+                ]
+            else:
+                continue
+            if mesh_expr is None:
+                continue
+            axes = self._resolve_mesh(mesh_expr, axes_of)
+            if not axes:
+                continue
+            for spec_call in (
+                c for e in spec_exprs for c in self._spec_calls(e)
+            ):
+                names = {
+                    n.value
+                    for arg in spec_call.args
+                    for n in ast.walk(arg)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str)
+                }
+                unknown = sorted(names - axes)
+                if unknown:
+                    yield ctx.finding(
+                        self.code, spec_call,
+                        f"partition spec names axis(es) "
+                        f"{', '.join(repr(u) for u in unknown)} not "
+                        f"defined by the enclosing mesh "
+                        f"(axes: {sorted(axes)})",
+                    )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -655,4 +795,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     DtypeDriftRule(),
     DebuggerArtifactRule(),
     ScanConstUploadRule(),
+    MeshAxisRule(),
 )

@@ -47,6 +47,7 @@ from dalle_pytorch_tpu.ops.pallas_decode import (
     flash_decode_attention,
     paged_decode_attention,
     paged_gather,
+    sharded_flash_decode_attention,
 )
 from dalle_pytorch_tpu.ops.rotary import apply_rotary
 
@@ -110,6 +111,15 @@ class Attention(nn.Module):
     # kernel; plain causal/full only) | "ring" | "auto"
     attn_impl: str = "auto"
     sp_mesh: Any = None  # Mesh with an "sp" axis, required for attn_impl="ring"
+    # serving mesh for the SHARDED flash-decode dispatch: a Pallas call is
+    # a single-device program GSPMD cannot partition, so when the sharded
+    # continuous engine sets this the cached flash path runs
+    # ops/pallas_decode.py:sharded_flash_decode_attention (shard_map over
+    # `decode_heads_axis`, heads split — bit-identical to unsharded).
+    # The axis must match the one the engine's KV-cache shardings use
+    # (ShardedContinuousEngine clones the model with its model_axis).
+    decode_mesh: Any = None
+    decode_heads_axis: str = "tp"
     dtype: Any = jnp.float32
 
     def _use_flash(self, n: int, key_mask) -> bool:
@@ -263,6 +273,11 @@ class Attention(nn.Module):
                 if paged:
                     out = paged_decode_attention(
                         q, ck, cv, lengths, pt, max_len
+                    )
+                elif self.decode_mesh is not None:
+                    out = sharded_flash_decode_attention(
+                        self.decode_mesh, q, ck, cv, lengths,
+                        head_axis=self.decode_heads_axis,
                     )
                 else:
                     out = flash_decode_attention(q, ck, cv, lengths)

@@ -109,6 +109,12 @@ class DALLE(nn.Module):
     img_loss_coeff_inv: float = 1.0
     attn_impl: str = "auto"  # "dense" | "flash" | "ring" | "auto"
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
+    # serving mesh handed down to the cached flash-decode dispatch
+    # (models/attention.py): set by the sharded continuous engine so the
+    # Pallas kernel splits per head over `decode_heads_axis` — the same
+    # axis the engine's KV-cache shardings use
+    decode_mesh: Any = None
+    decode_heads_axis: str = "tp"
     # layer executor: "unrolled" | "scan" (one compiled layer body,
     # ~depth× smaller program; see models/transformer.py docstring)
     executor: str = "unrolled"
@@ -159,6 +165,8 @@ class DALLE(nn.Module):
             remat_policy=self.remat_policy,
             attn_impl=self.attn_impl,
             sp_mesh=self.sp_mesh,
+            decode_mesh=self.decode_mesh,
+            decode_heads_axis=self.decode_heads_axis,
             executor=self.executor,
             dtype=self.dtype,
         )

@@ -195,6 +195,8 @@ class _ScanBlock(nn.Module):
     image_fmap_size: Optional[int]
     attn_impl: str
     sp_mesh: Any
+    decode_mesh: Any
+    decode_heads_axis: str
     deterministic: bool
     dtype: Any
 
@@ -229,6 +231,8 @@ class _ScanBlock(nn.Module):
             static_mask=None,
             attn_impl=self.attn_impl,
             sp_mesh=self.sp_mesh,
+            decode_mesh=self.decode_mesh,
+            decode_heads_axis=self.decode_heads_axis,
             dtype=self.dtype,
             name="attn",
         )(h, key_mask=key_mask, rotary=rotary,
@@ -340,6 +344,8 @@ class Transformer(nn.Module):
     remat_policy: Optional[str] = None
     attn_impl: str = "auto"  # "dense" | "flash" | "ring" | "auto"
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
+    decode_mesh: Any = None  # serving mesh for sharded flash decode
+    decode_heads_axis: str = "tp"  # mesh axis the kernel splits heads over
     # "unrolled" | "scan" — see module docstring. "scan" compiles one layer
     # body instead of `depth` copies; masked attn types run as dense with
     # depth-stacked scanned pattern masks; cached decode is native,
@@ -418,6 +424,8 @@ class Transformer(nn.Module):
                     ),
                     attn_impl=self.attn_impl,
                     sp_mesh=self.sp_mesh,
+                    decode_mesh=self.decode_mesh,
+                    decode_heads_axis=self.decode_heads_axis,
                     dtype=self.dtype,
                     name=f"attn_{attn_id}",
                 )
@@ -564,6 +572,8 @@ class Transformer(nn.Module):
             image_fmap_size=self.image_fmap_size,
             attn_impl=self.attn_impl,
             sp_mesh=self.sp_mesh,
+            decode_mesh=self.decode_mesh,
+            decode_heads_axis=self.decode_heads_axis,
             dtype=self.dtype,
         )
 

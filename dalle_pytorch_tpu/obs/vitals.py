@@ -22,7 +22,10 @@ healthy and well-utilized" — with four cooperating pieces:
   * `EngineVitals` — a background sampler thread snapshotting queue
     depth, slots/blocks active, prefix-cache occupancy, the age of the
     dispatch currently in flight, and `device.memory_stats()` (when the
-    backend provides it) into a bounded ring, exported as
+    backend provides it; per-device across the engine's mesh for the
+    sharded engine, rolled up into one payload + a
+    `dalle_serving_hbm_bytes{device=}` gauge per shard) into a bounded
+    ring, exported as
     `GET /debug/vitals` JSON time-series plus `/metrics` gauges. The
     device seam (`_device_memory_stats`) is an overridable hook so tests
     stub it. Zero-overhead-when-off is a counter-gated contract like the
@@ -681,7 +684,19 @@ class EngineVitals:
             except Exception:  # without jax, deltas just stay 0
                 pass
         self._m_inflight_age = self._m_head_age = self._m_mem = None
+        self._m_hbm = None
         if self.enabled and registry is not None:
+            # per-shard HBM gauge family: a mesh-sharded engine has one
+            # device PER SHARD, and "the device is full" is useless until
+            # it names which one — label by device so dashboards and the
+            # watchdog postmortem identify the sick shard
+            self._m_hbm = registry.gauge_family(
+                "dalle_serving_hbm_bytes",
+                "device memory_stats() bytes_in_use per mesh device "
+                "(one series per shard; absent when the backend doesn't "
+                "report memory stats)",
+                label_name="device",
+            )
             self._m_inflight_age = registry.gauge(
                 "dalle_serving_dispatch_inflight_age_seconds",
                 "age of the engine dispatch currently in flight (0 when "
@@ -787,6 +802,31 @@ class EngineVitals:
         except Exception:
             return None
 
+    def _device_memory_stats_all(self) -> Dict[str, Dict]:
+        """Overridable per-shard seam: `memory_stats()` for EVERY device
+        of the engine's mesh, keyed 'platform:id'. PR 7's sampler read
+        one process-local device; a mesh-sharded engine has one device
+        per shard, and a lopsided shard (bad partition rule, leaked
+        buffer) is invisible in a single-device read.
+
+        Without a mesh this routes through the legacy single-device seam
+        (`_device_memory_stats`) — ONE query per tick, and tests that
+        stub the legacy seam keep their no-real-device-touch contract on
+        every backend, not just ones whose memory_stats is empty."""
+        mesh = getattr(self._engine, "mesh", None)
+        if mesh is None:
+            stats = self._device_memory_stats()
+            return {"device:0": stats} if stats else {}
+        out: Dict[str, Dict] = {}
+        try:
+            for d in mesh.devices.flat:
+                stats = d.memory_stats()
+                if stats:
+                    out[f"{d.platform}:{d.id}"] = stats
+        except Exception:
+            return out
+        return out
+
     def sample(self) -> Dict:
         """One vitals snapshot from host state (never dispatches)."""
         snap: Dict = {"ts": round(time.time(), 3)}
@@ -811,12 +851,25 @@ class EngineVitals:
                 snap["prefix_entries"] = len(kv.cache)
         snap["dispatch_inflight"] = self.inflight()
         snap["compile_count"] = compile_guard.compile_count()
-        mem = self._device_memory_stats()
-        if mem:
-            snap["memory_stats"] = {
-                k: int(v) for k, v in mem.items()
-                if isinstance(v, (int, float))
+        per_dev = self._device_memory_stats_all()
+        if per_dev:
+            snap["memory_stats_per_device"] = {
+                dev: {
+                    k: int(v) for k, v in stats.items()
+                    if isinstance(v, (int, float))
+                }
+                for dev, stats in per_dev.items()
             }
+            snap["bytes_in_use_total"] = sum(
+                s.get("bytes_in_use", 0)
+                for s in snap["memory_stats_per_device"].values()
+            )
+            # the legacy single-device block is the FIRST device's stats
+            # — derived, not re-queried (one memory_stats pass per device
+            # per tick, not two for device 0)
+            snap["memory_stats"] = next(
+                iter(snap["memory_stats_per_device"].values())
+            )
         return snap
 
     def tick(self) -> Dict:
@@ -835,6 +888,11 @@ class EngineVitals:
             self._m_mem.set(
                 (snap.get("memory_stats") or {}).get("bytes_in_use", 0)
             )
+        if self._m_hbm is not None:
+            for dev, stats in (
+                snap.get("memory_stats_per_device") or {}
+            ).items():
+                self._m_hbm.labels(dev).set(stats.get("bytes_in_use", 0))
         if self.watchdog is not None:
             self.watchdog.check(snap, self._wall_ema)
         if self.slo is not None:
@@ -876,6 +934,12 @@ class EngineVitals:
             "summary": self.window_summary(),
             "samples": self.recent(n),
         }
+        mesh_detail = getattr(self._engine, "mesh_detail", None)
+        if mesh_detail is not None:
+            # sharded engine: one rolled-up payload names every shard —
+            # axis geometry + live per-device buffer bytes — next to the
+            # per-device memory_stats the samples carry
+            out["mesh"] = mesh_detail()
         if self.watchdog is not None:
             out["stalls"] = self.watchdog.recent_stalls()
         if self.slo is not None:

@@ -353,3 +353,50 @@ def paged_decode_attention(
     return paged_flash_decode_attention(
         q, k_pages, v_pages, lengths, page_table, sm_scale=sm_scale
     )
+
+
+# ------------------------------------------------- mesh-sharded dispatch
+#
+# A Pallas call is a single-device program: GSPMD cannot partition it, so
+# a mesh-sharded serving engine must split the kernel EXPLICITLY. Heads
+# are the natural cut (SNIPPETS.md [1]: shard_map-wrapped flash/paged
+# attention with P(data, model, ...) specs): decode attention is
+# head-independent, so each device runs the unmodified kernel over its
+# own head shard and the concatenation over heads is exact — the sharded
+# kernel is bit-for-bit the unsharded one, preserving the serving
+# stack's decode-composition-invariance contract.
+
+
+def sharded_flash_decode_attention(
+    mesh,
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    head_axis: str = "tp",
+    sm_scale: Optional[float] = None,
+):
+    """`flash_decode_attention` split over `head_axis` of `mesh` via
+    shard_map (`parallel/mesh.py`'s compat wrapper keeps it running on
+    jax 0.4.37). Heads that don't divide the axis fall back to the
+    unsharded kernel — same drop-to-replicated posture as
+    `serving_partition`'s divisibility rule."""
+    from dalle_pytorch_tpu.parallel.mesh import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    h = q.shape[1]
+    # a mesh without the axis (custom caller-built meshes) falls back
+    # unsharded rather than raising at trace time inside the chunk program
+    axis_n = dict(mesh.shape).get(head_axis, 1)
+    if axis_n == 1 or h % axis_n != 0:
+        return flash_decode_attention(q, k, v, lengths, sm_scale=sm_scale)
+    spec = P(None, head_axis, None, None)
+    fn = shard_map(
+        functools.partial(flash_decode_attention, sm_scale=sm_scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, P()),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, lengths)

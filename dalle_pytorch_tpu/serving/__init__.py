@@ -11,6 +11,12 @@ queue feeding fixed-shape compiled sampler programs.
     `max_batch` cache slots advanced in K-token chunks, prompts admitted
     into free slots at token boundaries in batched prefill waves
     (`models/dalle.py:prefill_into_slots` / `decode_image_chunk`).
+  * `sharded.py`  — `ShardedContinuousEngine`: the same continuous
+    engine spread over a `make_mesh` device mesh — params per
+    `parallel/partition.py`'s rules, the slot KV cache head-split per
+    `parallel/serving_partition.py`, the flash-decode kernel
+    shard_map-split per head. Same program bodies, same serving
+    surface, bit-identical tokens; `serve.py --mesh dp=1,tp=4`.
   * `batcher.py`  — `MicroBatcher`: bounded queue with dynamic
     micro-batching (flush on max-batch or deadline), backpressure via
     queue-full rejection, per-request timeout/cancellation, graceful
@@ -39,6 +45,11 @@ from dalle_pytorch_tpu.serving.engine import (
     SlotAllocator,
     engine_from_checkpoint,
 )
+from dalle_pytorch_tpu.serving.sharded import (
+    ShardedContinuousEngine,
+    build_serving_mesh,
+    parse_mesh_shape,
+)
 from dalle_pytorch_tpu.serving.batcher import (
     ContinuousBatcher,
     MicroBatcher,
@@ -62,4 +73,7 @@ __all__ = [
     "RequestTimeout",
     "ShuttingDownError",
     "ServingServer",
+    "ShardedContinuousEngine",
+    "build_serving_mesh",
+    "parse_mesh_shape",
 ]

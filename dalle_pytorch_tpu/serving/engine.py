@@ -510,6 +510,21 @@ class ContinuousEngine(GenerationEngine):
             self._state = self._fresh_state()
             raise
 
+    def _prefill_op(self, s, texts, slots, seeds, temps, keep):
+        """One batched-prefill dispatch over state `s` (subclass hook —
+        the sharded engine runs its sharding-pinned program here)."""
+        from dalle_pytorch_tpu.models.dalle import prefill_into_slots
+
+        return prefill_into_slots(
+            self.model, self.variables, s, texts, slots, seeds, temps, keep
+        )
+
+    def _release_op(self, s, mask):
+        """One slot-release dispatch (same subclass seam)."""
+        from dalle_pytorch_tpu.models.dalle import release_slots
+
+        return release_slots(self.model, s, mask)
+
     def prefill_slots(  # tracelint: hotloop
         self,
         assignments: Sequence[Tuple[int, SampleSpec]],
@@ -520,8 +535,6 @@ class ContinuousEngine(GenerationEngine):
         pair — the duplicate rows re-write the same slot with identical
         content (see `models/dalle.py:prefill_into_slots`), so every
         admission, single or batched, runs the SAME compiled program."""
-        from dalle_pytorch_tpu.models.dalle import prefill_into_slots
-
         n = len(assignments)
         assert 1 <= n <= self.prefill_batch, (
             f"{n} assignments exceed prefill_batch={self.prefill_batch}; "
@@ -539,9 +552,8 @@ class ContinuousEngine(GenerationEngine):
             t0 = time.perf_counter()
             self.vitals.dispatch_begin("prefill")
             try:
-                self._replace_state(lambda s: prefill_into_slots(
-                    self.model, self.variables, s, texts, slots, seeds, temps,
-                    keep,
+                self._replace_state(lambda s: self._prefill_op(
+                    s, texts, slots, seeds, temps, keep,
                 ))
             finally:
                 wall = time.perf_counter() - t0
@@ -549,6 +561,8 @@ class ContinuousEngine(GenerationEngine):
             if _warmup:
                 # after the dispatch (see GenerationEngine.generate: a
                 # pre-dispatch lowering would poison the sampler cache)
+                from dalle_pytorch_tpu.models.dalle import prefill_into_slots
+
                 self._capture_cost(
                     "prefill",
                     lambda v, s, t, sl, se, tm, k: prefill_into_slots(
@@ -661,8 +675,6 @@ class ContinuousEngine(GenerationEngine):
         """Deactivate `slots` so the chunk step stops touching them — after
         harvest, or wholesale on an error reset (which must not count
         toward `rows_generated`; only harvests do)."""
-        from dalle_pytorch_tpu.models.dalle import release_slots
-
         mask = np.zeros(self.max_batch, bool)
         mask[list(slots)] = True
         with self._lock:
@@ -670,7 +682,7 @@ class ContinuousEngine(GenerationEngine):
             self.vitals.dispatch_begin("release")
             try:
                 self._replace_state(
-                    lambda s: release_slots(self.model, s, mask)
+                    lambda s: self._release_op(s, mask)
                 )
             finally:
                 self.vitals.dispatch_end(
@@ -1318,6 +1330,7 @@ def engine_from_checkpoint(
     page_size: int = 32,
     kv_pages: Optional[int] = None,
     prefix_entries: int = 64,
+    mesh=None,
 ):
     """Build a serving engine from a single-file DALLE checkpoint.
 
@@ -1327,12 +1340,19 @@ def engine_from_checkpoint(
     `kv_layout="paged"` upgrades it to the block-paged
     `PagedContinuousEngine` (`page_size` tokens per page, `kv_pages` pool
     size or None for the slotted-equivalent worst case, `prefix_entries`
-    cached prompts). The loading
+    cached prompts). `mesh` (a `parse_mesh_shape` string/dict, or a ready
+    jax Mesh) selects the mesh-sharded `ShardedContinuousEngine` —
+    slot layout only (the paged pool's mesh split is the ROADMAP
+    follow-on). The loading
     sequence (VAE reconstruction, tokenizer, ring-attention downgrade for
     decode) was lifted from `generate.py`, which now calls this instead —
     CLI and server share one code path by construction.
     """
     assert mode in ("micro", "continuous"), f"unknown engine mode {mode!r}"
+    assert mesh is None or (mode == "continuous" and kv_layout == "slot"), (
+        "--mesh needs the continuous engine with the slot kv layout "
+        "(sharding the paged pool is the ROADMAP item 1 follow-on)"
+    )
     from pathlib import Path
 
     from dalle_pytorch_tpu.training.pipeline import (
@@ -1397,6 +1417,19 @@ def engine_from_checkpoint(
             if kv_layout == "paged"
             else {}
         )
+        if mesh is not None:
+            from dalle_pytorch_tpu.serving.sharded import (
+                ShardedContinuousEngine,
+            )
+
+            cls = ShardedContinuousEngine
+            try:
+                from jax.sharding import Mesh
+
+                is_mesh = isinstance(mesh, Mesh)
+            except Exception:  # pragma: no cover - jax always importable here
+                is_mesh = False
+            paged_kw = dict(mesh=mesh) if is_mesh else dict(mesh_shape=mesh)
         return cls(
             max_batch=max(int(b) for b in batch_shapes),
             chunk_tokens=chunk_tokens,

@@ -587,6 +587,12 @@ class ServingServer:
                 # paged engine: block-pool occupancy + prefix-cache size,
                 # the new resource axis a capacity dashboard needs
                 detail["kv"] = kv_detail()
+            mesh_detail = getattr(self.engine, "mesh_detail", None)
+            if mesh_detail is not None:
+                # sharded engine: axis names/sizes + per-device buffer
+                # bytes, so a probe (and the watchdog's stall dump, which
+                # rides engine.state_dump) names the sick shard
+                detail["mesh"] = mesh_detail()
         if err is not None:
             detail["last_error"] = repr(err)
             if err_age is not None:

@@ -68,6 +68,15 @@ def parse_args(argv=None):
     p.add_argument("--prefix_entries", type=int, default=64,
                    help="paged layout: prompts kept in the prefix cache "
                    "(0 disables prefix caching; LRU eviction)")
+    p.add_argument("--mesh", type=str, default=None, metavar="AXES",
+                   help="serve one engine SHARDED over a device mesh "
+                   "(continuous engine, slot layout): axis=size pairs "
+                   "over dp/fsdp/tp/sp, e.g. 'dp=1,tp=4'; one size may "
+                   "be -1 to absorb the remaining devices. Params shard "
+                   "per parallel/partition.py, the slot KV cache over "
+                   "attention heads (parallel/serving_partition.py). "
+                   "CPU smoke test: XLA_FLAGS="
+                   "--xla_force_host_platform_device_count=8")
     p.add_argument("--max_queue", type=int, default=64,
                    help="queue bound in rows; beyond it requests get 503")
     p.add_argument("--request_timeout_s", type=float, default=120.0)
@@ -117,6 +126,19 @@ def parse_args(argv=None):
     p.add_argument("--slo_window_s", type=float, default=300.0,
                    help="rolling window for SLO burn-rate computation")
     args = p.parse_args(argv)
+    if args.mesh is not None:
+        # fail at parse time, not after the checkpoint loads: both the
+        # engine/layout combination and the mesh string itself
+        if args.engine != "continuous" or args.kv_layout != "slot":
+            p.error("--mesh needs --engine continuous with --kv_layout "
+                    "slot (sharding the paged pool is the ROADMAP "
+                    "follow-on)")
+        from dalle_pytorch_tpu.serving.sharded import parse_mesh_shape
+
+        try:
+            parse_mesh_shape(args.mesh)
+        except (AssertionError, ValueError) as exc:
+            p.error(f"bad --mesh {args.mesh!r}: {exc}")
     if args.no_vitals and (
         args.slo_ttft_ms is not None or args.slo_request_ms is not None
     ):
@@ -161,6 +183,7 @@ def main(argv=None):
         page_size=args.page_size,
         kv_pages=args.kv_pages,
         prefix_entries=args.prefix_entries,
+        mesh=args.mesh,
     )
     if not args.no_program_costs:
         # attach BEFORE warmup: capture happens while the ladder compiles

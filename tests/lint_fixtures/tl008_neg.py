@@ -1,0 +1,41 @@
+"""TL008 negative fixture: known axes, factory-built meshes, tuple
+axis groups, empty specs, and unresolvable meshes (silent by design)."""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from dalle_pytorch_tpu.parallel.mesh import make_mesh
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("data", "model"))
+mesh4 = make_mesh(dp=2, tp=4)
+
+
+def body(q, k):
+    return q + k
+
+
+ok = shard_map(
+    body,
+    mesh=mesh,
+    # axis groups inside tuples resolve too
+    in_specs=(P("data", "model"), P(("data", "model"), None)),
+    out_specs=P("data", None),
+)
+
+ok4 = shard_map(
+    body, mesh=mesh4, in_specs=(P("dp", "tp"), P()), out_specs=P("dp"),
+)
+
+replicated = NamedSharding(mesh, P())
+
+
+def wrapped(unknown_mesh, spec):
+    # a mesh the rule cannot resolve (parameter) stays silent, even with
+    # an axis name no mesh here defines — false-negative bias; so does a
+    # spec built elsewhere
+    fn = shard_map(
+        body, mesh=unknown_mesh, in_specs=(P("wat"), P()), out_specs=P("wat"),
+    )
+    return fn, NamedSharding(unknown_mesh, spec)

@@ -1,0 +1,26 @@
+"""TL008 positive fixture: partition specs naming axes the enclosing
+mesh does not define. The mesh is bound from a LITERAL axis tuple, so
+the rule can resolve its vocabulary ("data", "model")."""
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("data", "model"))
+
+
+def body(q, k):
+    return q + k
+
+
+sharded = shard_map(
+    body,
+    mesh=mesh,
+    # "dp" belongs to the 4-axis make_mesh vocabulary, not THIS mesh
+    in_specs=(P("data", "model"), P("dp", None)),
+    out_specs=P("data", "tensor"),  # "tensor" is nobody's axis
+)
+
+# the classic rename drift: "model" misspelled survives until trace time
+sharding = NamedSharding(mesh, P("modle"))

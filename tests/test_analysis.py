@@ -47,6 +47,7 @@ class TestRuleCorpus:
             ("models/tl005_pos.py", "TL005", 3),
             ("tl006_pos.py", "TL006", 4),
             ("tl007_pos.py", "TL007", 3),
+            ("tl008_pos.py", "TL008", 3),
         ],
     )
     def test_positive_fixture_caught(self, fixture, code, expected):
@@ -71,6 +72,7 @@ class TestRuleCorpus:
             "models/tl005_neg.py",
             "tl006_neg.py",
             "tl007_neg.py",
+            "tl008_neg.py",
         ],
     )
     def test_negative_fixture_clean(self, fixture):
@@ -119,6 +121,38 @@ class TestRuleCorpus:
         at = tmp_path / "at.py"
         at.write_text(template.format(count=n))
         assert codes(lint_paths([at])) == ["TL007"]
+
+    def test_tl008_axis_vocab_in_lockstep_with_mesh(self):
+        """The rule's hardcoded make_mesh vocabulary (the linter never
+        imports jax) must track parallel/mesh.py's MESH_AXES — a renamed
+        axis would silently rot the factory resolution."""
+        from dalle_pytorch_tpu.analysis.rules import _MAKE_MESH_AXES
+        from dalle_pytorch_tpu.parallel.mesh import MESH_AXES
+
+        assert tuple(_MAKE_MESH_AXES) == tuple(MESH_AXES)
+
+    def test_tl008_factory_and_inline_mesh_resolution(self, tmp_path):
+        """make_mesh-built meshes resolve to the 4-axis vocabulary; an
+        inline Mesh(...) constructor resolves without a name binding."""
+        f = tmp_path / "factory.py"
+        f.write_text(textwrap.dedent(
+            """\
+            import numpy as np
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+            from dalle_pytorch_tpu.parallel.mesh import make_mesh
+
+            m = make_mesh(tp=2)
+            bad = NamedSharding(m, P("model"))
+            also_bad = NamedSharding(
+                Mesh(np.asarray(jax.devices()), ("x",)), P("y")
+            )
+            fine = NamedSharding(m, P("tp", "fsdp"))
+            """
+        ))
+        result = lint_paths([f])
+        assert codes(result) == ["TL008", "TL008"]
+        assert "'model'" in result.findings[0].message
 
 
 # --------------------------------------------------------- severity tiers

@@ -1,0 +1,370 @@
+"""Mesh-sharded serving: partition-spec rules, the sharded flash-decode
+kernel, and `ShardedContinuousEngine` parity with the single-device
+engine on the virtual 8-device CPU mesh (conftest forces
+--xla_force_host_platform_device_count=8).
+
+The load-bearing contract extends PR 2's decode-composition invariance
+ACROSS THE MESH: a request's tokens are bit-identical whether the
+engine's params/KV cache live on one device or are spread over a
+`make_mesh` tp axis. It holds because every split the serving partition
+rules make is reduction-free at the point of the split — heads are
+independent in attention, vocab columns are independent in the logits
+head — and the flash kernel's head split runs the unmodified
+single-device kernel per shard.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dalle_pytorch_tpu.models.dalle import (
+    DALLE,
+    init_paged_slot_state,
+    init_slot_state,
+)
+from dalle_pytorch_tpu.parallel.serving_partition import (
+    decode_state_shardings,
+    serving_variables_shardings,
+)
+from dalle_pytorch_tpu.serving.engine import ContinuousEngine, SampleSpec
+from dalle_pytorch_tpu.serving.sharded import (
+    ShardedContinuousEngine,
+    build_serving_mesh,
+    parse_mesh_shape,
+)
+from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+TEXT_SEQ = 8
+FMAP = 4
+IMG_SEQ = FMAP * FMAP
+
+
+def _model(**kw):
+    base = dict(
+        dim=32, depth=2, heads=2, dim_head=8,
+        num_image_tokens=32, image_fmap_size=FMAP,
+        num_text_tokens=64, text_seq_len=TEXT_SEQ,
+        shift_tokens=True, rotary_emb=True,
+    )
+    base.update(kw)
+    return DALLE(**base)
+
+
+def _params(model):
+    text = jnp.zeros((1, TEXT_SEQ), jnp.int32)
+    toks = jnp.zeros((1, model.image_seq_len), jnp.int32)
+    return jax.jit(model.init)(jax.random.PRNGKey(42), text, toks)
+
+
+def spec(seed, temperature=1.0, top_k=0.9):
+    ids = np.zeros(TEXT_SEQ, np.int32)
+    ids[:3] = (5, 6, 7)
+    return SampleSpec(ids, seed=seed, temperature=temperature, top_k=top_k)
+
+
+def _drain(engine, max_chunks=32):
+    for _ in range(max_chunks):
+        pos, act = engine.step_chunk()
+        if (pos[act] >= engine.image_seq_len).all():
+            return pos, act
+    raise AssertionError("decode never finished")
+
+
+def _flat_specs(shardings):
+    return {
+        "/".join(str(getattr(p, "key", p)) for p in path): s.spec
+        for path, s in jax.tree_util.tree_flatten_with_path(shardings)[0]
+    }
+
+
+# ------------------------------------------------------ partition rules
+
+
+class TestServingPartitionRules:
+    def test_kv_heads_shard_over_tp(self):
+        model = _model(heads=2)
+        mesh = build_serving_mesh({"tp": 2})
+        state = init_slot_state(model, 4)
+        flat = _flat_specs(decode_state_shardings(state, mesh))
+        k = next(v for p, v in flat.items() if p.endswith("attn/k"))
+        assert k == P(None, "tp")  # [B, H, L, dh]: heads split
+        v = next(v for p, v in flat.items() if p.endswith("attn/v"))
+        assert v == P(None, "tp")
+
+    def test_scan_executor_adds_depth_axis(self):
+        model = _model(heads=2, executor="scan")
+        mesh = build_serving_mesh({"tp": 2})
+        state = init_slot_state(model, 4)
+        flat = _flat_specs(decode_state_shardings(state, mesh))
+        k = next(v for p, v in flat.items() if p.endswith("attn/k"))
+        assert k == P(None, None, "tp")  # [depth, B, H, L, dh]
+
+    def test_paged_pool_heads_shard_pages_stay_whole(self):
+        """Paged layout: the page axis must NOT shard (the host page
+        table addresses physical pages globally); heads still split."""
+        model = _model(heads=2)
+        mesh = build_serving_mesh({"tp": 2})
+        state = init_paged_slot_state(model, 4, n_pages=8, page_size=8)
+        flat = _flat_specs(decode_state_shardings(state, mesh))
+        k = next(v for p, v in flat.items() if p.endswith("attn/k"))
+        assert k == P(None, "tp")  # [P, H, page, dh]: pages replicated
+
+    def test_nondivisible_heads_fall_back_to_replicated(self):
+        """A 2-head model on a 8-way tp axis cannot split heads — the
+        divisibility fallback drops to replicated instead of erroring."""
+        model = _model(heads=2)
+        mesh = build_serving_mesh({"tp": 8})
+        state = init_slot_state(model, 4)
+        flat = _flat_specs(decode_state_shardings(state, mesh))
+        k = next(v for p, v in flat.items() if p.endswith("attn/k"))
+        assert k == P()
+
+    def test_row_scalars_replicated(self):
+        """Per-row control state must replicate: the chunk-boundary host
+        snapshot (img_pos, active) is the retirement decision's input and
+        must stay a local read."""
+        model = _model()
+        mesh = build_serving_mesh({"tp": 2})
+        state = init_slot_state(model, 4)
+        flat = _flat_specs(decode_state_shardings(state, mesh))
+        for key in ("img_pos", "active", "seeds", "temps", "keep_k",
+                    "img_tokens"):
+            assert flat[key] == P(), key
+        idx = next(v for p, v in flat.items() if p.endswith("attn/index"))
+        assert idx == P()
+
+    def test_pending_logits_vocab_sharded(self):
+        model = _model()  # total_tokens = 64 + 8 + 32 = 104, % 2 == 0
+        mesh = build_serving_mesh({"tp": 2})
+        state = init_slot_state(model, 4)
+        flat = _flat_specs(decode_state_shardings(state, mesh))
+        assert flat["row"] == P(None, "tp")
+
+    def test_variables_follow_partition_rules(self):
+        model = _model()
+        mesh = build_serving_mesh({"tp": 2})
+        variables = _params(model)
+        flat = _flat_specs(serving_variables_shardings(variables, mesh))
+        qkv = next(v for p, v in flat.items() if "to_qkv/kernel" in p)
+        assert qkv == P("fsdp", "tp")
+
+
+# ----------------------------------------------------------- mesh flags
+
+
+class TestMeshFlags:
+    def test_parse_axis_pairs(self):
+        assert parse_mesh_shape("dp=2,tp=4") == {"dp": 2, "tp": 4}
+        assert parse_mesh_shape(" tp=-1 ") == {"tp": -1}
+        assert parse_mesh_shape(None) == {"tp": -1}
+
+    def test_parse_rejects_unknown_axis(self):
+        with pytest.raises(AssertionError):
+            parse_mesh_shape("pp=2")
+        with pytest.raises(AssertionError):
+            parse_mesh_shape("2,4")
+
+    def test_parse_rejects_nonpositive_sizes(self):
+        """tp=0 or tp=-2 must die at parse time — build_serving_mesh's
+        device-prefix math would otherwise accept an empty mesh and blow
+        up only after the checkpoint loads."""
+        with pytest.raises(AssertionError):
+            parse_mesh_shape("tp=0")
+        with pytest.raises(AssertionError):
+            parse_mesh_shape("tp=-2")
+        with pytest.raises(AssertionError):
+            build_serving_mesh({"tp": 0})
+
+    def test_build_uses_prefix_of_devices(self):
+        mesh = build_serving_mesh({"tp": 2})
+        assert dict(mesh.shape) == {"dp": 1, "fsdp": 1, "tp": 2, "sp": 1}
+        assert mesh.devices.size == 2
+
+    def test_build_absorbs_remaining_devices(self):
+        mesh = build_serving_mesh("dp=2,tp=-1")
+        n = len(jax.devices())
+        assert dict(mesh.shape)["tp"] == n // 2
+
+    def test_build_rejects_oversized_mesh(self):
+        with pytest.raises(AssertionError):
+            build_serving_mesh({"tp": 2 * len(jax.devices())})
+
+    def test_mesh_axes_in_lockstep_with_parallel_mesh(self):
+        """sharded.py re-declares the axis vocabulary so parse_mesh_shape
+        stays importable without a jax init — it must track MESH_AXES."""
+        from dalle_pytorch_tpu.parallel import mesh as pmesh
+        from dalle_pytorch_tpu.serving import sharded
+
+        assert tuple(sharded.MESH_AXES) == tuple(pmesh.MESH_AXES)
+
+
+# -------------------------------------------------- sharded flash kernel
+
+
+class TestShardedFlashDecode:
+    def test_bitwise_match_and_fallback(self):
+        """Head-split kernel == unsharded kernel BITWISE (each device
+        runs the unmodified kernel on its own heads); heads that don't
+        divide the axis fall back to the unsharded call."""
+        from dalle_pytorch_tpu.ops.pallas_decode import (
+            flash_decode_attention,
+            sharded_flash_decode_attention,
+        )
+
+        mesh = build_serving_mesh({"tp": 2})
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        b, h, s, d = 3, 4, 32, 8
+        q = jax.random.normal(k1, (b, h, 1, d))
+        k = jax.random.normal(k2, (b, h, s, d))
+        v = jax.random.normal(k3, (b, h, s, d))
+        lengths = jnp.asarray([5, 17, 32], jnp.int32)
+        want = np.asarray(flash_decode_attention(q, k, v, lengths))
+        got = np.asarray(
+            sharded_flash_decode_attention(mesh, q, k, v, lengths)
+        )
+        assert np.array_equal(want, got)
+
+        odd = np.asarray(sharded_flash_decode_attention(
+            mesh, q[:, :3], k[:, :3], v[:, :3], lengths
+        ))
+        assert np.array_equal(want[:, :3], odd)
+
+
+# ------------------------------------------------------- engine parity
+
+
+@pytest.fixture(scope="module")
+def engines():
+    """(single-device continuous, sharded tp=2) over ONE set of weights —
+    the same toy geometry as tests/test_continuous.py, so the unsharded
+    programs come out of the shared jit cache."""
+    model = _model()
+    params = _params(model)
+    cont = ContinuousEngine(
+        model=model, variables=params, max_batch=4, chunk_tokens=8,
+        registry=MetricsRegistry(),
+    )
+    sharded = ShardedContinuousEngine(
+        model=model, variables=params, max_batch=4, chunk_tokens=8,
+        registry=MetricsRegistry(), mesh=build_serving_mesh({"tp": 2}),
+    )
+    return cont, sharded
+
+
+class TestShardedParity:
+    def test_state_actually_sharded(self, engines):
+        _, sharded = engines
+        k = sharded._state["cache"]["layer_0"]["attn"]["k"]
+        assert k.sharding.spec == P(None, "tp")
+        assert len({s.device for s in k.addressable_shards}) == 2
+        assert sharded._state["img_pos"].sharding.spec == P()
+
+    def test_bit_identical_tokens_incl_midflight_admission(self, engines):
+        """The acceptance pin: same specs/seeds through both engines —
+        heterogeneous per-row sampling params, plus a mid-flight
+        admission after the first chunk — produce bit-identical tokens."""
+        cont, sharded = engines
+        first = [spec(7, 1.0, 0.9), spec(11, 0.7, 0.95), spec(13, 1.3, 0.8)]
+        late = spec(17, 0.9, 0.85)
+        results = []
+        for e in (cont, sharded):
+            for i, s in enumerate(first):
+                e.prefill_slot(i, s)
+            e.step_chunk()  # rows mid-flight...
+            e.prefill_slot(3, late)  # ...when the late row is admitted
+            _drain(e)
+            results.append(e.harvest([0, 1, 2, 3]))
+            e.release([0, 1, 2, 3])
+        assert np.array_equal(results[0], results[1])
+
+    def test_mesh_detail_names_every_shard(self, engines):
+        _, sharded = engines
+        dump = sharded.state_dump()
+        mesh = dump["mesh"]
+        assert mesh["axes"]["tp"] == 2
+        assert mesh["devices"] == 2
+        per_dev = mesh["per_device_state_bytes"]
+        assert len(per_dev) == 2
+        # replicated leaves weigh the same everywhere; the sharded KV
+        # splits evenly — so the two shards' totals must match
+        assert len(set(per_dev.values())) == 1
+        assert all(v > 0 for v in per_dev.values())
+
+    def test_healthz_carries_mesh_block(self, engines):
+        from dalle_pytorch_tpu.serving.server import ServingServer
+
+        _, sharded = engines
+        server = ServingServer(sharded, port=0)
+        try:
+            healthy, detail = server.health()
+            assert healthy
+            assert detail["mesh"]["axes"]["tp"] == 2
+            assert detail["mesh"]["model_axis"] == "tp"
+        finally:
+            server.shutdown(drain=False)
+
+
+# ------------------------------------------------------------ slow tier
+
+
+@pytest.mark.slow  # full warmup of the sharded program set + flash
+class TestShardedWarmServer:
+    def test_warm_sharded_cycle_compiles_nothing(self):
+        """Post-warmup sharded serve cycle (admit -> chunk -> mid-flight
+        admit -> harvest -> pixels -> release) compiles ZERO programs:
+        the out_shardings pin makes the donated state's sharding a fixed
+        point, so the jit cache never re-keys on a drifted sharding."""
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+        from dalle_pytorch_tpu.utils.compile_guard import assert_no_recompiles
+
+        model = _model(num_image_tokens=64)
+        params = _params(model)
+        vae = DiscreteVAE(
+            image_size=4 * FMAP, num_layers=2, num_tokens=64,
+            codebook_dim=32, hidden_dim=16,
+        )
+        vae_params = jax.jit(vae.init)(
+            jax.random.PRNGKey(1), jnp.zeros((1, 4 * FMAP, 4 * FMAP, 3))
+        )["params"]
+        engine = ShardedContinuousEngine(
+            model=model, variables=params, vae=vae, vae_params=vae_params,
+            max_batch=4, chunk_tokens=8, registry=MetricsRegistry(),
+            mesh=build_serving_mesh({"tp": 2}),
+        )
+        engine.warmup()
+        with assert_no_recompiles():
+            engine.prefill_slots([(0, spec(3)), (1, spec(4))])
+            engine.step_chunk()
+            engine.prefill_slot(2, spec(5))
+            _drain(engine)
+            toks = engine.harvest([0, 1, 2])
+            engine.decode_pixels(toks)
+            engine.release([0, 1, 2])
+
+    def test_flash_impl_sharded_parity(self):
+        """attn_impl="flash" routes the cached path through the
+        shard_map-wrapped kernel (models/attention.py decode_mesh) — and
+        stays bit-identical to the single-device flash engine."""
+        model = _model(shift_tokens=False, attn_impl="flash")
+        params = _params(model)
+        cont = ContinuousEngine(
+            model=model, variables=params, max_batch=2, chunk_tokens=8,
+            registry=MetricsRegistry(),
+        )
+        sharded = ShardedContinuousEngine(
+            model=model, variables=params, max_batch=2, chunk_tokens=8,
+            registry=MetricsRegistry(), mesh=build_serving_mesh({"tp": 2}),
+        )
+        # the engine handed the mesh AND the head axis to the attention
+        # dispatch (the kernel must split over the KV shardings' axis)
+        assert sharded.model.decode_mesh is not None
+        assert sharded.model.decode_heads_axis == sharded.model_axis
+        results = []
+        for e in (cont, sharded):
+            e.prefill_slot(0, spec(9))
+            _drain(e)
+            results.append(e.harvest([0]))
+        assert np.array_equal(results[0], results[1])

@@ -443,6 +443,90 @@ class TestEngineVitalsSampler:
         assert NULL_VITALS.samples_taken == 0
 
 
+class ShardStubVitals(StubVitals):
+    """Per-shard seam stubbed: a fake 2-device mesh's memory stats (the
+    PR 7 follow-on — one process used to sample only device 0)."""
+
+    def _device_memory_stats_all(self):
+        return {
+            "tpu:0": {"bytes_in_use": 1000, "peak_bytes_in_use": 1500},
+            "tpu:1": {"bytes_in_use": 3000, "peak_bytes_in_use": 3500},
+        }
+
+
+class TestPerShardVitals:
+    def test_per_device_rollup_and_gauge_family(self):
+        """One snapshot carries EVERY shard's memory stats plus their
+        total, and the dalle_serving_hbm_bytes{device=} family exports
+        one series per shard — the sick one is nameable."""
+        reg = MetricsRegistry()
+        eng = FakeContinuousEngine()
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            vit = ShardStubVitals(interval_s=60.0, registry=reg)
+            vit.bind(engine=eng, batcher=b)
+            snap = vit.tick()
+            per_dev = snap["memory_stats_per_device"]
+            assert per_dev["tpu:0"]["bytes_in_use"] == 1000
+            assert per_dev["tpu:1"]["bytes_in_use"] == 3000
+            assert snap["bytes_in_use_total"] == 4000
+            fam = reg.get("dalle_serving_hbm_bytes")
+            by_dev = {label: child.value for label, child in fam.items()}
+            assert by_dev == {"tpu:0": 1000, "tpu:1": 3000}
+        finally:
+            vit.stop()
+            b.shutdown(drain=False)
+
+    def test_vitals_detail_carries_mesh_block(self):
+        """An engine exposing mesh_detail() (the sharded engine) gets its
+        rollup into the /debug/vitals payload."""
+        reg = MetricsRegistry()
+        eng = FakeContinuousEngine()
+        eng.mesh_detail = lambda: {
+            "axes": {"tp": 2}, "devices": 2,
+            "per_device_state_bytes": {"tpu:0": 7, "tpu:1": 7},
+        }
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            vit = ShardStubVitals(interval_s=60.0, registry=reg)
+            vit.bind(engine=eng, batcher=b)
+            vit.tick()
+            detail = vit.detail()
+            assert detail["mesh"]["axes"] == {"tp": 2}
+            assert detail["mesh"]["per_device_state_bytes"]["tpu:1"] == 7
+        finally:
+            vit.stop()
+            b.shutdown(drain=False)
+
+    def test_mesh_devices_prefers_engine_mesh(self):
+        """The per-shard seam reads the ENGINE's mesh devices when one is
+        bound, not every process-visible device."""
+
+        class _Dev:
+            def __init__(self, i):
+                self.platform, self.id = "tpu", i
+
+            def memory_stats(self):
+                return {"bytes_in_use": 10 * (self.id + 1)}
+
+        class _Mesh:
+            class devices:
+                flat = [_Dev(0), _Dev(1)]
+
+        eng = FakeContinuousEngine()
+        eng.mesh = _Mesh()
+        vit = EngineVitals(enabled=True, interval_s=60.0)
+        vit.bind(engine=eng)
+        try:
+            stats = vit._device_memory_stats_all()
+            assert stats == {
+                "tpu:0": {"bytes_in_use": 10},
+                "tpu:1": {"bytes_in_use": 20},
+            }
+        finally:
+            vit.stop()
+
+
 # -------------------------------------------------- /debug + health (HTTP)
 
 
