@@ -48,6 +48,15 @@ device mesh, and its JSON line gains a `mesh` block — axis sizes,
 per-device state-buffer bytes, and the per-device memory PEAK over the
 measured window. On CPU pair it with
 XLA_FLAGS=--xla_force_host_platform_device_count=8.
+
+Fleet tracing (`--trace_export`, SERVE_TRACE_EXPORT=1): every measured
+request is traced client-side (the bench plays the ingress role) and
+shipped through a real `TraceExporter` to an in-process
+`CollectorServer` — the same export path a serving replica uses — and
+each engine's JSON line gains a `critical_path` block: per-stage fleet
+p50/p95 and dominant-critical-path stage attribution over the measured
+window only (tracers attach after calibration; the collector resets
+between engines).
 """
 
 from __future__ import annotations
@@ -177,10 +186,12 @@ def run_level(engine, text_ids, concurrency: int, requests_per_client: int,
 
 
 def _percentile(values, q):
-    if not values:
-        return None
-    ordered = sorted(values)
-    return ordered[min(len(ordered) - 1, max(0, int(q * len(ordered))))]
+    # canonical nearest-rank impl lives in obs/collector.py (the
+    # /critical_path endpoint); deferred import keeps this module's
+    # import cheap — by first call the engines imported jax anyway
+    from dalle_pytorch_tpu.obs.collector import _percentile as impl
+
+    return impl(values, q)
 
 
 def _stage_snapshot(registry):
@@ -213,7 +224,7 @@ def _stage_breakdown(registry, before):
 
 
 def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0,
-                  texts=None):
+                  texts=None, tracer=None):
     """Replay a pre-drawn Poisson arrival schedule against one batcher.
 
     `arrivals` are offsets (seconds) from the run start; both engines see
@@ -228,7 +239,14 @@ def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0,
     admissions (`GenRequest.prefix_hit`, paged engine only), the stats
     split TTFT by hit vs cold so the cache's win is measured on ONE run,
     not across runs.
+
+    `tracer` (--trace_export) mints one client-side trace per arrival —
+    the bench plays the fleet ingress role: its root span parents the
+    batcher's queue/prefill/chunk/harvest spans, and finish() at
+    completion ships the trace to the in-process collector, so the JSON
+    line's `critical_path` block covers exactly the measured window.
     """
+    from dalle_pytorch_tpu.obs.tracing import NULL_TRACE
     from dalle_pytorch_tpu.serving.engine import SampleSpec
 
     submitted, rejected = [], 0
@@ -238,12 +256,18 @@ def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0,
         if delay > 0:
             time.sleep(delay)
         ids = text_ids if texts is None else texts[i]
+        trace = (
+            tracer.start_trace("request", arrival=i) if tracer is not None
+            else NULL_TRACE
+        )
         try:
             req = batcher.submit(
-                [SampleSpec(ids, seed=int(seed))], timeout_s=timeout_s
+                [SampleSpec(ids, seed=int(seed))], timeout_s=timeout_s,
+                trace=trace,
             )
             submitted.append((time.monotonic(), req))
         except Exception:  # queue-full backpressure counts against the engine
+            trace.finish("rejected")
             rejected += 1
 
     ttfts, errors = [], 0
@@ -253,8 +277,10 @@ def run_open_loop(batcher, text_ids, arrivals, seeds, timeout_s=120.0,
         try:
             req.future.result(timeout=timeout_s)
         except Exception:
+            req.trace.finish("error")
             errors += 1
             continue
+        req.trace.finish("ok")
         last_done = max(last_done, time.monotonic())
         if req.first_token_at is not None:
             ttft = req.first_token_at - t_submit
@@ -372,7 +398,8 @@ def _sustained_rps(batcher, text_ids, seconds=2.5, clients=16,
     return len(done) / max(time.monotonic() - t0, 1e-9)
 
 
-def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None):
+def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
+                   trace_export=False):
     import jax
     import numpy as np
 
@@ -501,14 +528,43 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None):
         "continuous_saturation_rps": round(cont_cap, 3),
     }
 
+    # --trace_export: an in-process collector (real HTTP on port 0) plus
+    # one tracer+exporter per engine run — the bench exercises the SAME
+    # export path a fleet replica uses, and each line's `critical_path`
+    # block folds exactly the traces of its measured window (tracers are
+    # created after calibration; the collector resets between engines)
+    collector_srv = None
+    if trace_export:
+        from dalle_pytorch_tpu.obs import CollectorServer, TraceExporter, Tracer
+
+        collector_srv = CollectorServer(grace_s=0.05).start()
+
+    def _traced_run(batcher, site, **kw):
+        """One open-loop replay, optionally traced+exported; returns
+        (stats, critical_path block or None)."""
+        if collector_srv is None:
+            return run_open_loop(batcher, text_ids, arrivals, seeds, **kw), None
+        tracer = Tracer(max_traces=len(arrivals) + 8)
+        exporter = TraceExporter(collector_srv.url, site=site).attach(tracer)
+        stats = run_open_loop(
+            batcher, text_ids, arrivals, seeds, tracer=tracer, **kw
+        )
+        exporter.flush()
+        exporter.stop(final_flush=False)
+        block = collector_srv.collector.critical_path()
+        collector_srv.collector.reset()
+        return stats, block
+
     micro_stages0 = _stage_snapshot(micro.registry)
-    micro_stats = run_open_loop(mb, text_ids, arrivals, seeds, texts=texts)
+    micro_stats, micro_cp = _traced_run(mb, "bench-micro", texts=texts)
     mb.shutdown(drain=True)
     micro_line = {
         **common, "engine": "micro", "value": micro_stats["rps"],
         "max_delay_ms": delay_ms, **micro_stats,
         "stages": _stage_breakdown(micro.registry, micro_stages0),
     }
+    if micro_cp is not None:
+        micro_line["critical_path"] = micro_cp
     print(json.dumps(micro_line), flush=True)
 
     # admission-dispatch accounting: how well batched prefill amortized the
@@ -533,7 +589,7 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None):
         cont.kv.pool.peak_allocated = cont.kv.pool.n_allocated
         hits0, misses0 = cont.kv.cache.hits, cont.kv.cache.misses
         evictions0 = cont.kv.cache.evictions
-    cont_stats = run_open_loop(cb, text_ids, arrivals, seeds, texts=texts)
+    cont_stats, cont_cp = _traced_run(cb, "bench-continuous", texts=texts)
     vitals.stop()
     cb.shutdown(drain=True)
     # mean/peak occupancy + per-program MFU over the measured window
@@ -566,6 +622,8 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None):
         "stages": _stage_breakdown(cont.registry, cont_stages0),
         "vitals": vitals_block,
     }
+    if cont_cp is not None:
+        cont_line["critical_path"] = cont_cp
     if mesh is not None:
         # mesh shape + per-device memory PEAK over the measured window
         # (from the sampler's per-device memory_stats; empty on backends
@@ -620,6 +678,8 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None):
             cont_stats["ttft_p95_ms"] / micro_stats["ttft_p95_ms"], 3
         )
     print(json.dumps(cont_line), flush=True)
+    if collector_srv is not None:
+        collector_srv.shutdown()
 
 
 def main_closed_loop():
@@ -685,11 +745,20 @@ def main():
         "JSON line gains a `mesh` block with axis sizes and per-device "
         "memory peaks (slot layout only)",
     )
+    p.add_argument(
+        "--trace_export", action="store_true",
+        default=os.environ.get("SERVE_TRACE_EXPORT", "0") in ("1", "true"),
+        help="open-loop: trace every measured request through an "
+        "in-process fleet collector (obs/collector.py) and add a "
+        "`critical_path` block — per-stage fleet p50/p95 plus dominant-"
+        "stage attribution over the measured window only — to each "
+        "engine's JSON line",
+    )
     args = p.parse_args()
     if args.mode == "open-loop":
         main_open_loop(
             prompt_reuse=args.prompt_reuse, kv_layout=args.kv_layout,
-            mesh=args.mesh,
+            mesh=args.mesh, trace_export=args.trace_export,
         )
     else:
         main_closed_loop()

@@ -46,6 +46,18 @@ TL008  `shard_map` in_specs/out_specs (or a `NamedSharding` spec) naming
        factories (`make_mesh`, `build_serving_mesh`, `make_pp_mesh`);
        anything else stays silent (false-negative bias, like the rest of
        the pack).
+TL009  a `Trace.begin(...)` span whose matching `end()` is unreachable
+       on the exception path: begin and end in the SAME function, every
+       `end` in straight-line code — an exception between them leaks the
+       span open until `finish()` stamps it `abandoned`, so the exported
+       stage duration is the request's whole remaining life, which
+       poisons the fleet collector's critical-path attribution. Safe
+       shapes: `with trace.span(...)`, an `end` in a `finally` or
+       `except` block, or the batcher's cross-thread/cross-function
+       begin (no same-function `end` — silent by design). Receiver must
+       name a trace (`trace.begin`, `req.trace.begin`); begins bound to
+       attributes or inside comprehensions stay silent (false-negative
+       bias).
 """
 
 from __future__ import annotations
@@ -787,6 +799,108 @@ class MeshAxisRule(Rule):
                     )
 
 
+class SpanLeakRule(Rule):
+    code = "TL009"
+    name = "span-leak"
+    description = (
+        "Trace.begin(...) whose matching end() is not reachable on the "
+        "exception path (no enclosing try/finally or except) — a raise "
+        "between them leaks the span open until finish() marks it "
+        "abandoned, corrupting exported stage durations"
+    )
+
+    @staticmethod
+    def _trace_method_call(node: ast.AST, attr: str) -> bool:
+        """`<receiver>.{attr}(...)` where the receiver's dotted name
+        mentions a trace (`trace.begin`, `req.trace.end`, ...). Bare
+        receivers (`t.begin`) and unresolvable ones stay silent —
+        false-negative bias, and it keeps unrelated `.begin()` APIs
+        (db cursors, matchers) out of the findings."""
+        if not isinstance(node, ast.Call):
+            return False
+        if not isinstance(node.func, ast.Attribute) or node.func.attr != attr:
+            return False
+        dotted = dotted_name(node.func) or ""
+        receiver = dotted.rsplit(".", 1)[0] if "." in dotted else ""
+        return "trace" in receiver.lower()
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        for func in _functions(ctx.tree):
+            if isinstance(func, ast.Lambda):
+                continue
+            yield from self._check_function(ctx, func)
+
+    def _check_function(self, ctx, func) -> Iterator[Finding]:
+        begins: Dict[str, ast.AST] = {}  # span name -> its begin call
+        ends: Dict[str, Dict[str, bool]] = {}  # span name -> seen/protected
+
+        # the walk tracks whether the current block is exception-reachable
+        # cleanup (a `finally` or an `except` handler): an `end(span)`
+        # there closes the span on the error path too — the contract
+
+        def scan_exprs(exprs: List[ast.AST], protected: bool) -> None:
+            for expr in exprs:
+                for node in ast.walk(expr):
+                    if isinstance(node, _ALL_FUNCS):
+                        break  # nested defs get their own pass
+                    if self._trace_method_call(node, "end") and node.args:
+                        target = node.args[0]
+                        if isinstance(target, ast.Name):
+                            info = ends.setdefault(
+                                target.id, {"seen": False, "protected": False}
+                            )
+                            info["seen"] = True
+                            info["protected"] = info["protected"] or protected
+
+        def visit_stmt(stmt: ast.AST, protected: bool) -> None:
+            if isinstance(stmt, _ALL_FUNCS):
+                return
+            if isinstance(stmt, ast.Try):
+                walk(stmt.body, protected)
+                for handler in stmt.handlers:
+                    walk(handler.body, True)
+                walk(stmt.orelse, protected)
+                walk(stmt.finalbody, True)
+                return
+            if isinstance(stmt, ast.Assign) and isinstance(
+                stmt.value, ast.Call
+            ) and self._trace_method_call(stmt.value, "begin"):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        begins.setdefault(t.id, stmt.value)
+            exprs, blocks = [], []
+            for _field, value in ast.iter_fields(stmt):
+                if isinstance(value, list) and value and isinstance(
+                    value[0], ast.stmt
+                ):
+                    blocks.append(value)
+                elif isinstance(value, list):
+                    exprs.extend(v for v in value if isinstance(v, ast.AST))
+                elif isinstance(value, ast.AST):
+                    exprs.append(value)
+            scan_exprs(exprs, protected)
+            for block in blocks:
+                walk(block, protected)
+
+        def walk(stmts: List[ast.AST], protected: bool) -> None:
+            for stmt in stmts:
+                visit_stmt(stmt, protected)
+
+        walk(func.body, False)
+        for span_name, begin_node in begins.items():
+            info = ends.get(span_name)
+            if info is None or not info["seen"]:
+                continue  # cross-thread/cross-function end: silent
+            if not info["protected"]:
+                yield ctx.finding(
+                    self.code, begin_node,
+                    f"span `{span_name}` begun here has no end() reachable "
+                    "on the exception path — wrap the work in try/finally "
+                    "(or use `with trace.span(...)`) so an error can't "
+                    "leak the span open until finish()",
+                )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -796,4 +910,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     DebuggerArtifactRule(),
     ScanConstUploadRule(),
     MeshAxisRule(),
+    SpanLeakRule(),
 )

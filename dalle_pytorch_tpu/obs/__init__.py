@@ -22,6 +22,20 @@ Prometheus counters can't answer that; these modules can:
     behind `POST /debug/profile?seconds=N` (root-gated, single-flight,
     writes a TensorBoard trace dir) so a TPU hotspot can be captured
     from a live server without a restart.
+  * `aggregate.py` — fleet trace export: the `x-dalle-trace` context
+    codec (header parsed at POST /generate ingress, minted if absent, so
+    a bench client's or router's span parents the server's root) and the
+    `TraceExporter` background thread shipping finished traces as
+    batched JSONL to a collector — bounded buffer, exponential backoff,
+    drop-with-counter, NULL_EXPORTER zero-overhead when off, serving
+    provably unaffected by collector health.
+  * `collector.py` — the stitching `TraceCollector` service
+    (`python -m dalle_pytorch_tpu.obs.collector`, embeddable
+    in-process): joins spans from N processes on trace_id (out-of-order/
+    duplicate/late tolerated via a grace window), assembles ONE merged
+    Perfetto trace per request with one track per process identity
+    (`GET /traces`), and folds traces into fleet-wide per-stage p50/p95
+    + dominant-critical-path attribution (`GET /critical_path`).
   * `vitals.py`   — device telemetry and self-diagnosis: per-program
     `ProgramCostTable` (XLA cost/memory analysis captured at warmup →
     live MFU/bandwidth gauges and `GET /debug/programs`), the
@@ -36,7 +50,20 @@ histogram family (`training/metrics.py`), so `/metrics` and the traces
 agree on where the time went.
 """
 
-from dalle_pytorch_tpu.obs.tracing import NULL_TRACE, Span, Trace, Tracer
+from dalle_pytorch_tpu.obs.tracing import (
+    NULL_EXPORTER,
+    NULL_TRACE,
+    Span,
+    Trace,
+    Tracer,
+)
+from dalle_pytorch_tpu.obs.aggregate import (
+    TRACE_HEADER,
+    TraceExporter,
+    format_trace_header,
+    parse_trace_header,
+)
+from dalle_pytorch_tpu.obs.collector import CollectorServer, TraceCollector
 from dalle_pytorch_tpu.obs.logging import StructuredLog
 from dalle_pytorch_tpu.obs.profiler import ProfilerBusy, ProfilerCapture
 from dalle_pytorch_tpu.obs.vitals import (
@@ -49,7 +76,9 @@ from dalle_pytorch_tpu.obs.vitals import (
 )
 
 __all__ = [
+    "CollectorServer",
     "EngineVitals",
+    "NULL_EXPORTER",
     "NULL_TRACE",
     "NULL_VITALS",
     "ProfilerBusy",
@@ -60,6 +89,11 @@ __all__ = [
     "Span",
     "StallWatchdog",
     "StructuredLog",
+    "TRACE_HEADER",
     "Trace",
+    "TraceCollector",
+    "TraceExporter",
     "Tracer",
+    "format_trace_header",
+    "parse_trace_header",
 ]

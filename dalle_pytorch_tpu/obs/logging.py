@@ -11,6 +11,7 @@ which orchestrators (and the e2e tests) pattern-match.
 Request-line schema (keys always present):
 
     {"ts": <unix seconds>, "event": "request", "trace_id": str,
+     "site": str, "pid": int, "host": str,
      "outcome": "ok" | "rejected" | "timeout" | "cancelled" | "error"
                | "shutdown",
      "status": <http code>, "latency_ms": float,
@@ -19,11 +20,20 @@ Request-line schema (keys always present):
 plus whatever extra fields the caller attaches (prompt length, rows,
 seed, error text). `stages` is empty when tracing is disabled — the log
 line still records outcome and latency.
+
+Every line — request lines AND lifecycle events (so watchdog `stall`
+records too) — carries the stable process identity triple `site`/`pid`/
+`host` (`serve.py --trace_site`; site defaults to the hostname): fleet
+logs from N replicas merge into one stream and join against the
+collector's assembled traces by trace_id without guessing which process
+wrote what.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import socket
 import sys
 import threading
 import time
@@ -34,12 +44,27 @@ class StructuredLog:
     """Thread-safe JSONL writer. Failures to write never raise into the
     serving path (a closed pipe must not fail a request)."""
 
-    def __init__(self, stream=None, component: str = "dalle.serving"):
+    def __init__(self, stream=None, component: str = "dalle.serving",
+                 site: Optional[str] = None):
+        from dalle_pytorch_tpu.obs.aggregate import default_site, sanitize_site
+
         self._stream = stream if stream is not None else sys.stdout
         self._component = component
         self._lock = threading.Lock()
+        # stamped once: identity must be STABLE across every line this
+        # process writes, or downstream joins fracture mid-run —
+        # sanitized through the SAME clamp as TraceExporter so log lines
+        # and exported traces carry one identical site string
+        self._identity = {
+            "site": sanitize_site(site) if site else default_site(),
+            "pid": os.getpid(),
+            # host through the same clamp as TraceExporter.host, or log
+            # lines and span UIDs would disagree on long/odd hostnames
+            "host": sanitize_site(socket.gethostname() or "localhost"),
+        }
 
     def _emit(self, record: Dict) -> None:
+        record = {**self._identity, **record}
         line = json.dumps(record, default=str)
         try:
             with self._lock:

@@ -27,6 +27,15 @@ Export is Chrome/Perfetto `trace_event` JSON (the "JSON Array Format" /
 `traceEvents` object both chrome://tracing and ui.perfetto.dev load):
 one complete (`ph: "X"`) event per closed span, one synthetic track per
 trace so concurrent requests render as parallel rows.
+
+Fleet hooks (obs/aggregate.py): a tracer may carry a `TraceExporter` that
+ships every finished trace to a cross-process collector. The default is
+the shared `NULL_EXPORTER` no-op — same counter-gated zero-overhead
+contract as NULL_TRACE — so a tracer without `--trace_export` pays one
+attribute load per finished trace and allocates nothing. `start_trace`
+accepts an externally-minted `trace_id` plus a `parent_uid` (the
+`x-dalle-trace` header's parse) so spans from N processes join on one ID
+and the remote caller's span parents this process's root.
 """
 
 from __future__ import annotations
@@ -92,6 +101,7 @@ class _NullTrace:
     __slots__ = ()
     trace_id = ""
     outcome = None
+    parent_uid = None
     spans: List = []
 
     def __bool__(self) -> bool:
@@ -120,8 +130,27 @@ class _NullTrace:
         return 0.0
 
 
+class _NullExporter:
+    """Shared no-op exporter: the off path of cross-process trace export
+    (obs/aggregate.py:TraceExporter). Counter-gated like NULL_TRACE — a
+    tracer without an exporter attached serializes zero spans and buffers
+    zero traces, whatever traffic flows past it."""
+
+    __slots__ = ()
+    enabled = False
+    spans_serialized = 0
+    dropped = 0
+
+    def __bool__(self) -> bool:
+        return False
+
+    def export(self, trace) -> None:
+        pass
+
+
 NULL_SPAN = _NullSpan()
 NULL_TRACE = _NullTrace()
+NULL_EXPORTER = _NullExporter()
 
 
 class Trace:
@@ -129,9 +158,14 @@ class Trace:
     root span opens immediately and closes at `finish()`."""
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
-                 args: Dict):
+                 args: Dict, parent_uid: Optional[str] = None):
         self._tracer = tracer
         self.trace_id = trace_id
+        #: globally-unique span reference of the REMOTE span this trace's
+        #: root parents into (parsed off the x-dalle-trace header); the
+        #: exporter ships it so the collector stitches the cross-process
+        #: tree. None for locally-minted traces.
+        self.parent_uid = parent_uid
         self._lock = threading.Lock()
         self._next_id = 0
         self.spans: List[Span] = []
@@ -255,7 +289,15 @@ class Tracer:
         #: zero-overhead-when-off contract is `spans_created == 0` for a
         #: disabled tracer, whatever traffic flowed past it
         self.spans_created = 0
+        #: cross-process export hook (obs/aggregate.py:TraceExporter);
+        #: the shared no-op singleton until one attaches itself
+        self.exporter = NULL_EXPORTER
+        # paired epoch reads: monotonic timestamps convert to unix wall
+        # clock for the fleet collector, which must order spans from N
+        # processes on one axis (to_unix). Skew between hosts is the
+        # usual NTP-grade caveat, stated in the collector docs.
         self._epoch_mono = time.monotonic()
+        self._epoch_unix = time.time()
         if self.enabled:
             try:  # per-span compile attribution needs the jax.monitoring
                 compile_guard.install_listener()  # listener; optional —
@@ -264,10 +306,23 @@ class Tracer:
 
     # ------------------------------------------------------------ minting
 
-    def start_trace(self, name: str = "request", **args):
+    def start_trace(self, name: str = "request", trace_id: Optional[str] = None,
+                    parent_uid: Optional[str] = None, **args):
+        """Mint a trace. `trace_id`/`parent_uid` carry a propagated
+        x-dalle-trace context (validated by the caller —
+        `aggregate.parse_trace_header` is the gate); both default to a
+        locally-minted root context."""
         if not self.enabled:
             return NULL_TRACE
-        return Trace(self, name, uuid.uuid4().hex[:16], args)
+        return Trace(
+            self, name, trace_id or uuid.uuid4().hex[:16], args,
+            parent_uid=parent_uid,
+        )
+
+    def to_unix(self, t_mono: float) -> float:
+        """Monotonic span timestamp -> unix seconds (the exporter's wire
+        time base; mutually consistent within this process)."""
+        return self._epoch_unix + (t_mono - self._epoch_mono)
 
     def _count_span(self) -> None:
         with self._lock:
@@ -276,6 +331,9 @@ class Tracer:
     def _record(self, trace: Trace) -> None:
         with self._lock:
             self._ring.append(trace)
+        # outside the ring lock: export() is a bounded-deque append (or
+        # the shared no-op) and must never couple to the tracer lock
+        self.exporter.export(trace)
 
     # ------------------------------------------------------------- views
 

@@ -17,7 +17,12 @@ healthy and well-utilized" — with four cooperating pieces:
     peak constants from here so offline and live accounting cannot
     drift. Capture costs ONE extra backend compile per program at warmup
     (JAX's AOT path does not share the jit dispatch cache — measured),
-    which is why it is opt-in via `engine.cost_table`.
+    which is why it is opt-in via `engine.cost_table`. Mesh-sharded
+    engines pass their device labels at capture: where jax exposes
+    per-partition analysis the row gains a per-device block
+    (`GET /debug/programs?per_shard=1`,
+    `dalle_serving_mfu{program=,device=}`); the global row is the
+    documented fallback everywhere else.
 
   * `EngineVitals` — a background sampler thread snapshotting queue
     depth, slots/blocks active, prefix-cache occupancy, the age of the
@@ -86,27 +91,70 @@ def extract_cost(compiled) -> Dict[str, float]:
     return dict(cost or {})
 
 
+_MEMORY_FIELDS = (
+    "argument_size_in_bytes",
+    "output_size_in_bytes",
+    "temp_size_in_bytes",
+    "alias_size_in_bytes",
+    "generated_code_size_in_bytes",
+)
+
+
+def _memory_fields(mem) -> Dict[str, int]:
+    out = {}
+    for field in _MEMORY_FIELDS:
+        v = getattr(mem, field, None)
+        if v is not None:
+            out[field] = int(v)
+    return out
+
+
 def extract_memory(compiled) -> Dict[str, int]:
     """`compiled.memory_analysis()` HBM footprint fields as a plain dict
-    (empty when the backend doesn't implement it)."""
+    (empty when the backend doesn't implement it). A per-shard list
+    (some jax versions report one entry per partition) collapses to its
+    first entry here — `extract_memory_per_device` keeps the split."""
     try:
         mem = compiled.memory_analysis()
     except Exception:
         return {}
     if mem is None:
         return {}
-    out = {}
-    for field in (
-        "argument_size_in_bytes",
-        "output_size_in_bytes",
-        "temp_size_in_bytes",
-        "alias_size_in_bytes",
-        "generated_code_size_in_bytes",
+    if isinstance(mem, (list, tuple)):
+        mem = mem[0] if mem else None
+        if mem is None:
+            return {}
+    return _memory_fields(mem)
+
+
+def extract_cost_per_device(compiled) -> Optional[List[Dict[str, float]]]:
+    """Per-partition cost dicts when jax exposes them — a
+    `cost_analysis()` returning MULTIPLE entries is read as one entry
+    per mesh device. The common shape (one global entry for the whole
+    partitioned program) returns None and callers fall back to the
+    global row; that fallback IS the contract, not an error."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return None
+    if (
+        isinstance(cost, (list, tuple)) and len(cost) > 1
+        and all(isinstance(c, dict) for c in cost)
     ):
-        v = getattr(mem, field, None)
-        if v is not None:
-            out[field] = int(v)
-    return out
+        return [dict(c) for c in cost]
+    return None
+
+
+def extract_memory_per_device(compiled) -> Optional[List[Dict[str, int]]]:
+    """Per-partition memory dicts where `memory_analysis()` reports one
+    entry per device; None (fall back to the global row) otherwise."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if isinstance(mem, (list, tuple)) and len(mem) > 1:
+        return [_memory_fields(m) for m in mem]
+    return None
 
 
 def thread_stacks(name_contains: str = "batcher") -> Dict[str, List[str]]:
@@ -133,7 +181,7 @@ class _ProgramRow:
 
     __slots__ = (
         "name", "flops", "bytes_accessed", "memory", "wall_ema_s",
-        "last_wall_s", "dispatches", "synced",
+        "last_wall_s", "dispatches", "synced", "per_shard",
     )
 
     def __init__(self, name: str, flops: float, bytes_accessed: float,
@@ -142,6 +190,9 @@ class _ProgramRow:
         self.flops = float(flops)
         self.bytes_accessed = float(bytes_accessed)
         self.memory = memory
+        #: device label -> {"flops", "bytes_accessed", "memory"} when jax
+        #: exposed per-partition analysis at capture; None = global only
+        self.per_shard: Optional[Dict[str, Dict]] = None
         self.wall_ema_s: Optional[float] = None
         self.last_wall_s: Optional[float] = None
         self.dispatches = 0
@@ -204,8 +255,15 @@ class ProgramCostTable:
         with self._lock:
             return name in self._rows
 
-    def add(self, name: str, compiled) -> None:
-        """Register one already-compiled program's cost analysis."""
+    def add(self, name: str, compiled, devices=None) -> None:
+        """Register one already-compiled program's cost analysis.
+
+        `devices` (the engine's mesh device labels, in mesh order) opts
+        into per-shard attribution: where jax exposes per-partition
+        cost/memory analysis (`extract_cost_per_device`), each device
+        gets its own row — `GET /debug/programs?per_shard=1` and
+        `dalle_serving_mfu{program=,device=}`. Everywhere else the
+        global row stands alone, exactly as before."""
         cost = extract_cost(compiled)
         row = _ProgramRow(
             name,
@@ -213,11 +271,35 @@ class ProgramCostTable:
             bytes_accessed=float(cost.get("bytes accessed", 0.0)),
             memory=extract_memory(compiled),
         )
+        if devices:
+            per_cost = extract_cost_per_device(compiled)
+            if per_cost is not None and len(per_cost) == len(devices):
+                per_mem = extract_memory_per_device(compiled)
+                if per_mem is None or len(per_mem) != len(devices):
+                    per_mem = [{}] * len(devices)
+                row.per_shard = {
+                    str(dev): {
+                        "flops": float(c.get("flops", 0.0)),
+                        "bytes_accessed": float(
+                            c.get("bytes accessed", 0.0)
+                        ),
+                        "memory": m,
+                    }
+                    for dev, c, m in zip(devices, per_cost, per_mem)
+                }
+                # with per-partition entries the program-level row is
+                # their SUM (extract_cost's first entry would understate
+                # the collective dispatch by ~1/num_devices)
+                row.flops = sum(c["flops"] for c in row.per_shard.values())
+                row.bytes_accessed = sum(
+                    c["bytes_accessed"] for c in row.per_shard.values()
+                )
         with self._lock:
             self._rows[name] = row
             self._errors.pop(name, None)
 
-    def capture(self, name: str, lower_fn: Callable) -> bool:
+    def capture(self, name: str, lower_fn: Callable,
+                devices=None) -> bool:
         """AOT-lower + compile via `lower_fn() -> jax.stages.Lowered` and
         record the program's cost. Failures are recorded, never raised —
         a backend without cost analysis must not break warmup."""
@@ -227,7 +309,7 @@ class ProgramCostTable:
             lowered = lower_fn()
             if lowered is None:  # eager-fallback sampler: nothing to lower
                 return False
-            self.add(name, lowered.compile())
+            self.add(name, lowered.compile(), devices=devices)
             return True
         except Exception as exc:
             with self._lock:
@@ -252,16 +334,35 @@ class ProgramCostTable:
             row.synced = row.synced or bool(synced)
             export = row.synced and row.wall_ema_s > 0
             mfu = bw = None
+            shard_stats = []
             if export:
                 mfu = min(
                     1.0, row.flops / (row.wall_ema_s * self.peak_flops)
                 )
                 bw = row.bytes_accessed / row.wall_ema_s / 1e9
+                if row.per_shard:
+                    # the dispatch is collective — every shard shares the
+                    # program wall; per-device MFU divides each shard's
+                    # OWN flops by it, so a lopsided partition shows up
+                    # as one hot device, not a fleet average
+                    shard_stats = [
+                        (
+                            dev,
+                            min(1.0, c["flops"]
+                                / (row.wall_ema_s * self.peak_flops)),
+                            c["bytes_accessed"] / row.wall_ema_s / 1e9,
+                        )
+                        for dev, c in row.per_shard.items()
+                    ]
         if export:
             if self._m_mfu is not None:
                 self._m_mfu.labels(name).set(mfu)
+                for dev, s_mfu, _ in shard_stats:
+                    self._m_mfu.labels_extra(name, device=dev).set(s_mfu)
             if self._m_bw is not None:
                 self._m_bw.labels(name).set(bw)
+                for dev, _, s_bw in shard_stats:
+                    self._m_bw.labels_extra(name, device=dev).set(s_bw)
 
     def mfu(self, name: str) -> Optional[float]:
         with self._lock:
@@ -272,8 +373,11 @@ class ProgramCostTable:
 
     # ------------------------------------------------------------- export
 
-    def rows(self) -> List[Dict]:
-        """JSON-ready rows for `GET /debug/programs`."""
+    def rows(self, per_shard: bool = False) -> List[Dict]:
+        """JSON-ready rows for `GET /debug/programs`. `per_shard=True`
+        adds a per-mesh-device block to programs whose capture exposed
+        per-partition analysis (the `?per_shard=1` query); programs with
+        only the global row render unchanged — the documented fallback."""
         with self._lock:
             rows = list(self._rows.values())
             errors = dict(self._errors)
@@ -288,7 +392,8 @@ class ProgramCostTable:
                 "memory": r.memory,
                 "dispatches": r.dispatches,
             }
-            if r.wall_ema_s is not None:
+            live = r.wall_ema_s is not None
+            if live:
                 row["wall_ema_ms"] = round(r.wall_ema_s * 1e3, 3)
                 row["wall_includes_sync"] = r.synced
                 if r.synced and r.wall_ema_s > 0:
@@ -299,16 +404,36 @@ class ProgramCostTable:
                     row["hbm_gbps"] = float(
                         f"{r.bytes_accessed / r.wall_ema_s / 1e9:.4g}"
                     )
+            if per_shard and r.per_shard:
+                shards = []
+                for dev, c in r.per_shard.items():
+                    shard = {
+                        "device": dev,
+                        "flops": c["flops"],
+                        "bytes_accessed": c["bytes_accessed"],
+                        "memory": c["memory"],
+                    }
+                    if live and r.synced and r.wall_ema_s > 0:
+                        s_mfu = min(
+                            1.0,
+                            c["flops"] / (r.wall_ema_s * self.peak_flops),
+                        )
+                        shard["mfu"] = float(f"{s_mfu:.4g}")
+                        shard["hbm_gbps"] = float(
+                            f"{c['bytes_accessed'] / r.wall_ema_s / 1e9:.4g}"
+                        )
+                    shards.append(shard)
+                row["per_shard"] = shards
             out.append(row)
         for name, err in errors.items():
             out.append({"program": name, "error": err})
         return out
 
-    def detail(self) -> Dict:
+    def detail(self, per_shard: bool = False) -> Dict:
         return {
             "peak_flops": self.peak_flops,
             "hbm_bps": self.hbm_bps,
-            "programs": self.rows(),
+            "programs": self.rows(per_shard=per_shard),
         }
 
 

@@ -145,7 +145,17 @@ class GenerationEngine:
             return
         import jax
 
-        table.capture(name, lambda: jax.jit(fn).lower(*args))
+        # mesh-sharded engines hand their device labels through so the
+        # table can attribute per-partition cost where jax exposes it
+        # (ProgramCostTable.add; global-row fallback otherwise)
+        mesh = getattr(self, "mesh", None)
+        devices = (
+            [f"{d.platform}:{d.id}" for d in mesh.devices.flat]
+            if mesh is not None else None
+        )
+        table.capture(
+            name, lambda: jax.jit(fn).lower(*args), devices=devices
+        )
 
     def state_dump(self) -> dict:
         """Host-side engine state for `/debug/state` and stall reports.

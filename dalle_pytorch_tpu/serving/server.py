@@ -28,6 +28,9 @@ Endpoints:
   GET  /debug/programs -> per-program XLA cost/memory table captured at
                    warmup (FLOPs, bytes accessed, HBM footprint) plus
                    live MFU / achieved bandwidth where measured.
+                   `?per_shard=1` adds per-mesh-device rows where jax
+                   exposed per-shard cost analysis at capture (sharded
+                   engine; falls back to the global row otherwise).
   GET  /debug/state -> full engine-state dump for postmortems: slot
                    table with in-flight trace IDs, page tables +
                    refcounts (paged engine), queue summary, recent
@@ -38,11 +41,15 @@ Endpoints:
                    single-flight -> 409); returns the TensorBoard trace
                    dir.
 
-Every /generate request gets a trace ID minted here at ingress; it rides
-the `GenRequest` through the batcher (queue/prefill/chunk/harvest spans),
-comes back in the response payload as `trace_id`, and is logged as one
-structured JSON line per completed request when a `StructuredLog` is
-attached.
+Every /generate request gets a trace ID at ingress — ADOPTED from a valid
+`x-dalle-trace` header (fleet context propagation, obs/aggregate.py:
+the caller's span becomes the remote parent of this server's root span),
+minted fresh otherwise. It rides the `GenRequest` through the batcher
+(queue/prefill/chunk/harvest spans), comes back in the response payload
+as `trace_id`, is logged as one structured JSON line per completed
+request when a `StructuredLog` is attached, and — when a `TraceExporter`
+is attached (`serve.py --trace_export URL`) — ships to the fleet trace
+collector at finish.
 
 `ThreadingHTTPServer` gives one thread per in-flight request; they all
 funnel into the `MicroBatcher`, which is where concurrent requests
@@ -66,6 +73,7 @@ from urllib.parse import parse_qs
 
 import numpy as np
 
+from dalle_pytorch_tpu.obs.aggregate import TRACE_HEADER, parse_trace_header
 from dalle_pytorch_tpu.obs.logging import StructuredLog
 from dalle_pytorch_tpu.obs.profiler import ProfilerBusy, ProfilerCapture
 from dalle_pytorch_tpu.obs.tracing import Tracer
@@ -210,7 +218,13 @@ class _Handler(BaseHTTPRequestHandler):
                     "(set engine.cost_table before warmup)",
                 })
             else:
-                self._reply(200, table.detail())
+                # ?per_shard=1 adds per-mesh-device cost rows where jax
+                # exposed per-shard analysis at capture (global-only
+                # programs just render without the block)
+                per_shard = parse_qs(query).get("per_shard", ["0"])[0] in (
+                    "1", "true",
+                )
+                self._reply(200, table.detail(per_shard=per_shard))
         elif path == "/debug/state":
             self._reply(200, owner.state_dump())
         else:
@@ -313,12 +327,19 @@ class _Handler(BaseHTTPRequestHandler):
         if seed is None:
             seed = owner.next_seed(num_images)
         t0 = time.monotonic()
-        # trace ID minted at ingress: every stage of this request's life is
-        # a span on this one tree (queue/prefill/chunk/harvest land in the
-        # batcher worker; respond below). finish() runs on EVERY exit path
-        # so error traces reach the ring buffer and the request log too.
+        # trace context at ingress: a valid x-dalle-trace header ADOPTS
+        # the caller's trace ID (and records the caller's span as the
+        # remote parent) so a bench client's or replica router's spans
+        # and this server's land in ONE fleet-collector tree; absent or
+        # malformed, the ID is minted here exactly as before. finish()
+        # runs on EVERY exit path so error traces reach the ring buffer,
+        # the exporter, and the request log too.
+        ctx = parse_trace_header(self.headers.get(TRACE_HEADER))
         trace = owner.tracer.start_trace(
-            "request", rows=num_images, seed=int(seed),
+            "request",
+            trace_id=ctx[0] if ctx else None,
+            parent_uid=ctx[1] if ctx else None,
+            rows=num_images, seed=int(seed),
             prompt_chars=len(prompt),
         )
 
@@ -454,6 +475,7 @@ class ServingServer:
         profiler: Optional[ProfilerCapture] = None,
         trace_dump_path: Optional[str] = None,
         vitals: Optional[EngineVitals] = None,
+        exporter=None,
     ):
         self.engine = engine
         self.registry = engine.registry
@@ -468,6 +490,13 @@ class ServingServer:
         # bookkeeping is host-side clock reads — pass
         # Tracer(enabled=False) to get the pinned zero-allocation path
         self.tracer = tracer if tracer is not None else Tracer(max_traces=128)
+        # fleet export (obs/aggregate.py TraceExporter, `serve.py
+        # --trace_export URL`): attached here so the server owns its
+        # lifecycle — shutdown stops the shipper thread after the last
+        # handler finished its trace. None leaves NULL_EXPORTER in place.
+        self.exporter = exporter
+        if exporter is not None:
+            exporter.attach(self.tracer)
         self.log = log  # None: no structured logging at all (tests stay quiet)
         # log_requests=False keeps lifecycle events (warmup, trace_dump,
         # shutdown) flowing but drops the per-request lines — the
@@ -503,9 +532,12 @@ class ServingServer:
             self._httpd = _Server((host, port), self)
         except OSError:
             # bind failure (port in use, bad host): don't leak the batcher
-            # worker thread (or the vitals sampler) just started above
+            # worker thread, the vitals sampler, or the exporter shipper
+            # just started above
             self.vitals.stop()
             self.batcher.shutdown(drain=False)
+            if self.exporter is not None:
+                self.exporter.stop(final_flush=False)
             raise
         self._thread: Optional[threading.Thread] = None
         self._state_lock = threading.Lock()
@@ -622,6 +654,11 @@ class ServingServer:
         dump["batcher"] = summary() if summary is not None else {}
         dump["recent_compiles"] = compile_guard.recent_events()
         dump["worker_stacks"] = thread_stacks("batcher")
+        if self.exporter is not None:
+            # fleet-export health rides the postmortem dump: "did this
+            # replica's traces actually reach the collector" is the first
+            # question a cross-host stall investigation asks
+            dump["trace_export"] = self.exporter.detail()
         return dump
 
     def admission_context(self) -> dict:
@@ -707,5 +744,13 @@ class ServingServer:
         # the ring. (A handler thread still encoding a huge payload at
         # this instant is best-effort: the dump won't wait for it.)
         self._dump_traces()
+        if self.exporter is not None and first_close:
+            # same ordering logic as the dump: every finished trace is in
+            # the buffer by now; stop() makes one final best-effort flush
+            # (bounded by the POST timeout, so a dead collector cannot
+            # wedge shutdown)
+            self.exporter.stop()
+            if self.log is not None:
+                self.log.event("trace_export_stopped", **self.exporter.detail())
         if first_close and self.log is not None:
             self.log.event("shutdown", drain=drain)

@@ -247,6 +247,25 @@ class Family:
                 self._children[key] = child
             return child
 
+    def labels_extra(self, value, **extra) -> object:
+        """Child carrying the family label PLUS extra label dimensions —
+        the per-shard series (`dalle_serving_mfu{program=,device=}`)
+        without registering a second family per dimension. Children are
+        keyed by the full rendered label set, so plain `labels(value)`
+        children and extra-labeled ones coexist under one HELP/TYPE
+        header."""
+        pairs = [f'{self.label_name}="{value}"'] + [
+            f'{k}="{v}"' for k, v in sorted(extra.items())
+        ]
+        suffix = ",".join(pairs)
+        with self._lock:
+            child = self._children.get(suffix)
+            if child is None:
+                child = self.cls(self.name, self.help, **self._kw)
+                child._label_suffix = suffix
+                self._children[suffix] = child
+            return child
+
     def items(self) -> List:
         """Snapshot of (label value, child instrument) pairs — the public
         read surface for per-label reporting (bench_serving's per-stage

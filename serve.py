@@ -92,6 +92,15 @@ def parse_args(argv=None):
                    "view is GET /debug/traces)")
     p.add_argument("--trace_ring", type=int, default=256,
                    help="how many recent request traces to keep in memory")
+    p.add_argument("--trace_export", type=str, default=None, metavar="URL",
+                   help="ship finished request traces to a fleet trace "
+                   "collector (python -m dalle_pytorch_tpu.obs.collector) "
+                   "at URL as batched JSONL — bounded buffer + backoff; "
+                   "serving is unaffected when the collector is down")
+    p.add_argument("--trace_site", type=str, default=None, metavar="NAME",
+                   help="stable process identity for fleet traces and "
+                   "request-log lines (one track per site in the "
+                   "collector's merged view; default: hostname)")
     p.add_argument("--no_tracing", action="store_true",
                    help="disable the request span tracer entirely "
                    "(/debug/traces serves an empty trace; stage metrics "
@@ -146,6 +155,10 @@ def parse_args(argv=None):
         # gauge would sit at 0 forever — fail loudly, not silently
         p.error("--slo_ttft_ms/--slo_request_ms need the vitals sampler; "
                 "drop --no_vitals")
+    if args.trace_export is not None and args.no_tracing:
+        # the exporter ships finished traces; a disabled tracer never
+        # finishes any — fail loudly, not with a silently idle exporter
+        p.error("--trace_export needs the span tracer; drop --no_tracing")
     return args
 
 
@@ -159,7 +172,7 @@ def main(argv=None):
 
     from dalle_pytorch_tpu.obs import (
         EngineVitals, ProfilerCapture, ProgramCostTable, SLOTarget,
-        SLOTracker, StallWatchdog, StructuredLog, Tracer,
+        SLOTracker, StallWatchdog, StructuredLog, TraceExporter, Tracer,
     )
     from dalle_pytorch_tpu.serving import ServingServer, engine_from_checkpoint
 
@@ -167,8 +180,9 @@ def main(argv=None):
     # the one surviving print is the "[serve] listening" readiness line,
     # which orchestrators pattern-match. --no_request_log drops only the
     # per-request lines; lifecycle events (warmup, trace_dump, shutdown)
-    # always flow.
-    log = StructuredLog()
+    # always flow. --trace_site stamps every line's process identity so
+    # fleet logs merge and join against collector traces by trace_id.
+    log = StructuredLog(site=args.trace_site)
 
     batch_shapes = tuple(int(b) for b in args.batch_shapes.split(",") if b)
     engine = engine_from_checkpoint(
@@ -231,6 +245,16 @@ def main(argv=None):
         ),
     )
 
+    exporter = None
+    if args.trace_export is not None:
+        # the exporter registers its drop/sent/retry counters on the
+        # engine registry so /metrics carries fleet-export health
+        exporter = TraceExporter(
+            args.trace_export, site=args.trace_site,
+            registry=engine.registry,
+        )
+        log.event("trace_export", url=exporter.url, site=exporter.site)
+
     server = ServingServer(
         engine,
         host=args.host,
@@ -242,6 +266,7 @@ def main(argv=None):
         tracer=Tracer(
             enabled=not args.no_tracing, max_traces=args.trace_ring
         ),
+        exporter=exporter,
         log=log,
         log_requests=not args.no_request_log,
         profiler=ProfilerCapture(out_dir=args.profile_dir),

@@ -1,0 +1,52 @@
+"""TL009 negative fixture: every safe shape — finally-protected ends,
+except-handler ends, the context manager, cross-function begin/end (the
+batcher's cross-thread idiom), and non-trace `.begin()` receivers."""
+
+import contextlib
+
+
+def finally_protected(trace, work):
+    span = trace.begin("respond")
+    try:
+        work()
+    finally:
+        trace.end(span)
+
+
+def except_plus_success_path(trace, work):
+    # the serving HTTP handler's shape: error path ends with error=...,
+    # success path ends in straight-line code after the try
+    span = trace.begin("respond")
+    try:
+        payload = work()
+    except Exception as exc:
+        trace.end(span, error=repr(exc))
+        raise
+    trace.end(span)
+    return payload
+
+
+def context_manager(trace, work):
+    with trace.span("chunk"):
+        work()
+
+
+def cross_function_begin(trace):
+    # the batcher idiom: the queue span begins here and ends on the
+    # worker thread in another function — no same-function end, silent
+    return trace.begin("queue")
+
+
+def not_a_tracer(cursor, work):
+    txn = cursor.begin("txn")  # receiver names no trace: out of scope
+    work()
+    cursor.end(txn)
+
+
+def nested_finally(trace, work):
+    span = trace.begin("harvest")
+    try:
+        with contextlib.suppress(ValueError):
+            work()
+    finally:
+        trace.end(span, slots=1)

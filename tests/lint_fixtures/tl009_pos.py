@@ -1,0 +1,26 @@
+"""TL009 positive fixture: begin/end pairs in the same function with no
+exception-path end — a raise between them leaks the span open until
+finish() marks it abandoned."""
+
+
+def straight_line(trace, work):
+    span = trace.begin("respond")
+    work()  # a raise here leaks the span
+    trace.end(span)
+
+
+def end_inside_unprotected_if(req, ok):
+    span = req.trace.begin("harvest")
+    if ok:
+        req.trace.end(span)
+    else:
+        req.trace.end(span, error="bad")  # still straight-line code
+
+
+def try_without_cleanup_path(trace, work):
+    span = trace.begin("chunk")
+    try:
+        work()
+    except ValueError:
+        pass  # handler never ends the span; the success-path end
+    trace.end(span)  # is not exception-reachable for other raises

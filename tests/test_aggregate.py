@@ -1,0 +1,706 @@
+"""Fleet trace aggregation: exporter, collector, context propagation.
+
+The acceptance pins (ISSUE 9):
+
+  * two process identities (a bench client + a serving server, distinct
+    exporter sites) shipping to one collector yield exactly ONE
+    assembled trace whose Perfetto export renders each process as its
+    own track, the client span parenting the server spans via the
+    propagated x-dalle-trace header — including the out-of-order-arrival
+    case (server half ingested first);
+  * exporter off => zero serialized spans (counter-gated NULL_EXPORTER,
+    the NULL_TRACE idiom);
+  * exporter on with the collector unreachable => every request still
+    serves, memory stays bounded at `max_buffer`, drops are counted in
+    `dalle_obs_export_dropped_total`.
+
+Everything here runs with stubbed transports or localhost HTTP against
+fake engines — no model, no device, fast tier.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dalle_pytorch_tpu.obs import (
+    NULL_EXPORTER,
+    TRACE_HEADER,
+    CollectorServer,
+    StructuredLog,
+    TraceCollector,
+    TraceExporter,
+    Tracer,
+    format_trace_header,
+    parse_trace_header,
+)
+from dalle_pytorch_tpu.serving.server import ServingServer
+from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+from test_serving_e2e import FakeServingEngine, _get, _post
+
+
+# ------------------------------------------------------------ header codec
+
+
+class TestTraceHeaderCodec:
+    def test_round_trip(self):
+        tid = "deadbeefcafe0123"
+        assert parse_trace_header(format_trace_header(tid)) == (tid, None)
+        assert parse_trace_header(
+            format_trace_header(tid, "site:41:7")
+        ) == (tid, "site:41:7")
+
+    def test_exporter_minted_header_round_trips(self):
+        tracer = Tracer()
+        exp = _StubExporter("http://unused", site="bench-client.02")
+        trace = tracer.start_trace("client")
+        span = trace.begin("client_request")
+        tid, parent = parse_trace_header(exp.context_header(trace, span))
+        assert tid == trace.trace_id
+        # host is part of the identity: same-site same-pid replicas on
+        # two hosts (containers both at pid 1) must not collide
+        assert parent == (
+            f"bench-client.02:{exp.host}:{exp.pid}:{span.span_id}"
+        )
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "UPPERHEX0000", "short", "g" * 16,
+        "deadbeefcafe0123/bad uid", "deadbeefcafe0123/" + "x" * 200,
+        "deadbeefcafe0123/uid/extra",
+    ])
+    def test_garbage_rejected(self, bad):
+        assert parse_trace_header(bad) is None
+
+    def test_trailing_slash_alone_rejected(self):
+        assert parse_trace_header("deadbeefcafe0123/") is None
+
+
+# ---------------------------------------------------------------- exporter
+
+
+class _StubExporter(TraceExporter):
+    """Transport stub: records bodies instead of touching a socket, and
+    fails on demand — the backoff/overflow tests drive `_flush_once`
+    synchronously (no thread)."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.posted = []
+        self.fail = False
+
+    def _post(self, body):
+        if self.fail:
+            raise ConnectionRefusedError("collector down")
+        self.posted.append(body)
+
+
+def _finished_trace(tracer, **args):
+    t = tracer.start_trace("request", **args)
+    with t.span("queue"):
+        pass
+    t.finish("ok")
+    return t
+
+
+class TestExporter:
+    def test_off_is_null_and_counter_gated(self):
+        """No exporter attached: the tracer holds the shared no-op and
+        serializes nothing, however much traffic flows."""
+        tracer = Tracer()
+        assert tracer.exporter is NULL_EXPORTER and not tracer.exporter
+        for _ in range(8):
+            _finished_trace(tracer)
+        assert tracer.exporter.spans_serialized == 0
+        assert tracer.exporter.dropped == 0
+
+    def test_finished_traces_ship_as_jsonl(self):
+        tracer = Tracer()
+        exp = _StubExporter("http://c", site="srv")
+        tracer.exporter = exp  # no thread: flush driven synchronously
+        t1 = _finished_trace(tracer, rows=1)
+        t2 = _finished_trace(tracer, rows=2)
+        assert exp.buffered == 2
+        assert exp._flush_once() and exp.buffered == 0
+        (body,) = exp.posted
+        recs = [json.loads(line) for line in body.decode().splitlines()]
+        assert [r["trace_id"] for r in recs] == [t1.trace_id, t2.trace_id]
+        for rec in recs:
+            assert rec["site"] == "srv" and rec["pid"] == exp.pid
+            assert rec["outcome"] == "ok" and rec["parent_uid"] is None
+            names = {s["name"] for s in rec["spans"]}
+            assert names == {"request", "queue"}
+            for s in rec["spans"]:
+                # wire timestamps are unix seconds, not monotonic
+                assert abs(s["t0"] - time.time()) < 60.0
+                assert s["t1"] >= s["t0"]
+        assert exp.spans_serialized == 4
+        assert exp.traces_sent == 2
+
+    def test_backoff_grows_and_resets(self):
+        reg = MetricsRegistry()
+        exp = _StubExporter(
+            "http://c", site="srv", registry=reg,
+            backoff_s=0.5, backoff_max_s=4.0,
+        )
+        tracer = Tracer()
+        tracer.exporter = exp
+        exp.fail = True
+        for i, expect in enumerate((0.5, 1.0, 2.0, 4.0, 4.0)):
+            _finished_trace(tracer)
+            assert not exp._flush_once()
+            assert exp.current_backoff_s == expect
+            assert exp.consecutive_failures == i + 1
+        assert reg.get("dalle_obs_export_retries_total").value == 5
+        # the failed batch went back to the FRONT: nothing was lost yet
+        assert exp.buffered == 5
+        exp.fail = False
+        assert exp._flush_once()
+        assert exp.current_backoff_s == 0.0 and exp.consecutive_failures == 0
+        assert exp.buffered == 0 and exp.traces_sent == 5
+
+    def test_overflow_drops_oldest_with_counter(self):
+        reg = MetricsRegistry()
+        exp = _StubExporter("http://c", site="srv", registry=reg,
+                            max_buffer=3)
+        exp.fail = True
+        tracer = Tracer()
+        tracer.exporter = exp
+        traces = [_finished_trace(tracer, i=i) for i in range(6)]
+        assert exp.buffered == 3  # bounded memory, whatever the offered load
+        assert exp.dropped == 3
+        assert reg.get("dalle_obs_export_dropped_total").value == 3
+        exp.fail = False
+        assert exp._flush_once()
+        recs = [
+            json.loads(line) for line in exp.posted[0].decode().splitlines()
+        ]
+        # the freshest traces survived the overflow
+        assert [r["trace_id"] for r in recs] == [
+            t.trace_id for t in traces[3:]
+        ]
+
+    def test_requeue_after_failure_respects_bound(self):
+        exp = _StubExporter("http://c", site="srv", max_buffer=2,
+                            max_batch=2)
+        exp.fail = True
+        tracer = Tracer()
+        tracer.exporter = exp
+        for i in range(2):
+            _finished_trace(tracer, i=i)
+        assert not exp._flush_once()  # batch re-queued at the front
+        assert exp.buffered == 2
+        _finished_trace(tracer, i=99)  # overflow: oldest of the retry drops
+        assert exp.buffered == 2 and exp.dropped == 1
+
+    def test_site_sanitized_to_header_alphabet(self):
+        """A site with '/', spaces, or ':' would mint parent_uids the
+        header codec rejects — silently disabling fleet joins; the
+        exporter (and StructuredLog, same clamp) sanitizes instead."""
+        tracer = Tracer()
+        exp = _StubExporter("http://c", site="eu/replica 0:a")
+        trace = tracer.start_trace("client")
+        span = trace.begin("hop")
+        parsed = parse_trace_header(exp.context_header(trace, span))
+        assert parsed is not None and parsed[0] == trace.trace_id
+        import io
+
+        buf = io.StringIO()
+        StructuredLog(stream=buf, site="eu/replica 0:a").event("x")
+        assert json.loads(buf.getvalue())["site"] == exp.site
+
+    def test_stop_final_flush_drains_every_batch(self):
+        """stop() ships the WHOLE buffer (in max_batch posts), not one
+        batch — a drain-then-shutdown burst must not silently lose the
+        tail."""
+        exp = _StubExporter("http://c", site="srv", max_batch=2)
+        tracer = Tracer()
+        # attach minus the thread, so batch boundaries stay deterministic
+        tracer.exporter = exp
+        exp._tracer = tracer
+        for i in range(5):
+            _finished_trace(tracer, i=i)
+        exp.stop(final_flush=True)
+        assert exp.buffered == 0 and exp.traces_sent == 5
+        assert len(exp.posted) == 3  # ceil(5/2)
+        assert tracer.exporter is NULL_EXPORTER  # detached cleanly
+
+    def test_poisoned_trace_dropped_with_counter_not_fatal(self):
+        """A span arg json.dumps cannot serialize (circular ref — even
+        default=str can't rescue it) drops THAT trace with a counter;
+        the rest of the batch still ships and the shipper survives."""
+        exp = _StubExporter("http://c", site="srv")
+        tracer = Tracer()
+        tracer.exporter = exp
+        good1 = _finished_trace(tracer, i=0)
+        circular: dict = {}
+        circular["self"] = circular
+        _finished_trace(tracer, bad=circular)
+        good2 = _finished_trace(tracer, i=1)
+        assert exp._flush_once()
+        assert exp.dropped == 1 and exp.traces_sent == 2
+        recs = [
+            json.loads(line) for line in exp.posted[0].decode().splitlines()
+        ]
+        assert [r["trace_id"] for r in recs] == [
+            good1.trace_id, good2.trace_id,
+        ]
+
+    def test_export_call_is_nonblocking_while_transport_down(self):
+        """The serving-path pin at the unit level: export() is a bounded
+        append even when every POST fails — no socket on the caller."""
+        exp = _StubExporter("http://c", site="srv", max_buffer=4)
+        exp.fail = True
+        tracer = Tracer()
+        tracer.exporter = exp
+        t0 = time.monotonic()
+        for _ in range(100):
+            _finished_trace(tracer)
+        assert time.monotonic() - t0 < 1.0
+        assert exp.buffered == 4
+
+
+# --------------------------------------------------------------- collector
+
+
+def _record(trace_id="deadbeefcafe0123", site="srv", pid=41, host="h1",
+            spans=None, parent_uid=None, outcome="ok"):
+    return {
+        "schema": 1, "trace_id": trace_id, "site": site, "pid": pid,
+        "host": host, "outcome": outcome, "parent_uid": parent_uid,
+        "spans": spans if spans is not None else [
+            {"sid": 0, "parent": None, "name": "request",
+             "t0": 100.0, "t1": 100.1, "args": {}},
+            {"sid": 1, "parent": 0, "name": "queue",
+             "t0": 100.0, "t1": 100.02, "args": {}},
+            {"sid": 2, "parent": 0, "name": "chunk",
+             "t0": 100.02, "t1": 100.1, "args": {}},
+        ],
+    }
+
+
+def _client_record(trace_id="deadbeefcafe0123", pid=7):
+    return _record(
+        trace_id=trace_id, site="bench", pid=pid, host="h0",
+        spans=[
+            {"sid": 0, "parent": None, "name": "client",
+             "t0": 99.99, "t1": 100.12, "args": {}},
+            {"sid": 1, "parent": 0, "name": "client_request",
+             "t0": 99.995, "t1": 100.11, "args": {}},
+        ],
+    )
+
+
+class TestCollectorJoin:
+    def test_two_processes_one_assembled_trace(self):
+        col = TraceCollector()
+        server_rec = _record(parent_uid="bench:h0:7:1")
+        out = col.ingest_lines(
+            json.dumps(_client_record()) + "\n" + json.dumps(server_rec)
+        )
+        assert out == {"accepted": 2, "rejected": 0}
+        assert len(col) == 1  # ONE trace, not two
+        bundle = col.find("deadbeefcafe0123")
+        assert set(bundle.procs) == {"bench@h0:7", "srv@h1:41"}
+        assert bundle.procs["srv@h1:41"]["parent_uid"] == "bench:h0:7:1"
+
+    def test_out_of_order_arrival_assembles_identically(self):
+        """The server's half landing FIRST (exporters flush on their own
+        cadence) must assemble the same one trace with the same parent
+        edge."""
+        in_order, reversed_order = TraceCollector(), TraceCollector()
+        client, server = _client_record(), _record(parent_uid="bench:h0:7:1")
+        in_order.ingest_record(client)
+        in_order.ingest_record(server)
+        reversed_order.ingest_record(server)
+        reversed_order.ingest_record(client)
+        for col in (in_order, reversed_order):
+            assert len(col) == 1
+            ev = col.trace_events(trace_id="deadbeefcafe0123")
+            tracks = sorted(
+                e["args"]["name"] for e in ev["traceEvents"]
+                if e["ph"] == "M"
+            )
+            assert tracks == ["bench (h0:7)", "srv (h1:41)"]
+        assert (
+            in_order.trace_events("deadbeefcafe0123")
+            == reversed_order.trace_events("deadbeefcafe0123")
+        )
+
+    def test_duplicate_spans_deduped(self):
+        col = TraceCollector()
+        rec = _record()
+        rec["run"] = "aaaa0001"
+        col.ingest_record(rec)
+        col.ingest_record(rec)  # an exporter retry re-sends its batch
+        bundle = col.find(rec["trace_id"])
+        assert len(bundle.spans) == 3
+        assert col.duplicate_spans == 3
+        ev = col.trace_events(trace_id=rec["trace_id"])
+        xs = [e for e in ev["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 3  # never double-rendered
+
+    def test_client_retry_same_header_is_not_a_duplicate(self):
+        """A retried request legitimately reuses its x-dalle-trace
+        header: same trace_id, same process, FRESH trace instance whose
+        span ids restart at 0. The per-instance `run` nonce keeps both
+        attempts' spans — only true exporter re-sends dedupe."""
+        col = TraceCollector()
+        first = _record()
+        first["run"] = "aaaa0001"
+        retry = _record()
+        retry["run"] = "bbbb0002"
+        col.ingest_record(first)
+        col.ingest_record(retry)
+        bundle = col.find(first["trace_id"])
+        assert len(bundle.spans) == 6  # both attempts retained
+        assert col.duplicate_spans == 0
+        ev = col.trace_events(trace_id=first["trace_id"])
+        xs = [e for e in ev["traceEvents"] if e["ph"] == "X"]
+        assert len([e for e in xs if e["name"] == "chunk"]) == 2
+
+    def test_grace_window_seals_and_late_arrivals_count(self):
+        col = TraceCollector(grace_s=10.0)
+        col.ingest_record(_client_record(), now=1000.0)
+        # inside the window: settling, merges silently
+        assert col.sweep(now=1005.0) == 0
+        col.ingest_record(_record(parent_uid="bench:h0:7:1"), now=1005.0)
+        assert col.late_spans == 0
+        bundle = col.find("deadbeefcafe0123")
+        assert not bundle.sealed  # still settling inside the window
+        # idle past grace_s: sealed
+        assert col.sweep(now=1015.1) == 1
+        assert bundle.sealed
+        # late arrival after sealing: STILL one trace, but counted
+        late = _record(site="srv2", pid=42, host="h2", spans=[
+            {"sid": 0, "parent": None, "name": "request",
+             "t0": 100.0, "t1": 100.05, "args": {}},
+        ])
+        col.ingest_record(late, now=1016.0)
+        assert len(col) == 1
+        assert col.late_spans == 1
+        assert col.find("deadbeefcafe0123").late_spans == 1
+
+    def test_bounded_retention_evicts_oldest(self):
+        col = TraceCollector(max_traces=3)
+        for i in range(5):
+            col.ingest_record(_record(trace_id=f"{i:016x}"))
+        assert len(col) == 3
+        assert col.traces_evicted == 2
+        assert col.find(f"{0:016x}") is None
+        assert col.find(f"{4:016x}") is not None
+
+    def test_malformed_input_counted_never_fatal(self):
+        col = TraceCollector()
+        out = col.ingest_lines(
+            "not json\n"
+            + json.dumps({"trace_id": 7}) + "\n"
+            + json.dumps(_record(spans=[
+                {"sid": "bad", "name": "x", "t0": 1, "t1": 2},
+                {"sid": 1, "parent": None, "name": "ok",
+                 "t0": 1.0, "t1": 2.0},
+            ]))
+        )
+        assert out["rejected"] == 2 and out["accepted"] == 1
+        assert col.bad_records == 2 and col.bad_spans == 1
+        assert len(col.find(_record()["trace_id"]).spans) == 1
+
+    def test_flow_events_bind_client_span_to_server_root(self):
+        col = TraceCollector()
+        col.ingest_record(_client_record())
+        col.ingest_record(_record(parent_uid="bench:h0:7:1"))
+        ev = col.trace_events(trace_id="deadbeefcafe0123")["traceEvents"]
+        pids = {
+            e["args"]["name"]: e["pid"] for e in ev if e["ph"] == "M"
+        }
+        flows = {e["ph"]: e for e in ev if e["ph"] in ("s", "f")}
+        assert set(flows) == {"s", "f"}
+        # arrow starts on the client's track, finishes on the server's
+        assert flows["s"]["pid"] == pids["bench (h0:7)"]
+        assert flows["f"]["pid"] == pids["srv (h1:41)"]
+        # the server root's uid is addressable in args (join debugging)
+        server_req = [
+            e for e in ev
+            if e["ph"] == "X" and e["args"].get("uid") == "srv:h1:41:0"
+        ]
+        assert len(server_req) == 1 and server_req[0]["name"] == "request"
+
+
+class TestCriticalPath:
+    def test_stage_percentiles_and_dominant_attribution(self):
+        col = TraceCollector()
+        # 3 traces: chunk dominates two, queue dominates one
+        for i, (queue_s, chunk_s) in enumerate(
+            [(0.01, 0.08), (0.01, 0.06), (0.2, 0.05)]
+        ):
+            t0 = 100.0
+            col.ingest_record(_record(
+                trace_id=f"{i:016x}",
+                spans=[
+                    {"sid": 0, "parent": None, "name": "request",
+                     "t0": t0, "t1": t0 + queue_s + chunk_s, "args": {}},
+                    {"sid": 1, "parent": 0, "name": "queue",
+                     "t0": t0, "t1": t0 + queue_s, "args": {}},
+                    {"sid": 2, "parent": 0, "name": "chunk",
+                     "t0": t0 + queue_s, "t1": t0 + queue_s + chunk_s,
+                     "args": {}},
+                ],
+            ))
+        cp = col.critical_path()
+        assert cp["traces"] == 3
+        assert cp["stages"]["chunk"]["count"] == 3
+        assert cp["stages"]["chunk"]["p50_ms"] == 60.0
+        assert cp["stages"]["queue"]["p95_ms"] == 200.0
+        dom = cp["critical_path"]["dominant"]
+        assert dom["chunk"]["traces"] == 2
+        assert dom["queue"] == {"traces": 1, "fraction": 0.333}
+        attr = cp["critical_path"]["attributed_ms"]
+        assert attr["chunk"]["count"] == 3
+
+    def test_parent_covering_spans_excluded_from_attribution(self):
+        """The per-process root (and the client's enclosing span) cover
+        their children and must not double-count."""
+        col = TraceCollector()
+        col.ingest_record(_client_record())
+        col.ingest_record(_record(parent_uid="bench:h0:7:1"))
+        cp = col.critical_path()
+        assert "request" not in cp["stages"]
+        assert "client_request" not in cp["stages"]
+        assert {"queue", "chunk"} <= set(cp["stages"])
+
+    def test_untraced_gap_attributed(self):
+        col = TraceCollector()
+        col.ingest_record(_record(spans=[
+            {"sid": 0, "parent": None, "name": "request",
+             "t0": 100.0, "t1": 100.2, "args": {}},
+            {"sid": 1, "parent": 0, "name": "queue",
+             "t0": 100.0, "t1": 100.05, "args": {}},
+            # 0.1s of host time no span claims
+            {"sid": 2, "parent": 0, "name": "chunk",
+             "t0": 100.15, "t1": 100.2, "args": {}},
+        ]))
+        attr = col.critical_path()["critical_path"]["attributed_ms"]
+        assert attr["(untraced)"]["p50_ms"] == 100.0
+
+
+# ----------------------------------------------------- collector over HTTP
+
+
+@pytest.fixture()
+def collector_server():
+    server = CollectorServer(grace_s=0.05).start()
+    try:
+        yield server
+    finally:
+        server.shutdown()
+
+
+def _collector_get(server, path):
+    with urllib.request.urlopen(
+        f"{server.url}{path}", timeout=10
+    ) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+class TestCollectorHTTP:
+    def test_ingest_traces_critical_path_healthz(self, collector_server):
+        body = (
+            json.dumps(_client_record()) + "\n"
+            + json.dumps(_record(parent_uid="bench:h0:7:1")) + "\n"
+        ).encode()
+        req = urllib.request.Request(
+            f"{collector_server.url}/ingest", data=body, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert json.loads(resp.read()) == {
+                "accepted": 2, "rejected": 0,
+            }
+        status, payload = _collector_get(collector_server, "/traces")
+        assert status == 200
+        assert len(
+            [e for e in payload["traceEvents"] if e["ph"] == "M"]
+        ) == 2
+        status, payload = _collector_get(
+            collector_server, "/traces?trace_id=deadbeefcafe0123"
+        )
+        assert status == 200 and payload["traceEvents"]
+        status, payload = _collector_get(collector_server, "/critical_path")
+        assert status == 200 and payload["traces"] == 1
+        status, payload = _collector_get(collector_server, "/healthz")
+        assert status == 200 and payload["status"] == "ok"
+        assert payload["records_ingested"] == 2
+
+    def test_unknown_trace_404_and_bad_n_400(self, collector_server):
+        for path, code in (
+            ("/traces?trace_id=ffffffffffffffff", 404),
+            ("/traces?n=0", 400),
+            ("/nope", 404),
+        ):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _collector_get(collector_server, path)
+            assert e.value.code == code
+
+
+# ------------------------------------- acceptance: serving e2e over HTTP
+
+
+class TestFleetE2E:
+    """A bench client + one serving server (distinct exporter sites, one
+    process) exporting to one collector: the ISSUE's acceptance pin."""
+
+    def _serve_one(self, collector_url, site, header=None, out_of_order=False):
+        exporter = TraceExporter(collector_url, site=site)
+        server = ServingServer(
+            FakeServingEngine(), port=0, max_delay_ms=5,
+            tracer=Tracer(max_traces=16), exporter=exporter,
+        ).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/generate",
+                data=json.dumps({"prompt": "fleet"}).encode(),
+                headers={"Content-Type": "application/json",
+                         **({TRACE_HEADER: header} if header else {})},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = json.loads(resp.read())
+            assert exporter.flush(timeout_s=10.0)
+            return payload
+        finally:
+            server.shutdown()
+
+    def test_client_and_server_stitch_into_one_trace(self):
+        collector = CollectorServer(grace_s=0.05).start()
+        client_exp = TraceExporter(collector.url, site="bench")
+        client_tracer = Tracer()
+        client_exp.attach(client_tracer)
+        try:
+            trace = client_tracer.start_trace("client")
+            span = trace.begin("client_request")
+            header = client_exp.context_header(trace, span)
+            payload = self._serve_one(collector.url, "srv", header=header)
+            trace.end(span)
+            trace.finish("ok")
+            assert client_exp.flush(timeout_s=10.0)
+
+            # the server ADOPTED the propagated trace id
+            assert payload["trace_id"] == trace.trace_id
+            col = collector.collector
+            assert len(col) == 1  # exactly ONE assembled trace
+            bundle = col.find(trace.trace_id)
+            assert len(bundle.procs) == 2
+            srv_proc = next(
+                p for p in bundle.procs.values() if p["site"] == "srv"
+            )
+            assert srv_proc["parent_uid"] == client_exp.span_uid(span)
+
+            ev = col.trace_events(trace_id=trace.trace_id)["traceEvents"]
+            tracks = [e["args"]["name"] for e in ev if e["ph"] == "M"]
+            assert len(tracks) == 2  # one track per process identity
+            assert any(t.startswith("bench ") for t in tracks)
+            assert any(t.startswith("srv ") for t in tracks)
+            names = {e["name"] for e in ev if e["ph"] == "X"}
+            # client stage + the server's full stage vocabulary, merged
+            assert {"client_request", "request", "queue", "generate",
+                    "respond"} <= names
+            assert {e["ph"] for e in ev} >= {"s", "f"}  # the parent arrow
+            # the export is valid JSON end to end
+            json.loads(json.dumps(col.trace_events()))
+        finally:
+            collector.shutdown()
+            client_exp.stop(final_flush=False)
+
+    def test_absent_header_mints_locally(self):
+        collector = CollectorServer(grace_s=0.05).start()
+        try:
+            payload = self._serve_one(collector.url, "solo", header=None)
+            bundle = collector.collector.find(payload["trace_id"])
+            assert bundle is not None
+            (proc,) = bundle.procs.values()
+            assert proc["site"] == "solo" and proc["parent_uid"] is None
+        finally:
+            collector.shutdown()
+
+    def test_malformed_header_rejected_not_adopted(self):
+        collector = CollectorServer(grace_s=0.05).start()
+        try:
+            payload = self._serve_one(
+                collector.url, "srv", header="NOT-A-TRACE/###",
+            )
+            # a fresh 16-hex id was minted instead of adopting garbage
+            assert parse_trace_header(payload["trace_id"]) == (
+                payload["trace_id"], None,
+            )
+        finally:
+            collector.shutdown()
+
+    def test_collector_down_serving_unaffected(self):
+        """The other acceptance pin: exporter on, collector unreachable
+        — every request serves, buffer memory bounded, drops counted."""
+        import socket
+
+        # grab a port that is certainly closed
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        reg = MetricsRegistry()
+        exporter = TraceExporter(
+            f"http://127.0.0.1:{dead_port}", site="srv", registry=reg,
+            max_buffer=4, flush_interval_s=0.05, backoff_s=0.05,
+            timeout_s=0.5,
+        )
+        server = ServingServer(
+            FakeServingEngine(), port=0, max_delay_ms=5,
+            tracer=Tracer(max_traces=32), exporter=exporter,
+        ).start()
+        try:
+            for i in range(8):
+                status, payload = _post(
+                    server.port, {"prompt": f"req {i}"}
+                )
+                assert status == 200 and payload["trace_id"]
+            deadline = time.monotonic() + 10.0
+            while exporter.dropped == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert exporter.buffered <= exporter.max_buffer
+            assert exporter.dropped > 0
+            assert reg.get("dalle_obs_export_dropped_total").value > 0
+            assert exporter.consecutive_failures > 0
+            # the postmortem dump names the export failure
+            dump = server.state_dump()
+            assert dump["trace_export"]["last_error"]
+        finally:
+            server.shutdown()  # final flush is best-effort and bounded
+
+
+# ----------------------------------------------------- log identity fields
+
+
+class TestLogIdentity:
+    def test_every_line_carries_site_pid_host(self):
+        import io
+        import os
+
+        buf = io.StringIO()
+        log = StructuredLog(stream=buf, site="replica-3")
+        log.event("stall", reason="dispatch_stuck")
+        log.request(trace_id="t1", outcome="ok", status=200, latency_ms=1.0)
+        lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert len(lines) == 2
+        for rec in lines:
+            assert rec["site"] == "replica-3"
+            assert rec["pid"] == os.getpid()
+            assert rec["host"]
+
+    def test_site_defaults_stable(self):
+        import io
+
+        buf = io.StringIO()
+        log = StructuredLog(stream=buf)
+        log.event("a")
+        log.event("b")
+        recs = [json.loads(l) for l in buf.getvalue().splitlines()]
+        assert recs[0]["site"] == recs[1]["site"] != ""

@@ -48,6 +48,7 @@ class TestRuleCorpus:
             ("tl006_pos.py", "TL006", 4),
             ("tl007_pos.py", "TL007", 3),
             ("tl008_pos.py", "TL008", 3),
+            ("tl009_pos.py", "TL009", 3),
         ],
     )
     def test_positive_fixture_caught(self, fixture, code, expected):
@@ -73,6 +74,7 @@ class TestRuleCorpus:
             "tl006_neg.py",
             "tl007_neg.py",
             "tl008_neg.py",
+            "tl009_neg.py",
         ],
     )
     def test_negative_fixture_clean(self, fixture):
@@ -153,6 +155,40 @@ class TestRuleCorpus:
         result = lint_paths([f])
         assert codes(result) == ["TL008", "TL008"]
         assert "'model'" in result.findings[0].message
+
+    def test_tl009_finally_placement_is_decisive(self, tmp_path):
+        """The same begin/work/end sequence flips clean<->finding on
+        exactly one change: whether the end is exception-reachable."""
+        template = textwrap.dedent(
+            """\
+            def handler(trace, work):
+                span = trace.begin("respond")
+                {shape}
+            """
+        )
+        leaky = tmp_path / "leaky.py"
+        leaky.write_text(template.format(shape="work()\n    trace.end(span)"))
+        assert codes(lint_paths([leaky])) == ["TL009"]
+        safe = tmp_path / "safe.py"
+        safe.write_text(template.format(
+            shape="try:\n        work()\n    finally:\n"
+            "        trace.end(span)"
+        ))
+        assert lint_paths([safe]).clean
+
+    def test_tl009_receiver_must_name_a_trace(self, tmp_path):
+        """Unrelated `.begin()` APIs (db cursors, matchers) are out of
+        scope — the receiver heuristic keeps the rule quiet there."""
+        f = tmp_path / "cursor.py"
+        f.write_text(textwrap.dedent(
+            """\
+            def txn(db, work):
+                handle = db.begin("rw")
+                work()
+                db.end(handle)
+            """
+        ))
+        assert lint_paths([f]).clean
 
 
 # --------------------------------------------------------- severity tiers

@@ -121,6 +121,98 @@ class TestProgramCostTable:
         assert not table.has("eager")
 
 
+class PerShardCompiled:
+    """Compiled stand-in whose cost_analysis reports one entry per
+    partition — the 'where jax exposes per-shard data' arm."""
+
+    def __init__(self, per_dev):
+        self._per = per_dev
+
+    def cost_analysis(self):
+        return [dict(c) for c in self._per]
+
+    def memory_analysis(self):
+        return None
+
+
+class TestPerShardCostRows:
+    PER_DEV = [
+        {"flops": 1e9, "bytes accessed": 1e8},
+        {"flops": 3e9, "bytes accessed": 3e8},
+    ]
+
+    def _table(self, reg=None):
+        table = ProgramCostTable(
+            peak_flops=1e12, hbm_bps=1e11, registry=reg
+        )
+        table.add(
+            "chunk", PerShardCompiled(self.PER_DEV),
+            devices=["cpu:0", "cpu:1"],
+        )
+        return table
+
+    def test_per_shard_rows_and_global_sum(self):
+        table = self._table()
+        (row,) = table.rows(per_shard=True)
+        # the global row is the SUM of the partitions, not entry 0
+        assert row["flops"] == 4e9 and row["bytes_accessed"] == 4e8
+        shards = {s["device"]: s for s in row["per_shard"]}
+        assert shards["cpu:0"]["flops"] == 1e9
+        assert shards["cpu:1"]["flops"] == 3e9
+        # default rows() view is unchanged (no per_shard key)
+        (plain,) = table.rows()
+        assert "per_shard" not in plain
+
+    def test_per_shard_mfu_gauges_and_row_values(self):
+        reg = MetricsRegistry()
+        table = self._table(reg)
+        table.record_wall("chunk", 0.010, synced=True)
+        (row,) = table.rows(per_shard=True)
+        shards = {s["device"]: s for s in row["per_shard"]}
+        # per-device MFU divides each shard's OWN flops by the shared
+        # collective wall — the lopsided shard reads 3x the other
+        assert shards["cpu:1"]["mfu"] == pytest.approx(
+            3e9 / (0.010 * 1e12), rel=1e-3
+        )
+        assert shards["cpu:1"]["mfu"] == pytest.approx(
+            3 * shards["cpu:0"]["mfu"], rel=1e-3
+        )
+        out = reg.render()
+        assert 'dalle_serving_mfu{program="chunk"}' in out
+        assert 'dalle_serving_mfu{program="chunk",device="cpu:0"}' in out
+        assert 'dalle_serving_hbm_gbps{program="chunk",device="cpu:1"}' in out
+
+    def test_global_only_analysis_falls_back(self):
+        """The common jax shape (one entry for the whole partitioned
+        program) keeps the global row alone even with devices passed."""
+        table = ProgramCostTable()
+        table.add(
+            "prefill", FakeCompiled(flops=5e9), devices=["cpu:0", "cpu:1"]
+        )
+        (row,) = table.rows(per_shard=True)
+        assert "per_shard" not in row and row["flops"] == 5e9
+
+    def test_debug_programs_per_shard_query(self):
+        """GET /debug/programs?per_shard=1 surfaces the block; the plain
+        endpoint stays global-only."""
+        eng = FakeServingEngine()
+        eng.cost_table = self._table(eng.registry)
+        server = ServingServer(eng, port=0, max_delay_ms=5).start()
+        try:
+            status, body = _get(server.port, "/debug/programs")
+            assert status == 200
+            (row,) = json.loads(body)["programs"]
+            assert "per_shard" not in row
+            status, body = _get(server.port, "/debug/programs?per_shard=1")
+            assert status == 200
+            (row,) = json.loads(body)["programs"]
+            assert [s["device"] for s in row["per_shard"]] == [
+                "cpu:0", "cpu:1",
+            ]
+        finally:
+            server.shutdown()
+
+
 # ----------------------------------------------------------------- SLO burn
 
 
