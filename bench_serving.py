@@ -49,6 +49,18 @@ per-device state-buffer bytes, and the per-device memory PEAK over the
 measured window. On CPU pair it with
 XLA_FLAGS=--xla_force_host_platform_device_count=8.
 
+Priority mix (`--priority_mix P`, SERVE_PRIORITY_MIX): the QoS acceptance
+instrument. Open-loop Poisson arrivals at an OVERLOAD rate
+(SERVE_PRIORITY_OVERLOAD x the continuous engine's measured saturation,
+default 1.3) against ONE continuous batcher with preemption + deadline
+shedding on; each arrival is "high" with probability P, "low" otherwise
+(bimodal). The JSON line reports per-class completion and TTFT
+percentiles, the preemption/resumption/shed counter families, and
+`high_ttft_p95_ratio_vs_unloaded` — high-priority p95 TTFT against the
+same batcher's measured UNLOADED baseline. The QoS claim is that ratio
+staying small (the low class absorbs the overload via preemption and
+shedding) while low-class p95 degrades.
+
 Fleet tracing (`--trace_export`, SERVE_TRACE_EXPORT=1): every measured
 request is traced client-side (the bench plays the ingress role) and
 shipped through a real `TraceExporter` to an in-process
@@ -682,6 +694,231 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
         collector_srv.shutdown()
 
 
+def _class_counter_values(registry, name):
+    """{label: value} of a counter family (empty when never registered)."""
+    fam = registry.get(name)
+    if fam is None:
+        return {}
+    return {label: int(child.value) for label, child in fam.items()}
+
+
+def _ttft_stats(ttfts):
+    if not ttfts:
+        return {"ttft_p50_ms": None, "ttft_p95_ms": None}
+    return {
+        "ttft_p50_ms": round(1000 * _percentile(ttfts, 0.5), 1),
+        "ttft_p95_ms": round(1000 * _percentile(ttfts, 0.95), 1),
+    }
+
+
+def run_priority_open_loop(batcher, arrivals, seeds, texts, priorities,
+                           timeout_s):
+    """Replay a Poisson schedule with per-arrival priority classes.
+
+    Returns {class: stats} with offered/shed/rejected/completed counts
+    and TTFT percentiles per class. Sheds (`ShedError`) and queue-full
+    rejects are counted separately: under deliberate overload both are
+    CORRECT behavior for the low class, and the bench line must show
+    which mechanism absorbed the excess."""
+    from dalle_pytorch_tpu.serving.engine import SampleSpec
+    from dalle_pytorch_tpu.serving.qos import ShedError, TenantQuotaError
+
+    per_class = {
+        c: {"offered": 0, "shed": 0, "rejected": 0, "errors": 0,
+            "completed": 0, "ttfts": []}
+        for c in set(priorities)
+    }
+    submitted = []
+    t_start = time.monotonic()
+    for i, (offset, seed) in enumerate(zip(arrivals, seeds)):
+        delay = t_start + offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        cls = priorities[i]
+        stats = per_class[cls]
+        stats["offered"] += 1
+        try:
+            req = batcher.submit(
+                [SampleSpec(texts[i], seed=int(seed))],
+                timeout_s=timeout_s, priority=cls,
+            )
+            submitted.append((time.monotonic(), cls, req))
+        except (ShedError, TenantQuotaError):
+            stats["shed"] += 1
+        except Exception:
+            stats["rejected"] += 1
+    for t_submit, cls, req in submitted:
+        stats = per_class[cls]
+        try:
+            req.future.result(timeout=timeout_s + 30.0)
+        except Exception:
+            stats["errors"] += 1
+            continue
+        stats["completed"] += 1
+        if req.first_token_at is not None:
+            stats["ttfts"].append(req.first_token_at - t_submit)
+    out = {}
+    for cls, stats in per_class.items():
+        ttfts = stats.pop("ttfts")
+        out[cls] = {**stats, **_ttft_stats(ttfts)}
+    return out
+
+
+def main_priority_mix(mix, kv_layout="slot", prompt_reuse=0.0):
+    """`--priority_mix`: QoS under deliberate overload, one JSON line."""
+    import jax
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving.batcher import ContinuousBatcher
+    from dalle_pytorch_tpu.serving.engine import (
+        ContinuousEngine, PagedContinuousEngine, SampleSpec,
+    )
+    from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+    assert 0.0 < mix < 1.0, "--priority_mix is the HIGH-class fraction"
+    os.environ.setdefault("SERVE_DIM", "128")
+    os.environ.setdefault("SERVE_DEPTH", "3")
+    os.environ.setdefault("SERVE_FMAP", "8")
+    shapes = tuple(
+        int(b) for b in os.environ.get("SERVE_BATCH_SHAPES", "1,4,8").split(",")
+    )
+    max_batch = max(shapes)
+    chunk_tokens = int(os.environ.get("SERVE_CHUNK_TOKENS", "8"))
+    duration_s = float(os.environ.get("SERVE_OPEN_SECONDS", "10"))
+    overload = float(os.environ.get("SERVE_PRIORITY_OVERLOAD", "1.3"))
+    timeout_s = float(os.environ.get("SERVE_PRIORITY_TIMEOUT", "30"))
+
+    model, params, vae, vae_params, text_ids = build_toy()
+    prefill_batch = int(os.environ.get("SERVE_PREFILL_BATCH", "4"))
+    if kv_layout == "paged":
+        kv_pages_env = os.environ.get("SERVE_KV_PAGES")
+        cont = PagedContinuousEngine(
+            model=model, variables=params, vae=vae, vae_params=vae_params,
+            max_batch=max_batch, chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch, registry=MetricsRegistry(),
+            page_size=int(os.environ.get("SERVE_PAGE_SIZE", "16")),
+            kv_pages=int(kv_pages_env) if kv_pages_env else None,
+        )
+    else:
+        cont = ContinuousEngine(
+            model=model, variables=params, vae=vae, vae_params=vae_params,
+            max_batch=max_batch, chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch, registry=MetricsRegistry(),
+        )
+    cont.warmup()
+    # one slot held for the high class (SERVE_PRIORITY_RESERVE): a high
+    # arrival then admits at the next chunk boundary without waiting for
+    # a preemption cycle — the config the QoS acceptance ratio is stated
+    # for (preemption alone still bounds the tail, just one boundary
+    # later; set 0 to measure the fully work-conserving policy)
+    reserve = int(os.environ.get("SERVE_PRIORITY_RESERVE", "1"))
+    cb = ContinuousBatcher(
+        cont, max_queue_rows=max(64, 4 * max_batch), registry=cont.registry,
+        preempt=True, deadline_shed=True,
+        reserve_slots=min(reserve, max_batch - 1),
+    )
+
+    cap = _sustained_rps(
+        cb, text_ids,
+        make_text=lambda cid, i: np.random.default_rng([cid, i]).integers(
+            1, model.num_text_tokens, size=model.text_seq_len
+        ).astype(np.int32),
+    )
+    rate = float(os.environ.get("SERVE_RATE_RPS", 0) or overload * cap)
+
+    rng = np.random.default_rng(int(os.environ.get("SERVE_ARRIVAL_SEED", "0")))
+
+    # unloaded high-priority baseline: the SAME Poisson arrival process
+    # at a light rate (default 15% of saturation), all high class — the
+    # denominator of the acceptance ratio. Open-loop, not sequential-idle
+    # probing: an idle probe always catches the worker parked and
+    # measures the best case, while every real arrival pays the
+    # mid-chunk admission wait — the ratio must compare like with like.
+    base_frac = float(os.environ.get("SERVE_PRIORITY_BASELINE_FRACTION",
+                                     "0.15"))
+    base_rate = max(base_frac * cap, 1.0)
+    base_dur = min(duration_s, 5.0)
+    base_gaps = rng.exponential(1.0 / base_rate,
+                                size=int(base_rate * base_dur) + 1)
+    base_arrivals = np.cumsum(base_gaps)
+    base_arrivals = base_arrivals[base_arrivals < base_dur]
+    base_seeds = rng.integers(0, 2**31 - 1, size=len(base_arrivals))
+    base_texts = draw_prompt_schedule(
+        rng, len(base_arrivals), model.text_seq_len, model.num_text_tokens,
+        prompt_reuse,
+    )
+    unloaded = run_priority_open_loop(
+        cb, base_arrivals, base_seeds, base_texts,
+        ["high"] * len(base_arrivals), timeout_s,
+    )["high"]
+    gaps = rng.exponential(1.0 / rate, size=int(rate * duration_s) + 1)
+    arrivals = np.cumsum(gaps)
+    arrivals = arrivals[arrivals < duration_s]
+    seeds = rng.integers(0, 2**31 - 1, size=len(arrivals))
+    texts = draw_prompt_schedule(
+        rng, len(arrivals), model.text_seq_len, model.num_text_tokens,
+        prompt_reuse,
+    )
+    priorities = [
+        "high" if rng.random() < mix else "low" for _ in arrivals
+    ]
+
+    # counter snapshots so the line reports the measured window only
+    pre = {
+        name: _class_counter_values(cont.registry, f"dalle_serving_{name}")
+        for name in ("preemptions_total", "resumptions_total", "shed_total")
+    }
+    classes = run_priority_open_loop(
+        cb, arrivals, seeds, texts, priorities, timeout_s
+    )
+    cb.shutdown(drain=True)
+
+    def window(name):
+        now = _class_counter_values(cont.registry, f"dalle_serving_{name}")
+        return {
+            label: now.get(label, 0) - pre[name].get(label, 0)
+            for label in now
+        }
+
+    line = {
+        "metric": "serving_priority_mix",
+        "unit": "ratio",
+        "device": jax.devices()[0].platform,
+        "mode": "open-loop",
+        "engine": "continuous",
+        "kv_layout": kv_layout,
+        "priority_mix": mix,
+        "rate_rps": round(rate, 3),
+        "saturation_rps": round(cap, 3),
+        "overload_factor": overload,
+        "duration_s": duration_s,
+        "request_timeout_s": timeout_s,
+        "ttft_unloaded_p50_ms": unloaded["ttft_p50_ms"],
+        "ttft_unloaded_p95_ms": unloaded["ttft_p95_ms"],
+        "classes": classes,
+        "preemptions": window("preemptions_total"),
+        "resumptions": window("resumptions_total"),
+        "shed": window("shed_total"),
+        "dispatch_retries": int(
+            cont.registry.get("dalle_serving_dispatch_retries_total").value
+        ),
+    }
+    high = classes.get("high") or {}
+    if high.get("ttft_p95_ms") and unloaded["ttft_p95_ms"]:
+        line["high_ttft_p95_ratio_vs_unloaded"] = round(
+            high["ttft_p95_ms"] / unloaded["ttft_p95_ms"], 3
+        )
+        line["value"] = line["high_ttft_p95_ratio_vs_unloaded"]
+    else:
+        line["value"] = None
+    low = classes.get("low") or {}
+    if high.get("ttft_p95_ms") and low.get("ttft_p95_ms"):
+        line["low_ttft_p95_ratio_vs_high"] = round(
+            low["ttft_p95_ms"] / high["ttft_p95_ms"], 3
+        )
+    print(json.dumps(line), flush=True)
+
+
 def main_closed_loop():
     sweep = [
         int(c) for c in os.environ.get("SERVE_SWEEP", "1,4,8").split(",")
@@ -746,6 +983,19 @@ def main():
         "memory peaks (slot layout only)",
     )
     p.add_argument(
+        "--priority_mix", type=float,
+        default=(
+            float(os.environ["SERVE_PRIORITY_MIX"])
+            if os.environ.get("SERVE_PRIORITY_MIX") else None
+        ),
+        help="open-loop QoS mode: fraction of arrivals submitted as "
+        "priority 'high' (the rest 'low'), replayed at an OVERLOAD rate "
+        "(SERVE_PRIORITY_OVERLOAD x measured saturation) against one "
+        "continuous batcher with preemption + deadline shedding; the "
+        "JSON line reports per-class TTFT percentiles, preemption/"
+        "resumption/shed counts, and high-vs-unloaded p95 ratio",
+    )
+    p.add_argument(
         "--trace_export", action="store_true",
         default=os.environ.get("SERVE_TRACE_EXPORT", "0") in ("1", "true"),
         help="open-loop: trace every measured request through an "
@@ -755,7 +1005,12 @@ def main():
         "engine's JSON line",
     )
     args = p.parse_args()
-    if args.mode == "open-loop":
+    if args.mode == "open-loop" and args.priority_mix is not None:
+        main_priority_mix(
+            args.priority_mix, kv_layout=args.kv_layout,
+            prompt_reuse=args.prompt_reuse,
+        )
+    elif args.mode == "open-loop":
         main_open_loop(
             prompt_reuse=args.prompt_reuse, kv_layout=args.kv_layout,
             mesh=args.mesh, trace_export=args.trace_export,

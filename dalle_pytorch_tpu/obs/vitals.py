@@ -961,6 +961,11 @@ class EngineVitals:
             head_age = getattr(batcher, "head_age_s", None)
             if head_age is not None:
                 snap["queue_head_age_s"] = head_age()
+            class_depths = getattr(batcher, "class_depths", None)
+            if class_depths is not None:
+                # per-priority-class queue split: under overload the
+                # headline depth hides WHICH class is backing up
+                snap["queue_depth_by_class"] = class_depths()
             alloc = getattr(batcher, "allocator", None)
             if alloc is not None:
                 snap["slots_active"] = alloc.n_active

@@ -21,7 +21,16 @@ queue feeding fixed-shape compiled sampler programs.
     micro-batching (flush on max-batch or deadline), backpressure via
     queue-full rejection, per-request timeout/cancellation, graceful
     drain. `ContinuousBatcher`: same queue surface, but an
-    admit→chunk→retire worker loop over the slot cache.
+    admit→chunk→retire worker loop over the slot cache, with decode-time
+    priority preemption, chunk-boundary cancel/timeout retirement, and
+    one bounded retry after a failed dispatch rebuilds engine state.
+  * `qos.py`      — priority classes ("high"/"normal"/"low"), the
+    `WeightedFairQueue` stride scheduler with per-tenant accounting,
+    tenant quotas (`TenantQuotaError` → 429) and deadline-aware
+    admission shedding (`ShedError` → 503 + Retry-After).
+  * `faults.py`   — `FaultInjector`: deterministic fail-Nth / stall-Nth
+    seam on engine dispatches, for recovery-invariant tests and chaos
+    drills (attach to `engine.faults`).
   * `server.py`   — stdlib-only JSON HTTP API: POST /generate,
     GET /healthz (ok / degraded / 503 tiers), GET /metrics (Prometheus
     text format; `?exemplars=1` for OpenMetrics exemplars),
@@ -58,14 +67,27 @@ from dalle_pytorch_tpu.serving.batcher import (
     RequestTimeout,
     ShuttingDownError,
 )
+from dalle_pytorch_tpu.serving.faults import FaultInjector, InjectedFault
+from dalle_pytorch_tpu.serving.qos import (
+    PRIORITY_CLASSES,
+    ShedError,
+    TenantQuotaError,
+    WeightedFairQueue,
+)
 from dalle_pytorch_tpu.serving.server import ServingServer
 
 __all__ = [
     "ContinuousBatcher",
     "ContinuousEngine",
+    "FaultInjector",
     "GenerationEngine",
+    "InjectedFault",
+    "PRIORITY_CLASSES",
     "SampleSpec",
+    "ShedError",
     "SlotAllocator",
+    "TenantQuotaError",
+    "WeightedFairQueue",
     "engine_from_checkpoint",
     "MicroBatcher",
     "QueueFullError",

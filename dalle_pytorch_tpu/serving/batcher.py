@@ -11,34 +11,68 @@ queue inside the backend anyway.
 
 Overload handling is explicit, never implicit:
   * queue full  -> `submit` raises `QueueFullError` immediately
-    (backpressure; the HTTP layer maps it to 503 + Retry-After);
+    (backpressure; the HTTP layer maps it to 503 + Retry-After derived
+    from the measured chunk-wall EMA and queue depth);
+  * over quota  -> a tenant past `tenant_quota_rows` queued rows gets
+    `TenantQuotaError` (429 at the HTTP layer);
+  * unmeetable  -> with `deadline_shed`, a request whose estimated
+    completion time already exceeds its own timeout is rejected at
+    admission with `ShedError` (503 + Retry-After) instead of being
+    queued to a certain 504;
   * too old     -> requests that waited past their timeout are failed
     with `RequestTimeout` when they reach the head of the queue, not
-    silently dropped;
+    silently dropped — and (continuous engine) a request whose deadline
+    passes or that is cancelled MID-DECODE is retired at the next chunk
+    boundary, releasing its slot instead of squatting to completion;
   * cancelled   -> client-abandoned requests are skipped without costing
     a batch row;
-  * engine error-> every request in the failed batch gets the exception
-    (fail fast; no wedged clients), and the error is surfaced through
-    `last_error` for /healthz;
+  * engine error-> (continuous) the inflight set gets ONE bounded retry:
+    the donated-state rebuild left a clean engine, so every live request
+    is suspended and re-admitted from scratch (bit-identical tokens —
+    decode RNG is (seed, position)-keyed); a request whose retry budget
+    is spent gets the exception. Micro-batches keep fail-fast: every
+    request in the failed batch gets the exception. Either way the error
+    surfaces through `last_error` for /healthz;
+  * overloaded  -> (continuous) PRIORITY PREEMPTION: when the scheduler's
+    chosen head is blocked on slots/pages and a strictly-lower-class
+    request is decoding, the youngest such victim is released at the
+    chunk boundary and re-queued at the front of its own class — the
+    paged engine's prefix cache makes its eventual re-prefill near-free,
+    and restarting decode at position 0 regenerates the SAME tokens, so
+    preemption costs latency, never correctness;
   * shutdown    -> `shutdown(drain=True)` stops intake, flushes what is
     queued, then joins the worker; `drain=False` fails the queue.
+
+Intake order is not FIFO but weighted-fair over priority classes with
+per-tenant accounting (`serving/qos.py:WeightedFairQueue`): a tenant
+flooding the low class cannot starve other tenants or classes, and the
+low class's admission share is bounded below (no outright starvation).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
-from typing import List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from dalle_pytorch_tpu.obs.tracing import NULL_SPAN, NULL_TRACE
 from dalle_pytorch_tpu.serving.engine import SampleSpec
+from dalle_pytorch_tpu.serving.qos import (
+    ShedError,
+    TenantQuotaError,
+    WeightedFairQueue,
+    priority_class,
+)
 
 
 class QueueFullError(RuntimeError):
-    """Bounded queue is at capacity — reject, don't buffer unboundedly."""
+    """Bounded queue is at capacity — reject, don't buffer unboundedly.
+    `retry_after_s` carries the batcher's drain estimate for the HTTP
+    Retry-After header (None when the estimate has no basis yet)."""
+
+    retry_after_s: Optional[float] = None
 
 
 class RequestTimeout(RuntimeError):
@@ -97,6 +131,8 @@ class GenRequest:
         specs: Sequence[SampleSpec],
         timeout_s: float = 120.0,
         trace=NULL_TRACE,
+        priority: str = "normal",
+        tenant: str = "",
     ):
         assert specs, "request needs at least one sample row"
         self.specs: List[SampleSpec] = list(specs)
@@ -104,6 +140,28 @@ class GenRequest:
         self.enqueued_at = time.monotonic()
         self.future = _Future()
         self._cancelled = threading.Event()
+        # QoS identity: priority class drives the weighted-fair scheduler,
+        # tenant drives per-tenant fairness/quotas (qos.py). Validation
+        # raises ValueError here, which the HTTP layer maps to 400.
+        self.priority = str(priority)
+        self.klass = priority_class(self.priority)
+        self.tenant = str(tenant or "")
+        # ------------- suspension state (preemption / dispatch retry) ---
+        # rows harvested COMPLETE before a suspension, kept host-side so a
+        # resumed request only re-decodes its unfinished rows
+        self.resume_tokens: Dict[int, np.ndarray] = {}
+        # generated-so-far tokens per row at the last preemption, clipped
+        # to the row's decode position — observability, and the material
+        # for the resume bit-identity pin (a resumed row's final tokens
+        # must start with exactly this prefix)
+        self.preempt_snapshots: Dict[int, np.ndarray] = {}
+        self.preemptions = 0
+        self.dispatch_retries = 0
+        #: admission order stamp (continuous batcher) — the preemption
+        #: victim policy releases the YOUNGEST lower-class request
+        self.admitted_seq: Optional[int] = None
+        self._preempt_span = NULL_SPAN
+        self._suspend_reason: Optional[str] = None
         # request-scoped trace (obs/tracing.py), minted at HTTP ingress and
         # carried through the worker so stage spans land on one tree; the
         # default NULL_TRACE makes every span call a no-op for callers
@@ -125,6 +183,19 @@ class GenRequest:
     @property
     def rows(self) -> int:
         return len(self.specs)
+
+    @property
+    def pending_rows(self) -> int:
+        """Rows still to serve: total minus rows harvested complete before
+        a suspension (the scheduler's and allocator's accounting unit)."""
+        return len(self.specs) - len(self.resume_tokens)
+
+    def pending_row_specs(self) -> List:
+        """(row index, spec) for every row still to decode."""
+        return [
+            (i, s) for i, s in enumerate(self.specs)
+            if i not in self.resume_tokens
+        ]
 
     def cancel(self) -> None:
         """Best-effort: a request already handed to the engine completes."""
@@ -162,10 +233,17 @@ class MicroBatcher:
         max_queue_rows: int = 64,
         registry=None,
         name: str = "dalle_serving",
+        tenant_quota_rows: Optional[int] = None,
+        class_weights: Optional[dict] = None,
+        log=None,
     ):
         """`engine` needs `.generate(list[SampleSpec]) -> (tokens, pixels)`
         and (unless `max_batch` is given) a `.max_batch` attribute — the
-        tests drive a fake with exactly that surface."""
+        tests drive a fake with exactly that surface. `tenant_quota_rows`
+        caps any one tenant's queued rows (429 past it; None = no quota);
+        `class_weights` overrides qos.py's priority-class admission
+        shares; `log` (a StructuredLog) receives dispatch-retry and
+        preemption lifecycle events."""
         self.engine = engine
         # explicit None check: a caller passing a misconfigured 0 should
         # hit the assert below, not silently get the engine's cap
@@ -184,9 +262,14 @@ class MicroBatcher:
         )
         self.max_delay_s = float(max_delay_ms) / 1000.0
         self.max_queue_rows = int(max_queue_rows)
+        self.tenant_quota_rows = (
+            None if tenant_quota_rows is None else int(tenant_quota_rows)
+        )
+        self.log = log
         self._cond = threading.Condition()
-        self._pending: deque = deque()
-        self._pending_rows = 0
+        # weighted-fair priority intake (qos.py) — with one class and one
+        # tenant (the defaults) it degrades to exactly the old FIFO
+        self._queue = WeightedFairQueue(class_weights)
         self._closed = False
         self._drain = True
         self.last_error: Optional[BaseException] = None
@@ -225,6 +308,23 @@ class MicroBatcher:
         self._m_latency = registry.histogram(
             f"{p}_request_latency_seconds",
             "enqueue-to-result latency per request",
+        )
+        self._m_depth_by_class = registry.gauge_family(
+            f"{p}_queue_depth_rows_by_class",
+            "request rows waiting in the batcher queue, by priority class",
+            label_name="class",
+        )
+        self._m_shed = registry.counter_family(
+            f"{p}_shed_total",
+            "requests rejected at admission by the QoS layer, by reason "
+            "(deadline: the cost model said the SLO was unmeetable; "
+            "quota: the tenant was over its queued-rows quota)",
+            label_name="reason",
+        )
+        self._m_retries = registry.counter(
+            f"{p}_dispatch_retries_total",
+            "inflight requests re-admitted after a failed continuous "
+            "dispatch rebuilt the engine state (one bounded retry each)",
         )
         # per-stage wall time, labeled by stage — the aggregate view of the
         # span tracer's per-request breakdown, so /metrics and
@@ -287,23 +387,36 @@ class MicroBatcher:
         specs: Sequence[SampleSpec],
         timeout_s: float = 120.0,
         trace=NULL_TRACE,
+        priority: str = "normal",
+        tenant: str = "",
     ) -> GenRequest:
         """Enqueue one request; returns it (result via `req.future.result()`).
 
-        Raises `QueueFullError` (backpressure) or `ShuttingDownError`
-        immediately instead of blocking the caller. `trace` (a
-        `Trace` from `obs/tracing.py`) rides on the request; the worker
-        records stage spans onto it.
+        Raises `QueueFullError` (backpressure), `TenantQuotaError` (the
+        tenant is over its queued-rows quota), `ShedError` (deadline-
+        aware admission shed) or `ShuttingDownError` immediately instead
+        of blocking the caller. `trace` (a `Trace` from `obs/tracing.py`)
+        rides on the request; the worker records stage spans onto it.
+        `priority` ("high"/"normal"/"low") and `tenant` feed the
+        weighted-fair scheduler.
         """
-        req = GenRequest(specs, timeout_s=timeout_s, trace=trace)
+        req = GenRequest(
+            specs, timeout_s=timeout_s, trace=trace,
+            priority=priority, tenant=tenant,
+        )
         with self._cond:
             if self._closed:
                 raise ShuttingDownError("batcher is shutting down")
-            if req.rows > self.max_batch:
+            cap = self._admission_cap(req)
+            if req.rows > cap:
+                # permanent: this request could NEVER admit (its class's
+                # usable slots are max_batch minus any high-class
+                # reserve), and all-or-nothing admission means queueing
+                # it would head-of-line-block its class forever
                 self._m_rejected.inc()
                 raise QueueFullError(
                     f"request of {req.rows} rows exceeds max batch "
-                    f"{self.max_batch}"
+                    f"{cap} admissible at priority {req.priority!r}"
                 )
             can_ever = getattr(self.engine, "can_ever_admit", None)
             if can_ever is not None and not can_ever(req.specs):
@@ -314,21 +427,72 @@ class MicroBatcher:
                     f"request of {req.rows} rows exceeds the engine's KV "
                     "block pool capacity"
                 )
-            if self._pending_rows + req.rows > self.max_queue_rows:
+            # class-horizon queue bound: a request competes only against
+            # rows its class must wait behind (its own class and better),
+            # so a low-class flood 503s ITSELF while high-class arrivals
+            # still see room — overload rejections land on the class
+            # causing them
+            ahead = self._queue.rows_at_or_better(req.klass)
+            if ahead + req.rows > self.max_queue_rows:
                 self._m_rejected.inc()
-                raise QueueFullError(
-                    f"queue full ({self._pending_rows}/{self.max_queue_rows} rows)"
+                exc = QueueFullError(
+                    f"queue full ({ahead}/{self.max_queue_rows} rows at "
+                    f"priority {req.priority!r} or better)"
                 )
-            self._pending.append(req)
-            self._pending_rows += req.rows
+                exc.retry_after_s = self.retry_after_s()
+                raise exc
+            if self.tenant_quota_rows is not None and (
+                self._queue.tenant_rows(req.tenant) + req.rows
+                > self.tenant_quota_rows
+            ):
+                self._m_shed.labels("quota").inc()
+                raise TenantQuotaError(
+                    f"tenant {req.tenant!r} already has "
+                    f"{self._queue.tenant_rows(req.tenant)} rows queued "
+                    f"(quota {self.tenant_quota_rows})",
+                    retry_after_s=self.retry_after_s(),
+                )
+            shed = self._shed_check(req)
+            if shed is not None:
+                self._m_shed.labels(shed.reason).inc()
+                raise shed
+            self._queue.push(req)
             self._m_requests.inc()
-            self._m_depth.set(self._pending_rows)
+            self._set_depth_gauges()
             self._cond.notify_all()
         return req
 
+    def retry_after_s(self) -> float:
+        """Seconds a rejected client should wait before retrying. The
+        base batcher has no service-time model, so 1s; the continuous
+        batcher overrides with a chunk-wall-EMA drain estimate."""
+        return 1.0
+
+    def _admission_cap(self, req) -> int:
+        """Largest row count this request could EVER admit with — the
+        submit-time reject bound (the continuous batcher subtracts the
+        high-class slot reserve for non-high requests)."""
+        return self.max_batch
+
+    def _shed_check(self, req) -> Optional[ShedError]:
+        """Admission-time deadline shed (None = admit). Base batcher: no
+        cost model, never sheds; the continuous batcher overrides."""
+        return None
+
+    def _set_depth_gauges(self) -> None:
+        """Caller holds the lock."""
+        self._m_depth.set(self._queue.rows)
+        for name, rows in self._queue.class_depths().items():
+            self._m_depth_by_class.labels(name).set(rows)
+
     @property
     def queue_depth_rows(self) -> int:
-        return self._pending_rows
+        return self._queue.rows
+
+    def class_depths(self) -> Dict[str, int]:
+        """{priority class: queued rows} — vitals/healthz snapshot."""
+        with self._cond:
+            return self._queue.class_depths()
 
     def head_age_s(self) -> Optional[float]:
         """Age of the oldest queued request (None when empty) — the
@@ -336,25 +500,28 @@ class MicroBatcher:
         sampler cadence (~1 Hz) is noise next to the worker's own
         per-wave acquisitions."""
         with self._cond:
-            if not self._pending:
+            oldest = self._queue.oldest_enqueued_at()
+            if oldest is None:
                 return None
-            return time.monotonic() - self._pending[0].enqueued_at
+            return time.monotonic() - oldest
 
     def state_summary(self) -> dict:
         """Queue-side state for `/debug/state` and stall reports."""
         with self._cond:
-            pending = len(self._pending)
-            rows = self._pending_rows
+            reqs = self._queue.requests()
+            rows = self._queue.rows
+            by_class = self._queue.class_depths()
+            oldest = self._queue.oldest_enqueued_at()
             head_age = (
-                time.monotonic() - self._pending[0].enqueued_at
-                if self._pending else None
+                time.monotonic() - oldest if oldest is not None else None
             )
             queued_traces = [
-                req.trace.trace_id for req in self._pending if req.trace
+                req.trace.trace_id for req in reqs if req.trace
             ][:16]
         out = {
-            "queue_requests": pending,
+            "queue_requests": len(reqs),
             "queue_depth_rows": rows,
+            "queue_depth_by_class": by_class,
             "max_queue_rows": self.max_queue_rows,
             "queue_head_age_s": (
                 round(head_age, 3) if head_age is not None else None
@@ -378,36 +545,44 @@ class MicroBatcher:
 
     # -------------------------------------------------------------- worker
 
+    def _close_preempt_span(self, req, **kw) -> None:
+        """End a suspended request's open `preempted` span (no-op when it
+        has none) — on resume, or on any terminal outcome while queued."""
+        if req._preempt_span is not NULL_SPAN:
+            req.trace.end(req._preempt_span, **kw)
+            req._preempt_span = NULL_SPAN
+
     def _viable_head(self, now: float) -> Optional[GenRequest]:
-        """First admissible queued request, WITHOUT popping it — failing
-        expired and skipping cancelled ones from the front on the way.
-        Caller holds the lock. Shared by the micro-batch assembler and the
-        continuous admission loop so timeout/cancel bookkeeping cannot
-        drift between the two batchers."""
-        while self._pending:
-            head = self._pending[0]
+        """The scheduler's next admissible request, WITHOUT popping it —
+        failing expired and skipping cancelled picks on the way (those
+        pops are uncharged: a dead request consumed no capacity, so it
+        must not cost its class its fair share). Caller holds the lock.
+        Shared by the micro-batch assembler and the continuous admission
+        loop so timeout/cancel bookkeeping cannot drift between the two
+        batchers."""
+        while True:
+            head = self._queue.peek()
+            if head is None:
+                return None
             if head.cancelled:
-                self._pending.popleft()
-                self._pending_rows -= head.rows
+                self._queue.pop(charge=False)
                 self._m_cancelled.inc()
+                self._close_preempt_span(head, outcome="cancelled")
                 head.trace.end(head._queue_span, outcome="cancelled")
                 # requests that die queued still observe the queue stage
                 # so /metrics and the traces keep agreeing under overload
-                self.stage_seconds.labels("queue").observe(
-                    now - head.enqueued_at,
-                    exemplar=head.trace.trace_id or None,
-                )
+                # — except suspended ones, whose queue stage was already
+                # observed at FIRST admission (a second observation would
+                # cover decode time too and skew the histogram)
+                self._observe_queue_stage(head, now)
                 head.future.set_exception(RequestCancelled("cancelled"))
                 continue
             if head.expired(now):
-                self._pending.popleft()
-                self._pending_rows -= head.rows
+                self._queue.pop(charge=False)
                 self._m_timeouts.inc()
+                self._close_preempt_span(head, outcome="timeout")
                 head.trace.end(head._queue_span, outcome="timeout")
-                self.stage_seconds.labels("queue").observe(
-                    now - head.enqueued_at,
-                    exemplar=head.trace.trace_id or None,
-                )
+                self._observe_queue_stage(head, now)
                 head.future.set_exception(
                     RequestTimeout(
                         f"spent >{head.timeout_s:.1f}s queued; overloaded?"
@@ -415,7 +590,23 @@ class MicroBatcher:
                 )
                 continue
             return head
-        return None
+
+    def _observe_queue_stage(self, req, now: float) -> None:
+        """Observe the queue stage for a request dying in the queue —
+        unless it already observed it at a prior admission (suspended
+        requests re-queue; their wait shows as the `preempted` span)."""
+        if req._suspend_reason is not None:
+            return
+        self.stage_seconds.labels("queue").observe(
+            now - req.enqueued_at, exemplar=req.trace.trace_id or None
+        )
+
+    def _pop_head(self, head: GenRequest) -> None:
+        """Pop the request `_viable_head` just returned. Caller holds the
+        lock; nothing may have touched the queue in between (the stride
+        scheduler is deterministic, so the pick cannot have moved)."""
+        popped = self._queue.pop()
+        assert popped is head, "queue mutated between peek and pop"
 
     def _pop_ready(self, batch: List[GenRequest]) -> None:
         """Move queued requests into `batch` (capacity permitting), failing
@@ -426,17 +617,16 @@ class MicroBatcher:
             head = self._viable_head(now)
             if head is None or rows + head.rows > self.max_batch:
                 break
-            self._pending.popleft()
-            self._pending_rows -= head.rows
+            self._pop_head(head)
             rows += head.rows
             batch.append(head)
-        self._m_depth.set(self._pending_rows)
+        self._set_depth_gauges()
 
     def _assemble(self) -> Optional[List[GenRequest]]:
         """Block until a batch is ready (deadline-or-capacity), or None at
         shutdown with nothing left to drain."""
         with self._cond:
-            while not self._pending:
+            while not len(self._queue):
                 if self._closed:
                     return None
                 # empty queue: park until submit/shutdown notifies — an
@@ -532,18 +722,14 @@ class MicroBatcher:
         with self._cond:
             self._closed = True
             if not drain:
-                while self._pending:
-                    req = self._pending.popleft()
+                for req in self._queue.drain():
+                    self._close_preempt_span(req, outcome="shutdown")
                     req.trace.end(req._queue_span, outcome="shutdown")
-                    self.stage_seconds.labels("queue").observe(
-                        time.monotonic() - req.enqueued_at,
-                        exemplar=req.trace.trace_id or None,
-                    )
+                    self._observe_queue_stage(req, time.monotonic())
                     req.future.set_exception(
                         ShuttingDownError("server shutting down")
                     )
-                self._pending_rows = 0
-                self._m_depth.set(0)
+                self._set_depth_gauges()
             self._cond.notify_all()
         self._worker.join(timeout=timeout)
 
@@ -580,17 +766,40 @@ class ContinuousBatcher(MicroBatcher):
         max_queue_rows: int = 64,
         registry=None,
         name: str = "dalle_serving",
+        tenant_quota_rows: Optional[int] = None,
+        class_weights: Optional[dict] = None,
+        log=None,
+        preempt: bool = True,
+        deadline_shed: bool = True,
+        reserve_slots: int = 0,
     ):
         """`engine` needs the slot surface of `ContinuousEngine`
         (`prefill_slot` / `step_chunk` / `harvest` / `release` /
         `decode_pixels` / `image_seq_len` / `max_batch`; batched admission
         additionally uses `prefill_slots` + `prefill_batch` when present)
-        — the tests drive a fake with exactly that surface."""
+        — the tests drive a fake with exactly that surface. `preempt`
+        enables decode-time priority preemption; `deadline_shed` enables
+        the admission-time SLO-unmeetable shed (both on by default).
+        `reserve_slots` keeps that many cache slots usable ONLY by the
+        high class, so a high arrival usually admits at the next chunk
+        boundary without waiting for a preemption cycle — the latency/
+        utilization trade (reserved slots idle when no high traffic;
+        default 0 = fully work-conserving, preemption alone reclaims
+        capacity)."""
+        self.preempt = bool(preempt)
+        self.deadline_shed = bool(deadline_shed)
+        self.reserve_slots = int(reserve_slots)
+        assert 0 <= self.reserve_slots < int(
+            engine.max_batch if hasattr(engine, "max_batch") else 1 << 30
+        ), "reserve_slots must leave at least one slot for other classes"
         super().__init__(
             engine,
             max_queue_rows=max_queue_rows,
             registry=registry,
             name=name,
+            tenant_quota_rows=tenant_quota_rows,
+            class_weights=class_weights,
+            log=log,
         )
 
     def _post_init(self) -> None:
@@ -609,9 +818,29 @@ class ContinuousBatcher(MicroBatcher):
         self._m_admitted = self.registry.counter(
             f"{p}_admitted_total", "rows admitted into cache slots"
         )
+        self._m_preempt = self.registry.counter_family(
+            f"{p}_preemptions_total",
+            "decoding requests suspended at a chunk boundary, by reason "
+            "(priority: slot reclaimed for a higher class; "
+            "dispatch_retry: suspended by the bounded retry after a "
+            "failed dispatch rebuilt the engine state)",
+            label_name="reason",
+        )
+        self._m_resume = self.registry.counter_family(
+            f"{p}_resumptions_total",
+            "suspended requests re-admitted into slots, by the reason "
+            "they were suspended",
+            label_name="reason",
+        )
         # fallback chunk index for span metadata when the engine doesn't
         # keep its own (`ContinuousEngine.chunk_index`; test fakes don't)
         self._chunks_dispatched = 0
+        #: admission-order stamp source for the youngest-victim policy
+        self._admit_seq = 0
+        #: EMA of chunk-dispatch wall seconds — the cost model behind
+        #: deadline shedding and Retry-After estimates (None until the
+        #: first measured chunk)
+        self._chunk_ema: Optional[float] = None
         # instance-visible so /debug/state can render the in-flight table;
         # mutated only by the worker thread (readers snapshot, see
         # state_summary)
@@ -655,7 +884,7 @@ class ContinuousBatcher(MicroBatcher):
             with self._cond:
                 while True:
                     head = self._viable_head(time.monotonic())
-                    self._m_depth.set(self._pending_rows)
+                    self._set_depth_gauges()
                     if head is not None or inflight:
                         break
                     if self._closed:
@@ -663,16 +892,18 @@ class ContinuousBatcher(MicroBatcher):
                     # idle: no queued work, no live slots — park until
                     # submit/shutdown notifies (no busy-poll)
                     self._cond.wait()
-                # all-or-nothing admission in arrival order (no starvation:
-                # a wide request blocks later narrow ones until slots free).
-                # Paged engines gate on free KV blocks too: block
-                # exhaustion keeps the request queued (backpressure) until
-                # releases return pages, exactly like slot exhaustion. The
-                # check covers the WHOLE wave popped so far, not each
-                # request in isolation — pages are only reserved at
-                # prefill, so two requests that fit alone could jointly
-                # overrun the pool and break the allocator's reservation
-                # invariant mid-decode.
+                # all-or-nothing admission in weighted-fair scheduler
+                # order (no starvation: the stride scheduler bounds every
+                # class's wait, and a wide request blocks later narrow
+                # ones only until slots free). Paged engines gate on free
+                # KV blocks too: block exhaustion keeps the request
+                # queued (backpressure) until releases return pages,
+                # exactly like slot exhaustion. The check covers the
+                # WHOLE wave popped so far, not each request in
+                # isolation — pages are only reserved at prefill, so two
+                # requests that fit alone could jointly overrun the pool
+                # and break the allocator's reservation invariant
+                # mid-decode.
                 can_admit = getattr(self.engine, "can_admit", None)
                 demand_fn = getattr(self.engine, "admission_demand", None)
                 headroom_fn = getattr(
@@ -690,37 +921,55 @@ class ContinuousBatcher(MicroBatcher):
                 budget = headroom_fn() if incremental else 0
                 wave_demand = 0
                 wave_specs: List = []
-                while head is not None and self.allocator.n_free >= head.rows:
+                while (
+                    head is not None
+                    and self.allocator.n_free
+                    >= head.pending_rows + self._reserve_for(head)
+                ):
+                    pend = head.pending_row_specs()
                     if incremental:
-                        head_demand = demand_fn(head.specs)
+                        head_demand = demand_fn([s for _, s in pend])
                         if wave_demand + head_demand > budget:
                             break
                         wave_demand += head_demand
                     elif can_admit is not None and not can_admit(
-                        wave_specs + list(head.specs)
+                        wave_specs + [s for _, s in pend]
                     ):
                         break
-                    self._pending.popleft()
-                    self._pending_rows -= head.rows
-                    wave_specs.extend(head.specs)
+                    self._pop_head(head)
+                    wave_specs.extend(s for _, s in pend)
+                    # rows harvested before a suspension resume as done
                     partial[head] = {
-                        "tokens": [None] * head.rows,
-                        "remaining": head.rows,
+                        "tokens": [
+                            head.resume_tokens.get(i)
+                            for i in range(head.rows)
+                        ],
+                        "remaining": len(pend),
                     }
-                    for i, spec in enumerate(head.specs):
+                    for i, spec in pend:
                         slot = self.allocator.alloc()
                         inflight[slot] = (head, i)
                         admitted.append((slot, spec))
-                    self._m_admitted.inc(head.rows)
+                    head.admitted_seq = self._admit_seq
+                    self._admit_seq += 1
+                    self._m_admitted.inc(len(pend))
                     t_admit = time.monotonic()
-                    head.trace.end(head._queue_span)
-                    self.stage_seconds.labels("queue").observe(
-                        t_admit - head.enqueued_at,
-                        exemplar=head.trace.trace_id or None,
-                    )
+                    if head._suspend_reason is not None:
+                        # resumption: close the preempted span (its whole
+                        # duration is the suspension) — the queue stage
+                        # was already observed at FIRST admission
+                        self._m_resume.labels(head._suspend_reason).inc()
+                        self._close_preempt_span(head, outcome="resumed")
+                        head._suspend_reason = None
+                    else:
+                        head.trace.end(head._queue_span)
+                        self.stage_seconds.labels("queue").observe(
+                            t_admit - head.enqueued_at,
+                            exemplar=head.trace.trace_id or None,
+                        )
                     head._stage_span = head.trace.begin("prefill")
                     head = self._viable_head(time.monotonic())
-                self._m_depth.set(self._pending_rows)
+                self._set_depth_gauges()
 
             # which engine dispatch is in flight, so a failure still
             # observes the stage's wall time into stage_seconds — /metrics
@@ -842,6 +1091,13 @@ class ContinuousBatcher(MicroBatcher):
                 for req, sp in spans:
                     req.trace.end(sp, chunk_index=chunk_index)
                 self._m_chunk_seconds.observe(chunk_s)
+                # chunk-wall EMA: the service-time basis of deadline
+                # shedding and Retry-After estimates (α=0.2 — reactive to
+                # load shifts, stable against single-chunk noise)
+                self._chunk_ema = (
+                    chunk_s if self._chunk_ema is None
+                    else 0.2 * chunk_s + 0.8 * self._chunk_ema
+                )
                 self.stage_seconds.labels("chunk").observe(
                     chunk_s, exemplar=_first_trace_id(chunk_reqs)
                 )
@@ -860,7 +1116,12 @@ class ContinuousBatcher(MicroBatcher):
                     # worker thread (which would leave the server accepting
                     # requests nobody will ever serve)
                     self._retire(finished, inflight, partial)
-            except Exception as exc:  # fail fast: every live request errors
+                # chunk-boundary housekeeping, in order: retire cancelled/
+                # expired rows (their slots must not squat to completion),
+                # then reclaim a slot for a blocked higher-class head
+                self._reap(inflight, partial)
+                self._maybe_preempt(inflight, partial, img_pos)
+            except Exception as exc:
                 if stage_name is not None:
                     self.stage_seconds.labels(stage_name).observe(
                         time.monotonic() - stage_t0,
@@ -870,9 +1131,271 @@ class ContinuousBatcher(MicroBatcher):
                             )
                         ),
                     )
-                self._fail_all(exc, inflight, partial)
+                # one bounded retry per request off the rebuilt engine
+                # state; requests past their budget fail fast as before
+                self._recover(exc, inflight, partial)
                 continue
             self._set_slots_gauge()
+
+    # --------------------------------------------------- QoS / preemption
+
+    def _image_time_s(self) -> Optional[float]:
+        """Estimated wall seconds to decode one full image, from the
+        chunk EMA (None before the first measured chunk)."""
+        if self._chunk_ema is None:
+            return None
+        chunk_tokens = max(
+            1,
+            int(getattr(
+                self.engine, "chunk_tokens", getattr(self.engine, "chunk", 1)
+            )),
+        )
+        chunks = -(-int(self.engine.image_seq_len) // chunk_tokens)
+        return chunks * self._chunk_ema
+
+    def _est_wait_s(self) -> Optional[float]:
+        """Rough time a NEW row waits for a slot: rows in the system
+        (queued + decoding) drain at ~`max_batch` rows per image-time in
+        steady state. Deliberately coarse — it gates SHEDDING, where a 2x
+        error means rejecting slightly early or late, not corruption."""
+        image_time = self._image_time_s()
+        if image_time is None:
+            return None
+        backlog = self._queue.rows + self.allocator.n_active
+        return (backlog / self.max_batch) * image_time
+
+    def retry_after_s(self) -> float:
+        """Queue-drain estimate for Retry-After headers (503/429): how
+        long until today's backlog has drained at the measured service
+        rate. Clamped to [1, 60] — a precise huge value just tells the
+        client 'much later', and 0 invites an instant re-reject."""
+        wait = self._est_wait_s()
+        if wait is None:
+            return 1.0
+        return min(max(1.0, wait), 60.0)
+
+    def _shed_check(self, req) -> Optional[ShedError]:
+        """Deadline-aware admission shed: if the backlog estimate says
+        this request cannot finish inside ITS OWN timeout, reject it now
+        (503 + Retry-After) instead of queueing it to a certain 504 —
+        the queued-to-die request would also steal service time from
+        requests that still can meet their deadlines."""
+        if not self.deadline_shed:
+            return None
+        wait = self._est_wait_s()
+        image_time = self._image_time_s()
+        if wait is None or image_time is None:
+            return None  # no measured basis yet: admit
+        est_completion = wait + image_time
+        if est_completion <= req.timeout_s:
+            return None
+        return ShedError(
+            f"estimated completion {est_completion:.1f}s exceeds the "
+            f"request timeout {req.timeout_s:.1f}s "
+            f"({self._queue.rows} rows queued, "
+            f"{self.allocator.n_active} decoding)",
+            retry_after_s=min(
+                max(1.0, est_completion - req.timeout_s), 60.0
+            ),
+            reason="deadline",
+        )
+
+    def _suspend_host(self, req, inflight, partial, reason: str) -> None:
+        """Host half of a suspension: strip the request's rows from the
+        slot table, fold already-harvested rows into its resume state,
+        open the `preempted` span, and re-queue it at the FRONT of its
+        own (class, tenant) queue. The caller has dealt with the device
+        side (released the slots, or the engine state was rebuilt)."""
+        for slot in [s for s, (r, _) in inflight.items() if r is req]:
+            inflight.pop(slot)
+            self.allocator.free(slot)
+        info = partial.pop(req, None)
+        if info is not None:
+            for idx, toks in enumerate(info["tokens"]):
+                if toks is not None:
+                    req.resume_tokens[idx] = toks
+        req._suspend_reason = reason
+        req._preempt_span = req.trace.begin(
+            "preempted", reason=reason, pending_rows=req.pending_rows
+        )
+        with self._cond:
+            self._queue.push_front(req)
+            self._set_depth_gauges()
+            self._cond.notify_all()
+
+    def _reserve_for(self, head) -> int:
+        """Extra free slots `head` must leave behind: non-high classes
+        cannot dip into the high-class slot reserve."""
+        return self.reserve_slots if head.klass > 0 else 0
+
+    def _admission_cap(self, req) -> int:
+        return self.max_batch - self._reserve_for(req)
+
+    def _admission_blocked(self, head) -> bool:
+        """Would the scheduler's head fail to admit right now? Mirrors
+        the admission loop's slot + block-pool gating exactly."""
+        if self.allocator.n_free < head.pending_rows + self._reserve_for(head):
+            return True
+        specs = [s for _, s in head.pending_row_specs()]
+        demand_fn = getattr(self.engine, "admission_demand", None)
+        headroom_fn = getattr(self.engine, "admission_headroom", None)
+        if demand_fn is not None and headroom_fn is not None:
+            return demand_fn(specs) > headroom_fn()
+        can_admit = getattr(self.engine, "can_admit", None)
+        if can_admit is not None:
+            return not can_admit(specs)
+        return False
+
+    def _maybe_preempt(self, inflight, partial, img_pos) -> None:
+        """Chunk-boundary preemption: when the scheduler's chosen head is
+        blocked on slots/pages and a STRICTLY lower-class request is
+        decoding, release the youngest such victim and re-queue it.
+
+        Keying the decision off the scheduler's OWN next pick (not 'any
+        queued high request') is what makes this churn-free: the
+        deterministic stride scheduler returns the same head next
+        iteration, so the freed capacity goes to exactly the request it
+        was reclaimed for. Restarting the victim at image position 0
+        regenerates bit-identical tokens (decode RNG is (seed, position)-
+        keyed), so preemption trades the victim's latency — never its
+        output — for the head's; the paged engine's prefix cache makes
+        the victim's eventual re-prefill near-free.
+        """
+        if not self.preempt or not inflight:
+            return
+        while inflight:
+            if not self._preempt_one(inflight, partial, img_pos):
+                return
+
+    def _preempt_one(self, inflight, partial, img_pos) -> bool:
+        """Release ONE victim for the blocked head; True if it did (the
+        caller loops — a multi-row head may need several slots reclaimed
+        at this one boundary). Deliberately scoped to the scheduler's
+        head rather than the whole queued backlog of its class: eager
+        whole-backlog reclaim measured WORSE under saturation — every
+        extra victim's discarded-and-redone decode raises the effective
+        load, lengthening boundaries for the high class it meant to
+        protect."""
+        with self._cond:
+            head = self._queue.peek()
+            now = time.monotonic()
+            if (
+                head is None or head.cancelled or head.expired(now)
+                or not self._admission_blocked(head)
+            ):
+                return False
+            klass = head.klass
+        victims = {
+            req for req, _ in inflight.values() if req.klass > klass
+        }
+        if not victims:
+            return False
+        victim = max(victims, key=lambda r: r.admitted_seq)
+        slot_rows = {
+            s: idx for s, (r, idx) in inflight.items() if r is victim
+        }
+        # snapshot generated-so-far tokens (observability + the resume
+        # bit-identity pin) BEFORE the slots are released; fakes without
+        # `snapshot_rows` use their harvest path
+        snap_fn = getattr(self.engine, "snapshot_rows", self.engine.harvest)
+        slots = list(slot_rows)
+        toks = snap_fn(slots)
+        for slot, row_toks in zip(slots, toks):
+            pos = int(img_pos[slot]) if img_pos is not None else len(row_toks)
+            victim.preempt_snapshots[slot_rows[slot]] = np.asarray(
+                row_toks[:pos]
+            )
+        # the release dispatch may itself fail — let it propagate to the
+        # worker's recovery path with the victim still inflight, so the
+        # rebuilt-state suspension covers it like everyone else
+        self.engine.release(slots)
+        victim.preemptions += 1
+        self._m_preempt.labels("priority").inc()
+        if self.log is not None:
+            self.log.event(
+                "preempt",
+                trace_id=victim.trace.trace_id or None,
+                reason="priority",
+                rows=len(slots),
+                for_class=head.priority,
+                victim_class=victim.priority,
+            )
+        self._suspend_host(victim, inflight, partial, reason="priority")
+        self._set_slots_gauge()
+        return True
+
+    def _reap(self, inflight, partial) -> None:
+        """Chunk-boundary retirement of cancelled/expired DECODING
+        requests: release their slots through the same path preemption
+        uses instead of letting a dead request squat until completion."""
+        now = time.monotonic()
+        doomed: dict = {}
+        for slot, (req, _idx) in inflight.items():
+            if req.cancelled or req.expired(now):
+                doomed.setdefault(req, []).append(slot)
+        if not doomed:
+            return
+        # one release dispatch for the whole boundary; a failure here
+        # propagates to the worker's recovery path like any dispatch error
+        self.engine.release([s for ss in doomed.values() for s in ss])
+        for req, slots in doomed.items():
+            for s in slots:
+                inflight.pop(s)
+                self.allocator.free(s)
+            partial.pop(req, None)
+            if req.cancelled:
+                self._m_cancelled.inc()
+                exc: Exception = RequestCancelled(
+                    "cancelled mid-decode; slot released at the chunk "
+                    "boundary"
+                )
+            else:
+                self._m_timeouts.inc()
+                exc = RequestTimeout(
+                    f"exceeded {req.timeout_s:.1f}s mid-decode; slot "
+                    "released at the chunk boundary"
+                )
+            req.future.set_exception(exc)
+        self._set_slots_gauge()
+
+    def _recover(self, exc, inflight, partial) -> None:
+        """Dispatch-failure policy: the donated-state rebuild left a
+        clean engine, so every inflight request with retry budget is
+        SUSPENDED and re-admitted from scratch (bit-identical tokens —
+        the same (seed, position)-keyed determinism preemption relies
+        on); requests already retried once fail with the error. Falls
+        back to `_fail_all` when nothing is retryable, preserving the
+        original fail-fast behavior."""
+        retryable = [r for r in partial if r.dispatch_retries < 1]
+        if not retryable:
+            self._fail_all(exc, inflight, partial)
+            return
+        self._last_error_at = time.monotonic()
+        self.last_error = exc
+        self._m_errors.inc()
+        doomed = [r for r in partial if r.dispatch_retries >= 1]
+        for req in doomed:
+            for slot in [s for s, (r, _) in inflight.items() if r is req]:
+                inflight.pop(slot)
+                self.allocator.free(slot)
+            partial.pop(req, None)
+            req.future.set_exception(exc)
+        for req in retryable:
+            req.dispatch_retries += 1
+            self._m_retries.inc()
+            self._suspend_host(req, inflight, partial, reason="dispatch_retry")
+        if self.log is not None:
+            self.log.event(
+                "dispatch_retry",
+                error=repr(exc),
+                retried=len(retryable),
+                failed=len(doomed),
+            )
+        try:  # engine may be wedged; slot release is best-effort
+            self.engine.release(range(self.max_batch))
+        except Exception:
+            pass
+        self._set_slots_gauge()
 
     def _fail_all(self, exc, inflight, partial) -> None:
         """Engine failure: error every live request, free every slot, and

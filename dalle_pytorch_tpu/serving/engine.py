@@ -110,6 +110,12 @@ class GenerationEngine:
 
         self.vitals = NULL_VITALS
         self.cost_table = None
+        # fault-injection seam (serving/faults.py): every dispatch calls
+        # `_fault_point(program)`, a no-op until a test/chaos harness sets
+        # a FaultInjector here — the injected failure then takes the SAME
+        # recovery path (donated-state rebuild, batcher retry/fail-fast)
+        # a real XLA error would
+        self.faults = None
         if registry is None:
             from dalle_pytorch_tpu.training.metrics import MetricsRegistry
 
@@ -127,6 +133,14 @@ class GenerationEngine:
             "dalle_serving_engine_compile_seconds",
             "wall time of compiling (warmup) dispatches",
         )
+
+    def _fault_point(self, name: str) -> None:
+        """Dispatch-site hook for the fault injector (inert when none is
+        attached). Sits INSIDE each dispatch's vitals bracket — and inside
+        `_replace_state`'s try for the donated ops — so an injected fault
+        is indistinguishable from a real dispatch failure downstream."""
+        if self.faults is not None:
+            self.faults.on_dispatch(name)
 
     # -------------------------------------------------------------- vitals
 
@@ -249,6 +263,7 @@ class GenerationEngine:
             t0 = time.perf_counter()
             self.vitals.dispatch_begin(prog)
             try:
+                self._fault_point(prog)
                 out = generate_images_cached_batched(
                     self.model, self.variables, jnp.asarray(text),
                     seeds, temps, keep,
@@ -508,13 +523,17 @@ class ContinuousEngine(GenerationEngine):
 
         return init_slot_state(self.model, self.max_batch)
 
-    def _replace_state(self, op) -> None:
+    def _replace_state(self, op, fault_tag: Optional[str] = None) -> None:
         """Run one state-transforming dispatch. The slot ops DONATE the
         state buffers (models/dalle.py), so on failure the old state is
         unusable — rebuild a clean empty one rather than bricking the
-        engine (the batcher fails the in-flight requests either way).
-        Caller holds the lock."""
+        engine (the batcher fails or retries the in-flight requests
+        either way). `fault_tag` names the dispatch for the fault-
+        injection seam; injected faults raise inside this try so they
+        exercise the SAME rebuild path. Caller holds the lock."""
         try:
+            if fault_tag is not None:
+                self._fault_point(fault_tag)
             self._state = op(self._state)
         except BaseException:
             self._state = self._fresh_state()
@@ -564,7 +583,7 @@ class ContinuousEngine(GenerationEngine):
             try:
                 self._replace_state(lambda s: self._prefill_op(
                     s, texts, slots, seeds, temps, keep,
-                ))
+                ), fault_tag="prefill")
             finally:
                 wall = time.perf_counter() - t0
                 self.vitals.dispatch_end("prefill", wall)
@@ -621,7 +640,7 @@ class ContinuousEngine(GenerationEngine):
             t0 = time.perf_counter()
             self.vitals.dispatch_begin("chunk")
             try:
-                self._replace_state(self._chunk_op)
+                self._replace_state(self._chunk_op, fault_tag="chunk")
                 if not _warmup:
                     self._m_chunks.inc()
                     self.chunk_index += 1
@@ -660,14 +679,16 @@ class ContinuousEngine(GenerationEngine):
             self.variables, self._state,
         )
 
-    def harvest(self, slots: Sequence[int]) -> np.ndarray:  # tracelint: hotloop
-        """Finished slots' tokens [len(slots), image_seq_len] (host copy)."""
+    def _read_token_rows(self, slots: Sequence[int]) -> np.ndarray:  # tracelint: hotloop
+        """Host copy of `slots`' token rows — the one transfer shared by
+        harvest and the preemption snapshot."""
         import jax
 
         with self._lock:
             t0 = time.perf_counter()
             self.vitals.dispatch_begin("harvest")
             try:
+                self._fault_point("harvest")
                 # one explicit fixed-shape transfer of the whole token buffer,
                 # sliced on the host: a device-side gather of just the finished
                 # rows would compile one program PER finished-count (1..max_batch)
@@ -678,8 +699,22 @@ class ContinuousEngine(GenerationEngine):
                 self.vitals.dispatch_end(
                     "harvest", time.perf_counter() - t0
                 )
-            self.stats.rows_generated += len(list(slots))
         return toks[list(slots)].astype(np.int32)
+
+    def harvest(self, slots: Sequence[int]) -> np.ndarray:
+        """Finished slots' tokens [len(slots), image_seq_len] (host copy)."""
+        toks = self._read_token_rows(slots)
+        with self._lock:
+            self.stats.rows_generated += len(list(slots))
+        return toks
+
+    def snapshot_rows(self, slots: Sequence[int]) -> np.ndarray:
+        """`harvest` minus the traffic accounting: the preemption path's
+        host copy of generated-so-far tokens. A preempted row is NOT a
+        generated row — it will decode again from position 0 on resume —
+        so this must not move `rows_generated` (dashboards read that as
+        completed work)."""
+        return self._read_token_rows(slots)
 
     def release(self, slots: Sequence[int]) -> None:  # tracelint: hotloop
         """Deactivate `slots` so the chunk step stops touching them — after
@@ -692,7 +727,7 @@ class ContinuousEngine(GenerationEngine):
             self.vitals.dispatch_begin("release")
             try:
                 self._replace_state(
-                    lambda s: self._release_op(s, mask)
+                    lambda s: self._release_op(s, mask), fault_tag="release"
                 )
             finally:
                 self.vitals.dispatch_end(
@@ -729,6 +764,7 @@ class ContinuousEngine(GenerationEngine):
             t0 = time.perf_counter()
             self.vitals.dispatch_begin("decode_pixels")
             try:
+                self._fault_point("decode_pixels")
                 for i in range(0, len(padded), self.max_batch):
                     outs.append(
                         np.asarray(  # tracelint: disable=TL002 -- pixel harvest is the terminal sync of the retire path; rows leave the device here by design
@@ -1124,7 +1160,8 @@ class PagedContinuousEngine(ContinuousEngine):
                         int(spec.seed) & 0x7FFFFFFF, spec.temperature,
                         self._keep_k(spec.top_k), partial_src, pdst,
                         self.page_size,
-                    )
+                    ),
+                    fault_tag="admit_hit",
                 )
                 if not _warmup:
                     self._m_prefix_hits.inc()
@@ -1205,7 +1242,7 @@ class PagedContinuousEngine(ContinuousEngine):
                 # on failure _replace_state rebuilds state AND (via
                 # _fresh_state) the kv manager, so the half-done host
                 # mappings above are discarded wholesale
-                self._replace_state(op)
+                self._replace_state(op, fault_tag="prefill")
                 if not _warmup:
                     self._m_prefills.inc(len(misses))
                     self._m_prefill_dispatches.inc()

@@ -1,0 +1,94 @@
+"""Deterministic fault injection for engine dispatches.
+
+The recovery invariants the serving layer claims — a failed donated
+dispatch rebuilds clean state, the block pool / prefix cache / slot
+allocator stay consistent, preempted rows survive an engine rebuild — are
+only worth anything if tests can MAKE dispatches fail at chosen points.
+`FaultInjector` is that seam: every engine dispatch calls
+`engine._fault_point(program)` (a no-op until an injector is attached to
+`engine.faults`), and the injector fails or stalls the Nth dispatch of a
+named program, deterministically.
+
+The failure is raised INSIDE the engine's `_replace_state` try for the
+donated slot ops, so the engine's real recovery path runs — state rebuild,
+host-manager reset, batcher retry/fail-fast — exactly as it would for an
+XLA error. Dispatch counting includes warmup dispatches; tests attach the
+injector AFTER warmup so rule indices count serving traffic only.
+
+Strictly a test/chaos seam: nothing in the serving stack constructs one
+unless asked (`serve.py` has no flag for it; tests set `engine.faults`).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class InjectedFault(RuntimeError):
+    """The deliberate failure a fail-Nth rule raises."""
+
+
+class FaultInjector:
+    """Fail or stall the Nth dispatch of a named engine program.
+
+    Rules are one-shot and deterministic: `fail_nth("chunk", 3)` raises
+    `InjectedFault` on the third chunk dispatch after attachment and never
+    again; `stall_nth("prefill", 1, seconds=2)` sleeps inside the first
+    prefill dispatch (watchdog fodder) then lets it proceed. `fired`
+    records every rule that triggered, for assertions.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        # program -> {nth: rule dict}; one rule per (program, nth)
+        self._rules: Dict[str, Dict[int, dict]] = {}
+        self.fired: List[dict] = []
+
+    def fail_nth(self, program: str, nth: int,
+                 exc: Optional[BaseException] = None) -> "FaultInjector":
+        assert nth >= 1
+        with self._lock:
+            self._rules.setdefault(program, {})[int(nth)] = {
+                "kind": "fail",
+                "exc": exc,
+            }
+        return self
+
+    def stall_nth(self, program: str, nth: int,
+                  seconds: float) -> "FaultInjector":
+        assert nth >= 1 and seconds >= 0
+        with self._lock:
+            self._rules.setdefault(program, {})[int(nth)] = {
+                "kind": "stall",
+                "seconds": float(seconds),
+            }
+        return self
+
+    def dispatches(self, program: str) -> int:
+        with self._lock:
+            return self._counts.get(program, 0)
+
+    def on_dispatch(self, program: str) -> None:
+        """Called by the engine at every dispatch of `program`. Raises
+        `InjectedFault` (or the rule's exception) for a matching fail
+        rule; sleeps for a stall rule; counts and returns otherwise."""
+        with self._lock:
+            n = self._counts.get(program, 0) + 1
+            self._counts[program] = n
+            rule = self._rules.get(program, {}).pop(n, None)
+            if rule is not None:
+                self.fired.append({"program": program, "nth": n, **rule})
+        if rule is None:
+            return
+        if rule["kind"] == "stall":
+            time.sleep(rule["seconds"])
+            return
+        exc = rule["exc"]
+        if exc is None:
+            exc = InjectedFault(
+                f"injected failure: {program} dispatch #{n}"
+            )
+        raise exc

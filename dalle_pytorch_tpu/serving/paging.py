@@ -537,6 +537,57 @@ class PagedKVManager:
     def blocks_free(self) -> int:
         return self.pool.n_free
 
+    def leak_check(self) -> List[str]:
+        """Audit the reservation/refcount invariants; returns violation
+        strings (empty = consistent). The fault-injection tests run this
+        after mid-wave dispatch failures and preempt/resume churn: a
+        leaked page or refcount here is exactly the corruption a failed
+        donated dispatch could smuggle past the rebuild path."""
+        problems: List[str] = []
+        expected: Dict[int, int] = {}
+        for slot, pages in enumerate(self._row_pages):
+            for p in pages:
+                expected[p] = expected.get(p, 0) + 1
+            mapped, debt = int(self._mapped[slot]), int(self._debt[slot])
+            if pages and mapped + debt != self.pages_per_row:
+                problems.append(
+                    f"slot {slot}: mapped {mapped} + reserved {debt} != "
+                    f"pages_per_row {self.pages_per_row}"
+                )
+            if not pages and (mapped or debt):
+                problems.append(
+                    f"slot {slot}: no pages but mapped={mapped} debt={debt}"
+                )
+            live = [int(p) for p in self.table[slot] if p != GARBAGE_PAGE]
+            if sorted(live) != sorted(pages):
+                problems.append(
+                    f"slot {slot}: table pages {sorted(live)} != row pages "
+                    f"{sorted(pages)}"
+                )
+        for entry in self.cache._entries.values():
+            for p in entry.full_pages:
+                expected[p] = expected.get(p, 0) + 1
+            if entry.partial_page is not None:
+                expected[entry.partial_page] = (
+                    expected.get(entry.partial_page, 0) + 1
+                )
+        actual = self.pool.refcounts()
+        for p in sorted(set(expected) | set(actual)):
+            if expected.get(p, 0) != actual.get(p, 0):
+                problems.append(
+                    f"page {p}: refcount {actual.get(p, 0)} but "
+                    f"{expected.get(p, 0)} references held (rows + cache)"
+                )
+        free = sorted(self.pool._free)
+        should_be_free = sorted(
+            p for p in range(1, self.pool.n_pages) if p not in actual
+        )
+        if free != should_be_free:
+            problems.append(
+                f"free list {free} != unreferenced pages {should_be_free}"
+            )
+        return problems
+
     def debug_dump(self) -> Dict:
         """JSON-ready paging state for `/debug/state` and stall reports:
         per-row page tables + debt, live-page refcounts, prefix-cache

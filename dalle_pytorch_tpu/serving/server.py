@@ -86,6 +86,11 @@ from dalle_pytorch_tpu.serving.batcher import (
     RequestTimeout,
     ShuttingDownError,
 )
+from dalle_pytorch_tpu.serving.qos import (
+    PRIORITY_CLASSES,
+    ShedError,
+    TenantQuotaError,
+)
 from dalle_pytorch_tpu.serving.engine import (
     ContinuousEngine,
     GenerationEngine,
@@ -320,6 +325,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "rerank requested but no CLIP checkpoint is loaded "
                 "(start the server with --clip_path)"
             )
+            priority = body.get("priority", "normal")
+            assert priority in PRIORITY_CLASSES, (
+                f"priority must be one of {list(PRIORITY_CLASSES)}"
+            )
+            tenant = body.get("tenant", "")
+            assert isinstance(tenant, str) and len(tenant) <= 128, (
+                "tenant must be a string of at most 128 characters"
+            )
         except Exception as exc:
             self._reply(400, {"error": f"bad request: {exc}"})
             return
@@ -373,12 +386,40 @@ class _Handler(BaseHTTPRequestHandler):
                 for i in range(num_images)
             ]
             admission.update(owner.admission_context())
+            admission["priority"] = priority
+            if tenant:
+                admission["tenant"] = tenant
             req = owner.batcher.submit(
-                specs, timeout_s=timeout_s, trace=trace
+                specs, timeout_s=timeout_s, trace=trace,
+                priority=priority, tenant=tenant,
             )
         except QueueFullError as exc:
             closed_out("rejected", 503, error=str(exc))
-            self._reply(503, {"error": str(exc)}, [("Retry-After", "1")])
+            # Retry-After from the batcher's chunk-wall-EMA drain
+            # estimate where it has one; the pre-first-measurement
+            # fallback is the old constant 1s
+            retry = getattr(exc, "retry_after_s", None) or 1.0
+            self._reply(
+                503, {"error": str(exc)},
+                [("Retry-After", str(int(round(retry))))],
+            )
+            return
+        except ShedError as exc:
+            # deadline-aware admission shed: the cost model says this
+            # request's own timeout is unmeetable — 503 now beats a 504
+            # after timeout_s of queueing
+            closed_out("shed", 503, error=str(exc))
+            self._reply(
+                503, {"error": str(exc)},
+                [("Retry-After", str(int(round(exc.retry_after_s))))],
+            )
+            return
+        except TenantQuotaError as exc:
+            closed_out("quota", 429, error=str(exc))
+            self._reply(
+                429, {"error": str(exc)},
+                [("Retry-After", str(int(round(exc.retry_after_s))))],
+            )
             return
         except ShuttingDownError as exc:
             closed_out("shutdown", 503)
@@ -438,6 +479,12 @@ class _Handler(BaseHTTPRequestHandler):
         # paged engine: whether this request admitted via the prefix cache
         # — the request-log field that explains cheap vs full prefills
         extra = {} if req.prefix_hit is None else {"prefix_hit": req.prefix_hit}
+        if req.preemptions:
+            # QoS lifecycle made visible per request: how often this one
+            # was suspended for a higher class / retried after a failure
+            extra["preemptions"] = req.preemptions
+        if req.dispatch_retries:
+            extra["dispatch_retries"] = req.dispatch_retries
         closed_out("ok", 200, **extra)
         self._reply(200, payload)
 
@@ -476,6 +523,10 @@ class ServingServer:
         trace_dump_path: Optional[str] = None,
         vitals: Optional[EngineVitals] = None,
         exporter=None,
+        tenant_quota_rows: Optional[int] = None,
+        preempt: bool = True,
+        deadline_shed: bool = True,
+        reserve_slots: int = 0,
     ):
         self.engine = engine
         self.registry = engine.registry
@@ -514,6 +565,11 @@ class ServingServer:
                 engine,
                 max_queue_rows=max_queue_rows,
                 registry=self.registry,
+                tenant_quota_rows=tenant_quota_rows,
+                log=log,
+                preempt=preempt,
+                deadline_shed=deadline_shed,
+                reserve_slots=reserve_slots,
             )
         else:
             self.batcher = MicroBatcher(
@@ -521,6 +577,8 @@ class ServingServer:
                 max_delay_ms=max_delay_ms,
                 max_queue_rows=max_queue_rows,
                 registry=self.registry,
+                tenant_quota_rows=tenant_quota_rows,
+                log=log,
             )
         # wire the sampler's host-state sources and launch it (no-op when
         # disabled); binding also hands the engine its dispatch clock
@@ -614,6 +672,7 @@ class ServingServer:
             detail["engine"] = "continuous"
             detail["slots_active"] = self.batcher.allocator.n_active
             detail["chunk_tokens"] = self.engine.chunk_tokens
+            detail["qos"] = self.qos_detail()
             kv_detail = getattr(self.engine, "kv_detail", None)
             if kv_detail is not None:
                 # paged engine: block-pool occupancy + prefix-cache size,
@@ -660,6 +719,30 @@ class ServingServer:
             # question a cross-host stall investigation asks
             dump["trace_export"] = self.exporter.detail()
         return dump
+
+    def qos_detail(self) -> dict:
+        """Overload-behavior snapshot for /healthz: per-class queue
+        depth plus the preempt/resume/shed lifetime tallies — the first
+        numbers an overload investigation asks for."""
+        out: dict = {
+            "queue_by_class": self.batcher.class_depths(),
+            "preempt_enabled": getattr(self.batcher, "preempt", False),
+            "deadline_shed": getattr(self.batcher, "deadline_shed", False),
+        }
+        for key, metric in (
+            ("preemptions", "dalle_serving_preemptions_total"),
+            ("resumptions", "dalle_serving_resumptions_total"),
+            ("shed", "dalle_serving_shed_total"),
+        ):
+            fam = self.registry.get(metric)
+            if fam is not None:
+                out[key] = {
+                    label: int(child.value) for label, child in fam.items()
+                }
+        retries = self.registry.get("dalle_serving_dispatch_retries_total")
+        if retries is not None:
+            out["dispatch_retries"] = int(retries.value)
+        return out
 
     def admission_context(self) -> dict:
         """Submit-time load context stamped onto every request log line

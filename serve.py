@@ -80,6 +80,26 @@ def parse_args(argv=None):
     p.add_argument("--max_queue", type=int, default=64,
                    help="queue bound in rows; beyond it requests get 503")
     p.add_argument("--request_timeout_s", type=float, default=120.0)
+    p.add_argument("--no_preempt", action="store_true",
+                   help="disable decode-time priority preemption "
+                   "(continuous engine): a high-priority request blocked "
+                   "on slots then waits for natural completions instead "
+                   "of reclaiming a low-priority slot at a chunk boundary")
+    p.add_argument("--no_shed", action="store_true",
+                   help="disable deadline-aware admission shedding "
+                   "(continuous engine): requests whose estimated "
+                   "completion exceeds their own timeout queue anyway "
+                   "instead of getting an immediate 503 + Retry-After")
+    p.add_argument("--tenant_quota_rows", type=int, default=None,
+                   help="per-tenant cap on queued request rows; a tenant "
+                   "past it gets 429 + Retry-After (default: no quota)")
+    p.add_argument("--reserve_slots", type=int, default=0,
+                   help="cache slots reserved for priority 'high' "
+                   "requests (continuous engine): high arrivals admit at "
+                   "the next chunk boundary without waiting for a "
+                   "preemption cycle, at the cost of idle slots when "
+                   "there is no high traffic (default 0: work-conserving, "
+                   "preemption alone reclaims capacity)")
     p.add_argument("--cond_scale", type=float, default=1.0)
     p.add_argument("--no_warmup", action="store_true",
                    help="skip compiling all batch shapes at startup (first "
@@ -155,6 +175,14 @@ def parse_args(argv=None):
         # gauge would sit at 0 forever — fail loudly, not silently
         p.error("--slo_ttft_ms/--slo_request_ms need the vitals sampler; "
                 "drop --no_vitals")
+    if args.tenant_quota_rows is not None and args.tenant_quota_rows < 1:
+        p.error("--tenant_quota_rows must be >= 1 (omit it for no quota)")
+    max_shape = max(
+        (int(b) for b in args.batch_shapes.split(",") if b), default=1
+    )
+    if not 0 <= args.reserve_slots < max_shape:
+        p.error(f"--reserve_slots must be in [0, {max_shape - 1}] so at "
+                "least one slot stays usable by every class")
     if args.trace_export is not None and args.no_tracing:
         # the exporter ships finished traces; a disabled tracer never
         # finishes any — fail loudly, not with a silently idle exporter
@@ -272,6 +300,10 @@ def main(argv=None):
         profiler=ProfilerCapture(out_dir=args.profile_dir),
         trace_dump_path=args.trace_dump,
         vitals=vitals,
+        tenant_quota_rows=args.tenant_quota_rows,
+        preempt=not args.no_preempt,
+        deadline_shed=not args.no_shed,
+        reserve_slots=args.reserve_slots,
     )
 
     import threading

@@ -697,7 +697,12 @@ class TestErrorPathStageObservations:
             req.future.result(timeout=10)
         b.shutdown()
         trace.finish("error")
-        assert dict(b.stage_seconds.items())["chunk"].count == 1
+        # one observation per FAILED dispatch: the original chunk plus
+        # the one bounded retry the recovery path grants the request
+        assert dict(b.stage_seconds.items())["chunk"].count == 2
+        assert (
+            b.registry.get("dalle_serving_dispatch_retries_total").value == 1
+        )
 
     def test_queued_timeout_observes_queue_stage(self):
         gate = threading.Event()
