@@ -61,6 +61,17 @@ same batcher's measured UNLOADED baseline. The QoS claim is that ratio
 staying small (the low class absorbs the overload via preemption and
 shedding) while low-class p95 degrades.
 
+Fleet mode (`--replicas N`, SERVE_REPLICAS): robustness instrument for
+the replica router. N in-process continuous replicas behind a real
+`FleetRouter` take the same open-loop Poisson schedule twice over HTTP —
+once healthy, once with one replica HARD-KILLED 30% into the window. The
+JSON line reports both windows' completion and latency percentiles, the
+p95 killed-vs-healthy ratio, and the router's failover/hedge/ejection
+accounting; the headline value is the killed-window completion fraction
+(the chaos claim is 1.0 — failover retries absorb the crash).
+SERVE_FLEET_SECONDS (6) / SERVE_FLEET_RPS (auto) / SERVE_FLEET_SLOTS (4)
+/ SERVE_HEDGE_MS (off) size it.
+
 Fleet tracing (`--trace_export`, SERVE_TRACE_EXPORT=1): every measured
 request is traced client-side (the bench plays the ingress role) and
 shipped through a real `TraceExporter` to an in-process
@@ -919,6 +930,244 @@ def main_priority_mix(mix, kv_layout="slot", prompt_reuse=0.0):
     print(json.dumps(line), flush=True)
 
 
+def fleet_request(port, body, timeout=30.0, headers=None):
+    """One HTTP POST /generate against the router. NEVER raises: a
+    router-down window must record an error outcome in the load loop,
+    not crash the bench (tests/test_router.py pins this)."""
+    import urllib.error
+    import urllib.request
+
+    t0 = time.monotonic()
+    out = {"ok": False, "status": None, "error": None, "payload": None}
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            out["status"] = resp.status
+            out["payload"] = json.loads(resp.read())
+            out["ok"] = resp.status == 200
+    except urllib.error.HTTPError as exc:
+        out["status"] = exc.code
+        out["error"] = f"http {exc.code}"
+        try:
+            exc.read()
+        except Exception:
+            pass
+    except Exception as exc:
+        out["error"] = repr(exc)
+    out["latency_s"] = time.monotonic() - t0
+    return out
+
+
+def run_fleet_window(port, arrivals, seeds, timeout_s=60.0, on_offset=None):
+    """Open-loop Poisson replay through the router over HTTP: each
+    arrival fires a client thread (open-loop — a slow fleet cannot slow
+    the arrival process). `on_offset` is the chaos hook: (offset_s,
+    callable) runs once when the schedule passes that offset — the bench
+    kills a replica with it mid-window. Returns completion counts and
+    latency percentiles."""
+    results = [None] * len(arrivals)
+    threads = []
+    fired = threading.Event()
+    t_start = time.monotonic()
+    for i, (offset, seed) in enumerate(zip(arrivals, seeds)):
+        delay = t_start + offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        if (
+            on_offset is not None and not fired.is_set()
+            and offset >= on_offset[0]
+        ):
+            fired.set()
+            # off the arrival thread: a blocking kill (server shutdown
+            # joins worker threads) must not stall the Poisson schedule
+            threading.Thread(target=on_offset[1], daemon=True).start()
+
+        def client(i=i, seed=seed):
+            results[i] = fleet_request(
+                port,
+                {"prompt": f"fleet bench {seed}", "seed": int(seed),
+                 "timeout_s": timeout_s},
+                timeout=timeout_s + 5.0,
+            )
+
+        t = threading.Thread(target=client, daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout_s + 10.0)
+    done = [r for r in results if r is not None]
+    lat = sorted(r["latency_s"] for r in done if r["ok"])
+    completed = sum(1 for r in done if r["ok"])
+    wall = time.monotonic() - t_start
+    return {
+        "offered": len(arrivals),
+        "completed": completed,
+        "errors": len(arrivals) - completed,
+        "wall_s": round(wall, 3),
+        "rps": round(completed / max(wall, 1e-9), 3),
+        "latency_p50_ms": (
+            round(1000 * _percentile(lat, 0.5), 1) if lat else None
+        ),
+        "latency_p95_ms": (
+            round(1000 * _percentile(lat, 0.95), 1) if lat else None
+        ),
+    }
+
+
+def main_fleet(n_replicas, hedge_after_ms=None):
+    """`--replicas N` fleet mode: N in-process continuous-engine
+    replicas behind a real `FleetRouter`, open-loop load over HTTP, one
+    replica HARD-KILLED mid-window — one JSON line with the healthy
+    window, the chaos window (must still complete 100%), and the
+    router's failover/hedge accounting."""
+    import numpy as np
+
+    from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+    from dalle_pytorch_tpu.serving.engine import ContinuousEngine
+    from dalle_pytorch_tpu.serving.router import FleetRouter, RouterServer
+    from dalle_pytorch_tpu.serving.server import ServingServer
+    from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+    assert n_replicas >= 2, "--replicas needs >= 2 (one gets killed)"
+    chunk_tokens = int(os.environ.get("SERVE_CHUNK_TOKENS", "4"))
+    max_batch = int(os.environ.get("SERVE_FLEET_SLOTS", "4"))
+    duration_s = float(os.environ.get("SERVE_FLEET_SECONDS", "6"))
+    model, params, vae, vae_params, _text_ids = build_toy()
+
+    servers = []
+    for _ in range(n_replicas):
+        eng = ContinuousEngine(
+            model=model, variables=params, vae=vae, vae_params=vae_params,
+            max_batch=max_batch, chunk_tokens=chunk_tokens,
+            prefill_batch=max_batch, registry=MetricsRegistry(),
+        )
+        eng.tokenizer = ByteTokenizer()
+        servers.append(
+            ServingServer(
+                eng, port=0, request_timeout_s=120,
+                max_queue_rows=max(64, 8 * max_batch),
+            ).start()
+        )
+    router = FleetRouter(
+        [f"r{i}=http://127.0.0.1:{s.port}" for i, s in enumerate(servers)],
+        registry=MetricsRegistry(),
+        hedge_after_ms=hedge_after_ms,
+        probe_interval_s=0.25,
+    )
+    front = RouterServer(router, port=0).start()
+    port = front.port
+
+    # warm every replica (compile + one real request) and calibrate the
+    # offered rate off the measured warm latency: ~40% of the fleet's
+    # rough capacity (max_batch rows per image-time per replica)
+    warm_lat = []
+    for i in range(n_replicas * 3):
+        out = fleet_request(port, {"prompt": "warm", "seed": 10_000 + i})
+        assert out["ok"], f"warmup request failed: {out}"
+        warm_lat.append(out["latency_s"])
+    # rate off the WARM single-request latency (last round only — the
+    # first pays compiles), derated to 25% of the optimistic
+    # slots-per-image-time fleet capacity: this is a ROBUSTNESS
+    # instrument, so the healthy window must complete 100% and the chaos
+    # claim isolates the kill, not queue-full backpressure
+    image_s = max(min(warm_lat[-n_replicas:]), 1e-3)
+    rate = 0.25 * n_replicas * max_batch / image_s
+    rate = float(os.environ.get("SERVE_FLEET_RPS", rate))
+
+    rng = np.random.default_rng(int(os.environ.get("SERVE_ARRIVAL_SEED", "0")))
+    n = max(4, int(rate * duration_s))
+    arrivals = np.sort(rng.uniform(0.0, duration_s, size=n))
+    seeds = rng.integers(0, 2**31 - 1, size=n)
+
+    reg = router.registry
+
+    def _fam(name):
+        fam = reg.get(name)
+        if fam is None:
+            return {}
+        if hasattr(fam, "items"):
+            return {label: int(c.value) for label, c in fam.items()}
+        return {"total": int(fam.value)}
+
+    healthy = run_fleet_window(port, arrivals, seeds)
+
+    # snapshot AFTER the healthy window: the router block must describe
+    # the chaos window it is printed next to, not fold in warmup and
+    # healthy-window traffic
+    fam_names = (
+        "dalle_router_requests_total", "dalle_router_failovers_total",
+        "dalle_router_hedges_total", "dalle_router_hedge_wins_total",
+        "dalle_router_ejections_total", "dalle_router_unroutable_total",
+    )
+    before = {name: _fam(name) for name in fam_names}
+
+    kill_at = 0.3 * duration_s
+
+    def kill():
+        servers[0].shutdown(drain=False)
+
+    killed = run_fleet_window(
+        port, arrivals, seeds + 1, on_offset=(kill_at, kill)
+    )
+
+    def _delta(name):
+        prev = before[name]
+        return {
+            label: v - prev.get(label, 0)
+            for label, v in _fam(name).items()
+        }
+
+    per_replica = _delta("dalle_router_requests_total")
+    total_reqs = max(1, sum(per_replica.values()))
+    line = {
+        "bench": "serving_fleet",
+        "engine": "continuous",
+        "replicas": n_replicas,
+        "max_batch": max_batch,
+        "chunk_tokens": chunk_tokens,
+        "rate_rps": round(rate, 3),
+        "killed_replica": "r0",
+        "kill_at_s": round(kill_at, 3),
+        "healthy": healthy,
+        "killed": killed,
+        "router": {
+            # killed-window DELTAS: what the chaos cost, not lifetime
+            "failovers": _delta("dalle_router_failovers_total"),
+            "hedges": _delta("dalle_router_hedges_total").get("total", 0),
+            "hedge_wins": _delta("dalle_router_hedge_wins_total").get(
+                "total", 0
+            ),
+            "ejections": _delta("dalle_router_ejections_total"),
+            "unroutable": _delta("dalle_router_unroutable_total").get(
+                "total", 0
+            ),
+            "retry_budget": round(router.budget.balance, 2),
+            "per_replica_share": {
+                name: round(v / total_reqs, 3)
+                for name, v in per_replica.items()
+            },
+        },
+        "p95_killed_vs_healthy": (
+            round(killed["latency_p95_ms"] / healthy["latency_p95_ms"], 3)
+            if killed["latency_p95_ms"] and healthy["latency_p95_ms"]
+            else None
+        ),
+        "value": killed["completed"] / max(1, killed["offered"]),
+        "metric": "fleet_completion_with_replica_killed",
+        "unit": "fraction",
+    }
+    print(json.dumps(line), flush=True)
+
+    front.shutdown()
+    for s in servers[1:]:
+        s.shutdown()
+
+
 def main_closed_loop():
     sweep = [
         int(c) for c in os.environ.get("SERVE_SWEEP", "1,4,8").split(",")
@@ -996,6 +1245,15 @@ def main():
         "resumption/shed counts, and high-vs-unloaded p95 ratio",
     )
     p.add_argument(
+        "--replicas", type=int,
+        default=int(os.environ.get("SERVE_REPLICAS", "0")),
+        help="fleet mode: N in-process continuous replicas behind a real "
+        "FleetRouter, open-loop HTTP load, one replica hard-killed "
+        "mid-window; the JSON line carries the healthy vs killed-window "
+        "latency and the router's failover/hedge accounting "
+        "(SERVE_FLEET_SECONDS / SERVE_FLEET_RPS / SERVE_HEDGE_MS)",
+    )
+    p.add_argument(
         "--trace_export", action="store_true",
         default=os.environ.get("SERVE_TRACE_EXPORT", "0") in ("1", "true"),
         help="open-loop: trace every measured request through an "
@@ -1005,7 +1263,13 @@ def main():
         "engine's JSON line",
     )
     args = p.parse_args()
-    if args.mode == "open-loop" and args.priority_mix is not None:
+    if args.replicas:
+        hedge = os.environ.get("SERVE_HEDGE_MS")
+        main_fleet(
+            args.replicas,
+            hedge_after_ms=float(hedge) if hedge else None,
+        )
+    elif args.mode == "open-loop" and args.priority_mix is not None:
         main_priority_mix(
             args.priority_mix, kv_layout=args.kv_layout,
             prompt_reuse=args.prompt_reuse,

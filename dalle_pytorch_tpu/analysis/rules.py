@@ -46,6 +46,17 @@ TL008  `shard_map` in_specs/out_specs (or a `NamedSharding` spec) naming
        factories (`make_mesh`, `build_serving_mesh`, `make_pp_mesh`);
        anything else stays silent (false-negative bias, like the rest of
        the pack).
+TL010  retry-hygiene in `serving/` loops: (a) a bare `except` /
+       `except BaseException` inside a `while` loop that does not
+       re-`raise` swallows KeyboardInterrupt and shutdown sentinels —
+       the drain/Ctrl-C path wedges inside the retry loop; (b) a broad
+       `except Exception` that keeps the loop running with NO backoff or
+       budget call anywhere in the loop (heuristic call-name match:
+       sleep/wait/backoff/budget/withdraw/retry_after/recover/deposit)
+       is a hot failure loop — exactly the retry amplification the
+       router's success-fraction retry budget exists to prevent.
+       Handlers that `break`/`return`/`raise` are safe (the loop ends);
+       anything outside `serving/` is out of scope.
 TL009  a `Trace.begin(...)` span whose matching `end()` is unreachable
        on the exception path: begin and end in the SAME function, every
        `end` in straight-line code — an exception between them leaks the
@@ -901,6 +912,133 @@ class SpanLeakRule(Rule):
                 )
 
 
+class RetryHygieneRule(Rule):
+    code = "TL010"
+    name = "retry-hygiene"
+    description = (
+        "serving retry/failover loop with a broad exception handler that "
+        "either swallows KeyboardInterrupt/shutdown sentinels (bare "
+        "except / except BaseException without re-raise) or keeps "
+        "retrying with no backoff or budget call — the hot failure loop "
+        "that amplifies an outage"
+    )
+
+    #: retry discipline is a serving-stack contract; training scripts and
+    #: analysis tooling loop differently and stay out of scope
+    SCOPED_DIRS = ("serving",)
+
+    #: call-name fragments that count as backoff/budget discipline. The
+    #: list is a heuristic by design (false-negative bias, like the rest
+    #: of the pack): `cond.wait`, `time.sleep`, `budget.withdraw`,
+    #: `self._recover`, `stop.wait(backoff)` all match.
+    BACKOFF_HINTS = (
+        "sleep", "wait", "backoff", "budget", "withdraw", "retry_after",
+        "recover", "deposit",
+    )
+
+    def _in_scope(self, ctx: FileContext) -> bool:
+        return any(d in ctx.path.parts for d in self.SCOPED_DIRS)
+
+    @staticmethod
+    def _shallow(stmts) -> Iterator[ast.AST]:
+        """Every node under `stmts` without descending into nested
+        function defs (they get their own pass)."""
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, _ALL_FUNCS):
+                    stack.append(child)
+
+    @classmethod
+    def _handler_kind(cls, handler: ast.ExceptHandler) -> Optional[str]:
+        """'base' for bare/except BaseException, 'broad' for Exception
+        (tuples count if any element matches), None for narrow."""
+        t = handler.type
+        if t is None:
+            return "base"
+        elts = t.elts if isinstance(t, ast.Tuple) else [t]
+        names = {terminal_name(e) for e in elts}
+        if "BaseException" in names:
+            return "base"
+        if "Exception" in names:
+            return "broad"
+        return None
+
+    @classmethod
+    def _has_backoff(cls, nodes) -> bool:
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                dotted = (dotted_name(node.func) or "").lower()
+                if any(h in dotted for h in cls.BACKOFF_HINTS):
+                    return True
+        return False
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        for func in _functions(ctx.tree):
+            if isinstance(func, ast.Lambda):
+                continue
+            for loop in _walk_shallow(func):
+                if isinstance(loop, ast.While):
+                    yield from self._check_loop(ctx, loop)
+
+    def _check_loop(self, ctx: FileContext, loop: ast.While
+                    ) -> Iterator[Finding]:
+        loop_nodes = list(self._shallow(loop.body))
+        loop_has_backoff = self._has_backoff(loop_nodes)
+        for node in loop_nodes:
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                kind = self._handler_kind(handler)
+                if kind is None:
+                    continue
+                body = list(self._shallow(handler.body))
+                # bare `raise` or `raise <caught-name>` both re-raise the
+                # caught exception, interrupts included
+                reraises = any(
+                    isinstance(n, ast.Raise) and (
+                        n.exc is None
+                        or (
+                            handler.name is not None
+                            and isinstance(n.exc, ast.Name)
+                            and n.exc.id == handler.name
+                        )
+                    )
+                    for n in body
+                )
+                if kind == "base" and not reraises:
+                    yield ctx.finding(
+                        self.code, handler,
+                        "bare `except`/`except BaseException` inside a "
+                        "serving retry loop swallows KeyboardInterrupt "
+                        "and shutdown sentinels — catch `Exception`, or "
+                        "re-`raise` what the loop cannot handle, so "
+                        "drain/Ctrl-C can still stop it",
+                    )
+                    continue
+                exits = any(
+                    isinstance(n, (ast.Raise, ast.Return, ast.Break))
+                    for n in body
+                )
+                if exits:
+                    continue  # the loop ends on failure: not a retry
+                if loop_has_backoff or self._has_backoff(body):
+                    continue
+                yield ctx.finding(
+                    self.code, handler,
+                    "broad `except` keeps this serving retry loop "
+                    "running with no backoff or budget call in the loop "
+                    "— a hot failure loop amplifies an outage; add a "
+                    "backoff sleep/wait or a retry-budget check "
+                    "(recognized call names: "
+                    f"{', '.join(self.BACKOFF_HINTS)})",
+                )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -911,4 +1049,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     ScanConstUploadRule(),
     MeshAxisRule(),
     SpanLeakRule(),
+    RetryHygieneRule(),
 )

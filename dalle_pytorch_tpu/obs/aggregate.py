@@ -114,6 +114,16 @@ def default_site() -> str:
     )
 
 
+def span_uid_for(site: str, host: str, pid: int, span_id: int) -> str:
+    """THE span-UID identity format (`site:host:pid:span_id`) — the one
+    definition every producer (TraceExporter, the replica router) and
+    the collector's join reconstruction share, so the format cannot
+    drift between them (a drift silently stops parent edges resolving
+    fleet-wide, with zero diagnostics). Host is part of the identity:
+    two containerized replicas sharing a site both run as pid 1."""
+    return f"{site}:{host}:{pid}:{span_id}"
+
+
 class TraceExporter:
     """Background JSONL shipper from one process's tracer to a collector.
 
@@ -200,11 +210,9 @@ class TraceExporter:
     def span_uid(self, span: Span) -> str:
         """Globally-unique reference for one of THIS process's spans —
         what an outbound `x-dalle-trace` header carries as parent_uid and
-        what the collector joins against. Host is part of the identity:
-        two containerized replicas sharing a --trace_site both run as
-        pid 1, and site+pid alone would collide their spans in the
-        collector's uid join."""
-        return f"{self.site}:{self.host}:{self.pid}:{span.span_id}"
+        what the collector joins against (`span_uid_for`, the shared
+        format definition)."""
+        return span_uid_for(self.site, self.host, self.pid, span.span_id)
 
     def context_header(self, trace: Trace, span: Span) -> str:
         """Ready-to-send `x-dalle-trace` value parenting the callee's

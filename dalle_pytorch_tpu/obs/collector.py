@@ -64,12 +64,15 @@ def _percentile(values: List[float], q: float) -> Optional[float]:
 
 
 def _span_uid(proc_info: Dict, sid: int) -> str:
-    """Collector-side reconstruction of `TraceExporter.span_uid` from an
-    ingested record's identity fields — the two MUST stay in lockstep or
-    propagated parent edges silently stop resolving. Host is part of the
-    identity: two replicas sharing a site both run as pid 1 in
-    containers."""
-    return f"{proc_info['site']}:{proc_info['host']}:{proc_info['pid']}:{sid}"
+    """Collector-side reconstruction of a producer's span UID from an
+    ingested record's identity fields — built by the SAME
+    `aggregate.span_uid_for` every producer uses, so the join format
+    cannot drift."""
+    from dalle_pytorch_tpu.obs.aggregate import span_uid_for
+
+    return span_uid_for(
+        proc_info["site"], proc_info["host"], proc_info["pid"], sid
+    )
 
 
 class _Bundle:

@@ -732,6 +732,13 @@ class SLOTracker:
         with self._lock:
             return [name for name, b in self._burn.items() if b > 1.0]
 
+    def max_burn(self) -> float:
+        """Worst burn rate across every tracked SLO — the scalar the
+        batcher's preemption-aware shed consults (0.0 with no targets
+        or no observations yet)."""
+        with self._lock:
+            return max(self._burn.values(), default=0.0)
+
     def status(self) -> List[Dict]:
         out = []
         for t in self.targets:

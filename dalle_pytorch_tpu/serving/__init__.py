@@ -31,6 +31,16 @@ queue feeding fixed-shape compiled sampler programs.
   * `faults.py`   — `FaultInjector`: deterministic fail-Nth / stall-Nth
     seam on engine dispatches, for recovery-invariant tests and chaos
     drills (attach to `engine.faults`).
+  * `router.py`   — `FleetRouter` + `RouterServer`: ONE admission router
+    in front of N replicas (`python -m dalle_pytorch_tpu.serving.router`
+    / `serve.py --router --replicas ...`): /healthz-probed per-replica
+    state (healthy / degraded-deprioritized / ejected) with a rolling
+    error-rate circuit breaker, least-outstanding routing with QoS
+    spillover and Retry-After class cooldowns, failover retries under a
+    success-fraction retry budget (seed pinned at ingress, so
+    re-dispatch is bit-identical), optional tail hedging, and graceful
+    drain (`POST /admin/drain?replica=` — a rolling restart is a
+    zero-error event).
   * `server.py`   — stdlib-only JSON HTTP API: POST /generate,
     GET /healthz (ok / degraded / 503 tiers), GET /metrics (Prometheus
     text format; `?exemplars=1` for OpenMetrics exemplars),
@@ -74,6 +84,11 @@ from dalle_pytorch_tpu.serving.qos import (
     TenantQuotaError,
     WeightedFairQueue,
 )
+from dalle_pytorch_tpu.serving.router import (
+    FleetRouter,
+    RetryBudget,
+    RouterServer,
+)
 from dalle_pytorch_tpu.serving.server import ServingServer
 
 __all__ = [
@@ -89,6 +104,9 @@ __all__ = [
     "TenantQuotaError",
     "WeightedFairQueue",
     "engine_from_checkpoint",
+    "FleetRouter",
+    "RetryBudget",
+    "RouterServer",
     "MicroBatcher",
     "QueueFullError",
     "RequestCancelled",

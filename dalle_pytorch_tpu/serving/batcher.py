@@ -235,6 +235,7 @@ class MicroBatcher:
         name: str = "dalle_serving",
         tenant_quota_rows: Optional[int] = None,
         class_weights: Optional[dict] = None,
+        tenant_weights: Optional[dict] = None,
         log=None,
     ):
         """`engine` needs `.generate(list[SampleSpec]) -> (tokens, pixels)`
@@ -242,8 +243,9 @@ class MicroBatcher:
         tests drive a fake with exactly that surface. `tenant_quota_rows`
         caps any one tenant's queued rows (429 past it; None = no quota);
         `class_weights` overrides qos.py's priority-class admission
-        shares; `log` (a StructuredLog) receives dispatch-retry and
-        preemption lifecycle events."""
+        shares and `tenant_weights` sets proportional per-tenant shares
+        within each class; `log` (a StructuredLog) receives
+        dispatch-retry and preemption lifecycle events."""
         self.engine = engine
         # explicit None check: a caller passing a misconfigured 0 should
         # hit the assert below, not silently get the engine's cap
@@ -269,7 +271,11 @@ class MicroBatcher:
         self._cond = threading.Condition()
         # weighted-fair priority intake (qos.py) — with one class and one
         # tenant (the defaults) it degrades to exactly the old FIFO
-        self._queue = WeightedFairQueue(class_weights)
+        self._queue = WeightedFairQueue(class_weights, tenant_weights)
+        #: rows currently inside an engine dispatch — the drain hook the
+        #: replica's /admin/drain status and the fleet router's
+        #: zero-error rolling restart read
+        self._inflight_rows = 0
         self._closed = False
         self._drain = True
         self.last_error: Optional[BaseException] = None
@@ -489,6 +495,19 @@ class MicroBatcher:
     def queue_depth_rows(self) -> int:
         return self._queue.rows
 
+    @property
+    def inflight_rows(self) -> int:
+        """Rows currently being served by the engine (drain hook: a
+        micro-batch in flight; the continuous batcher overrides with its
+        live slot count)."""
+        return self._inflight_rows
+
+    @property
+    def quiesced(self) -> bool:
+        """True when nothing is queued and nothing is in flight — the
+        'safe to restart this replica' predicate behind graceful drain."""
+        return not len(self._queue) and self.inflight_rows == 0
+
     def class_depths(self) -> Dict[str, int]:
         """{priority class: queued rows} — vitals/healthz snapshot."""
         with self._cond:
@@ -620,6 +639,12 @@ class MicroBatcher:
             self._pop_head(head)
             rows += head.rows
             batch.append(head)
+            # counted from the POP, not the flush: between assembly and
+            # dispatch these rows are in the worker's hands, and the
+            # drain predicate (`quiesced`) must not report an idle
+            # batcher while they are — an operator restarting on it
+            # would drop them
+            self._inflight_rows += head.rows
         self._set_depth_gauges()
 
     def _assemble(self) -> Optional[List[GenRequest]]:
@@ -660,6 +685,13 @@ class MicroBatcher:
         specs: List[SampleSpec] = []
         for req in batch:
             specs.extend(req.specs)
+        try:  # rows were counted into _inflight_rows at pop time
+            self._flush_inner(batch, specs)
+        finally:
+            self._inflight_rows = 0
+
+    def _flush_inner(self, batch: List[GenRequest],
+                     specs: List[SampleSpec]) -> None:
         t0 = time.monotonic()
         for req in batch:
             req.trace.end(req._queue_span)
@@ -768,6 +800,7 @@ class ContinuousBatcher(MicroBatcher):
         name: str = "dalle_serving",
         tenant_quota_rows: Optional[int] = None,
         class_weights: Optional[dict] = None,
+        tenant_weights: Optional[dict] = None,
         log=None,
         preempt: bool = True,
         deadline_shed: bool = True,
@@ -799,6 +832,7 @@ class ContinuousBatcher(MicroBatcher):
             name=name,
             tenant_quota_rows=tenant_quota_rows,
             class_weights=class_weights,
+            tenant_weights=tenant_weights,
             log=log,
         )
 
@@ -846,6 +880,13 @@ class ContinuousBatcher(MicroBatcher):
         # state_summary)
         self._inflight: dict = {}
         self._partial: dict = {}
+        #: preemption-aware SLO burn (ROADMAP §5 follow-on): a callable
+        #: returning the SLOTracker's max burn rate. Above 1.0 the
+        #: deadline shed tightens (a fleet already burning error budget
+        #: sheds earlier) and the preemption victim policy switches to
+        #: least-progress (cheapest redo). None = burn-blind, exactly
+        #: the pre-wiring behavior. ServingServer wires vitals.slo in.
+        self.slo_burn = None
 
     def state_summary(self) -> dict:
         """Queue summary plus the slot → in-flight request table. The
@@ -873,6 +914,11 @@ class ContinuousBatcher(MicroBatcher):
         out["slots_active"] = self.allocator.n_active
         out["slots_free"] = self.allocator.n_free
         return out
+
+    @property
+    def inflight_rows(self) -> int:
+        """Rows decoding in cache slots right now (the drain hook)."""
+        return self.allocator.n_active
 
     # ------------------------------------------------------------- worker
 
@@ -1174,12 +1220,30 @@ class ContinuousBatcher(MicroBatcher):
             return 1.0
         return min(max(1.0, wait), 60.0)
 
+    def _burn_factor(self) -> float:
+        """SLO-burn pessimism multiplier from the wired `slo_burn` hook:
+        1.0 at or under budget (or unwired), the burn rate itself above
+        it, capped at 4x so a pathological burn spike cannot shed every
+        request outright."""
+        fn = self.slo_burn
+        if fn is None:
+            return 1.0
+        try:
+            burn = float(fn())
+        except Exception:
+            return 1.0  # a broken burn source must not break admission
+        return max(1.0, min(burn, 4.0))
+
     def _shed_check(self, req) -> Optional[ShedError]:
         """Deadline-aware admission shed: if the backlog estimate says
         this request cannot finish inside ITS OWN timeout, reject it now
         (503 + Retry-After) instead of queueing it to a certain 504 —
         the queued-to-die request would also steal service time from
-        requests that still can meet their deadlines."""
+        requests that still can meet their deadlines. When the SLO
+        error budget is burning (burn rate > 1 from the PR 7
+        SLOTracker), the margin tightens by the burn factor: a fleet
+        already missing its objective sheds EARLIER, trading marginal
+        admissions for budget recovery (reason `slo_burn`)."""
         if not self.deadline_shed:
             return None
         wait = self._est_wait_s()
@@ -1187,17 +1251,21 @@ class ContinuousBatcher(MicroBatcher):
         if wait is None or image_time is None:
             return None  # no measured basis yet: admit
         est_completion = wait + image_time
-        if est_completion <= req.timeout_s:
+        factor = self._burn_factor()
+        budget_s = req.timeout_s / factor
+        if est_completion <= budget_s:
             return None
+        reason = "deadline" if est_completion > req.timeout_s else "slo_burn"
         return ShedError(
             f"estimated completion {est_completion:.1f}s exceeds the "
-            f"request timeout {req.timeout_s:.1f}s "
-            f"({self._queue.rows} rows queued, "
+            f"admission budget {budget_s:.1f}s "
+            f"(timeout {req.timeout_s:.1f}s / burn factor {factor:.2f}; "
+            f"{self._queue.rows} rows queued, "
             f"{self.allocator.n_active} decoding)",
             retry_after_s=min(
-                max(1.0, est_completion - req.timeout_s), 60.0
+                max(1.0, est_completion - budget_s), 60.0
             ),
-            reason="deadline",
+            reason=reason,
         )
 
     def _suspend_host(self, req, inflight, partial, reason: str) -> None:
@@ -1290,7 +1358,23 @@ class ContinuousBatcher(MicroBatcher):
         }
         if not victims:
             return False
-        victim = max(victims, key=lambda r: r.admitted_seq)
+        if self._burn_factor() > 1.0 and img_pos is not None:
+            # burning SLO budget: evict the victim with the LEAST decode
+            # progress — the cheapest redo, so the preemption itself
+            # wastes the fewest already-spent chunk dispatches while the
+            # fleet digs out of its budget hole. Tie-break youngest
+            # (the default policy) for determinism.
+            def progress(r):
+                return sum(
+                    int(img_pos[s])
+                    for s, (rr, _) in inflight.items() if rr is r
+                )
+
+            victim = min(
+                victims, key=lambda r: (progress(r), -r.admitted_seq)
+            )
+        else:
+            victim = max(victims, key=lambda r: r.admitted_seq)
         slot_rows = {
             s: idx for s, (r, idx) in inflight.items() if r is victim
         }

@@ -14,9 +14,14 @@ QoS layer:
     low class's ratio is the minimum and it MUST be picked (the starvation
     bound tests/test_qos.py pins via trace timestamps).
   * per-tenant fairness WITHIN a class: each (class, tenant) pair gets its
-    own deque, and the class serves the tenant with the least rows served
-    so far — one tenant flooding the low class degrades only its own
-    latency, not other low-class tenants'.
+    own deque, and the class serves the tenant with the least
+    rows_served / weight so far — one tenant flooding the low class
+    degrades only its own latency, not other low-class tenants'. Tenant
+    weights (`tenant_weights={"a": 4, "b": 1}`, `serve.py
+    --tenant_weights a=4,b=1`) make that fairness PROPORTIONAL instead
+    of equal: a backlogged weight-4 tenant gets ~4x the admission share
+    of a backlogged weight-1 tenant in the same class (unlisted tenants
+    weigh 1). Weights are shares, not caps — quotas stay the hard bound.
   * per-tenant quotas: `tenant_rows` counts a tenant's queued rows so the
     batcher can 429 a tenant past its share (`TenantQuotaError`).
 
@@ -83,7 +88,8 @@ class WeightedFairQueue:
     deque it replaces.
     """
 
-    def __init__(self, weights: Optional[Dict[str, float]] = None):
+    def __init__(self, weights: Optional[Dict[str, float]] = None,
+                 tenant_weights: Optional[Dict[str, float]] = None):
         w = dict(DEFAULT_CLASS_WEIGHTS)
         if weights:
             w.update(weights)
@@ -91,6 +97,15 @@ class WeightedFairQueue:
             f"every class needs a positive weight, got {w}"
         )
         self.weights = tuple(float(w[c]) for c in PRIORITY_CLASSES)
+        # per-tenant admission shares within a class (stride scheduling
+        # over rows_served / weight, same math as the class level);
+        # tenants not listed weigh 1.0
+        self.tenant_weights = {
+            str(t): float(v) for t, v in (tenant_weights or {}).items()
+        }
+        assert all(v > 0 for v in self.tenant_weights.values()), (
+            f"tenant weights must be positive, got {self.tenant_weights}"
+        )
         # class -> tenant -> deque[request]; OrderedDict keeps tenant
         # iteration deterministic (test-friendly tie-breaks)
         self._queues: Tuple["OrderedDict[str, deque]", ...] = tuple(
@@ -150,9 +165,16 @@ class WeightedFairQueue:
             served = self._tenant_served[k]
             backlogged = [t for t, tq in self._queues[k].items() if tq]
             if backlogged:
-                floor = min(served.get(t, 0.0) for t in backlogged)
+                # weighted virtual time, like the class-level clamp: the
+                # floor is the minimum served/weight RATIO, and the idle
+                # tenant re-enters at that ratio scaled by its own weight
+                floor = min(
+                    served.get(t, 0.0) / self.tenant_weight(t)
+                    for t in backlogged
+                )
                 served[req.tenant] = max(
-                    served.get(req.tenant, 0.0), floor
+                    served.get(req.tenant, 0.0),
+                    floor * self.tenant_weight(req.tenant),
                 )
 
     def _account(self, req, sign: int) -> None:
@@ -165,6 +187,10 @@ class WeightedFairQueue:
             self._tenant_rows[req.tenant] = t
         else:
             self._tenant_rows.pop(req.tenant, None)
+
+    def tenant_weight(self, tenant: str) -> float:
+        """Admission-share weight of one tenant (1.0 unless configured)."""
+        return self.tenant_weights.get(tenant, 1.0)
 
     # --------------------------------------------------------- scheduling
 
@@ -184,7 +210,7 @@ class WeightedFairQueue:
         served = self._tenant_served[best]
         tenant = min(
             (t for t, q in self._queues[best].items() if q),
-            key=lambda t: served.get(t, 0.0),
+            key=lambda t: served.get(t, 0.0) / self.tenant_weight(t),
         )
         return best, tenant
 

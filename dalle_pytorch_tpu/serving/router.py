@@ -1,0 +1,1466 @@
+"""Replica fleet router: health-aware routing, failover, hedging, drain.
+
+One admission router in front of N `ServingServer` replicas — the ROADMAP
+item 1 scale step (data-parallel across hosts, tensor-parallel within:
+each replica may itself be a `--mesh` sharded engine). The router's job
+is to make the FLEET survive any single replica being slow, wedged,
+restarting, or gone, without client-visible errors — replica failure is
+the steady state, not the exception (Vortex-style serving fleets,
+PAPERS.md).
+
+Mechanisms, in the order a request meets them:
+
+  * ROUTING POLICY — least-outstanding-rows over the routable replicas,
+    healthy replicas preferred over degraded ones, with QoS spillover:
+    the "low" class may only use non-degraded replicas, "high"/"normal"
+    may claim a degraded one (a degraded replica still serves — its own
+    /healthz said so — it just should not absorb background traffic).
+    A replica that answered 503 with Retry-After is COOLED for that
+    priority class for that long: replica-level backpressure is obeyed
+    per class, not fleet-wide (a low-class queue-full must not cool the
+    replica for high traffic). A 429 passes through instead — tenant
+    quotas are tenant-scoped, and the over-quota tenant must see its
+    own 429 + Retry-After rather than making the class unroutable for
+    everyone.
+  * HEALTH STATE MACHINE — active probing of each replica's /healthz
+    drives per-replica state: `healthy` / `degraded` (deprioritized) /
+    `ejected` (no traffic). Ejection comes from consecutive probe
+    failures OR a rolling dispatch error-rate burst (the circuit
+    breaker's closed→open edge). While ejected, probes back off
+    exponentially (capped); a probe success half-opens the circuit: ONE
+    trial request is let through, its success closes the circuit
+    (healthy again), its failure re-ejects with a doubled backoff — a
+    flapping replica converges to absorbing one trial per backoff
+    window instead of live traffic.
+  * FAILOVER + RETRY BUDGET — a failed or timed-out dispatch re-routes
+    to the next candidate. Decode is (seed, position)-keyed and the
+    router PINS the seed before the first attempt (a client that sent
+    no seed gets one assigned here), so a re-dispatched request returns
+    bit-identical tokens wherever it lands — failover costs latency,
+    never output. Retries draw from a budget that refills as a fraction
+    of recent SUCCESSES (Finagle-style token bucket, not a fixed
+    per-request count): during a full-fleet outage the budget drains
+    and stays empty, so total dispatch attempts are bounded and retries
+    cannot amplify the outage against recovering replicas.
+  * HEDGING — with `--hedge_after_ms`, a dispatch that has not answered
+    within the threshold gets a duplicate sent to the next candidate
+    (budget-gated, counted); the first usable answer wins and the
+    loser's connection is closed. Tail latency insurance for the p99,
+    safe because duplicated execution is bit-identical.
+  * GRACEFUL DRAIN — `POST /admin/drain?replica=NAME` stops new
+    admissions to that replica, waits out its outstanding rows, then
+    marks it `drained` (out of rotation, not probed back in); a rolling
+    restart is a zero-error event. `POST /admin/undrain?replica=NAME`
+    returns it to rotation. `?propagate=1` additionally drains/undrains
+    the replica's own intake (`ServingServer` /admin/drain) so direct
+    clients are refused too.
+
+Observability: the router adopts or mints `x-dalle-trace` at ingress and
+parents every dispatch span into the caller's context, so its
+route/retry/hedge decisions appear in the stitched fleet critical path
+(obs/collector.py); each dispatch carries `x-dalle-route`
+(`replica;attempt;hedged`) which the replica stamps into its request log
+line — a fleet log join attributes every retry. The router exports
+`dalle_router_*` metric families, serves its own /healthz (503 only when
+NO replica is routable) and `GET /debug/replicas` (full per-replica
+state dump), and logs one structured `request` line per routed request
+with the routing decision.
+
+Run it: `python -m dalle_pytorch_tpu.serving.router --replicas
+http://h1:8000,http://h2:8000 --port 8100` (or `serve.py --router
+--replicas ...`). Everything is stdlib; the `_post`/`_probe` seams are
+the only socket touches, and the state machine runs off an injectable
+clock so chaos tests drive it deterministically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import queue as queue_mod
+import re
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+from dalle_pytorch_tpu.obs.aggregate import (
+    TRACE_HEADER,
+    default_site,
+    format_trace_header,
+    parse_trace_header,
+    sanitize_site,
+    span_uid_for,
+)
+from dalle_pytorch_tpu.obs.tracing import Tracer
+from dalle_pytorch_tpu.serving.qos import PRIORITY_CLASSES, priority_class
+
+#: routing-decision header the router stamps on every forwarded dispatch;
+#: replicas parse it into their request log lines so a fleet log join can
+#: attribute every attempt (satellite of the PR 9 site/pid/host identity)
+ROUTE_HEADER = "x-dalle-route"
+
+_ROUTE_RE = re.compile(r"^([A-Za-z0-9_.\-]{1,64});(\d{1,4});([01])$")
+
+MAX_BODY_BYTES = 1 << 20
+
+#: numeric encoding of replica state for the state gauge family
+STATE_VALUES = {
+    "healthy": 0.0,
+    "degraded": 1.0,
+    "half_open": 2.0,
+    "draining": 3.0,
+    "drained": 4.0,
+    "ejected": 5.0,
+}
+
+
+def format_route_header(replica: str, attempt: int, hedged: bool) -> str:
+    """`x-dalle-route` value for one dispatch: `replica;attempt;hedged`.
+    The replica name goes through the same clamp as trace sites so the
+    strict parser on the other side always round-trips it."""
+    return f"{sanitize_site(replica)};{int(attempt)};{1 if hedged else 0}"
+
+
+def parse_route_header(value) -> Optional[Dict]:
+    """Strict/total parse of an inbound `x-dalle-route` header into
+    `{"replica", "attempt", "hedged"}`; None for anything malformed —
+    the fields land in request log lines, and garbage must not."""
+    if not value or not isinstance(value, str):
+        return None
+    m = _ROUTE_RE.match(value.strip())
+    if not m:
+        return None
+    return {
+        "replica": m.group(1),
+        "attempt": int(m.group(2)),
+        "hedged": m.group(3) == "1",
+    }
+
+
+class RetryBudget:
+    """Token-bucket retry budget that refills on SUCCESS, not on time.
+
+    `deposit()` is called once per successful dispatch and adds `ratio`
+    tokens (capped); `withdraw()` spends one token per retry/hedge and
+    returns False when the bucket is empty. The refill-on-success shape
+    is the anti-amplification property the chaos tests pin: during a
+    full-fleet outage nothing succeeds, the bucket drains to zero, and
+    every further request costs exactly ONE attempt — a fleet of
+    retrying routers cannot DDoS its own recovering replicas. `initial`
+    seeds the bucket so cold-start failover works before the first
+    success.
+    """
+
+    def __init__(self, ratio: float = 0.2, initial: float = 10.0,
+                 cap: float = 100.0):
+        assert ratio >= 0 and initial >= 0 and cap >= initial
+        self.ratio = float(ratio)
+        self.cap = float(cap)
+        self._balance = float(initial)
+        self._lock = threading.Lock()
+        self.withdrawn = 0
+        self.denied = 0
+
+    @property
+    def balance(self) -> float:
+        with self._lock:
+            return self._balance
+
+    def deposit(self) -> None:
+        with self._lock:
+            self._balance = min(self.cap, self._balance + self.ratio)
+
+    def withdraw(self) -> bool:
+        with self._lock:
+            if self._balance < 1.0:
+                self.denied += 1
+                return False
+            self._balance -= 1.0
+            self.withdrawn += 1
+            return True
+
+
+class Replica:
+    """Per-replica routing state. All mutation happens under the
+    router's lock; the dispatch threads only touch it through the
+    router's helpers."""
+
+    def __init__(self, name: str, url: str, now: float):
+        self.name = name
+        self.url = url.rstrip("/")
+        parts = urlsplit(self.url)
+        assert parts.scheme in ("http", ""), (
+            f"replica {name}: only http:// URLs are supported, got {url!r}"
+        )
+        assert parts.hostname, f"replica {name}: no host in {url!r}"
+        self.host = parts.hostname
+        self.port = parts.port or 80
+        #: admin-controlled lifecycle: active | draining | drained
+        self.mode = "active"
+        #: probe/breaker-controlled health: healthy | degraded |
+        #: half_open | ejected
+        self.health = "healthy"
+        self.outstanding_rows = 0
+        self.inflight = 0
+        self.probe_failures = 0
+        self.next_probe_at = now
+        self.probe_backoff_s = 0.0
+        #: consecutive circuit opens — drives the capped exponential
+        #: backoff (reset when a trial closes the circuit)
+        self.open_count = 0
+        #: rolling (ts, ok) dispatch outcomes for the error-rate breaker
+        self.window: deque = deque()
+        #: priority class index -> monotonic ts until which this replica
+        #: is cooled for that class (its own Retry-After, obeyed)
+        self.cooldowns: Dict[int, float] = {}
+        self.trial_inflight = False
+        self.last_error: Optional[str] = None
+        self.ejected_reason: Optional[str] = None
+        self.requests = 0
+        self.failures = 0
+
+    def state(self) -> str:
+        """Single display state: admin mode wins over health."""
+        if self.mode != "active":
+            return self.mode
+        return self.health
+
+    def error_rate(self) -> Tuple[int, float]:
+        n = len(self.window)
+        if not n:
+            return 0, 0.0
+        fails = sum(1 for _, ok in self.window if not ok)
+        return n, fails / n
+
+    def detail(self, now: float) -> Dict:
+        n, rate = self.error_rate()
+        return {
+            "name": self.name,
+            "url": self.url,
+            "state": self.state(),
+            "mode": self.mode,
+            "health": self.health,
+            "outstanding_rows": self.outstanding_rows,
+            "inflight": self.inflight,
+            "requests": self.requests,
+            "failures": self.failures,
+            "error_window": {"samples": n, "error_rate": round(rate, 3)},
+            "probe_failures": self.probe_failures,
+            "probe_backoff_s": round(self.probe_backoff_s, 3),
+            "next_probe_in_s": round(max(0.0, self.next_probe_at - now), 3),
+            "open_count": self.open_count,
+            "cooldowns_s": {
+                PRIORITY_CLASSES[k]: round(max(0.0, until - now), 3)
+                for k, until in self.cooldowns.items()
+                if until > now
+            },
+            "ejected_reason": self.ejected_reason,
+            "last_error": self.last_error,
+        }
+
+
+class FleetRouter:
+    """Routing policy core: replica set, health state machine, failover
+    loop. HTTP-free except for the `_post`/`_probe` seams, and clocked by
+    the injectable `time_fn` so tests drive probes/backoff/cooldowns
+    deterministically while exercising real sockets."""
+
+    def __init__(
+        self,
+        replicas: Sequence[str],
+        registry=None,
+        tracer: Optional[Tracer] = None,
+        log=None,
+        exporter=None,
+        site: Optional[str] = None,
+        request_timeout_s: float = 120.0,
+        attempt_timeout_s: float = 30.0,
+        hedge_after_ms: Optional[float] = None,
+        probe_interval_s: float = 1.0,
+        probe_timeout_s: float = 2.0,
+        eject_after_probe_failures: int = 3,
+        error_window_s: float = 30.0,
+        error_rate_threshold: float = 0.5,
+        error_min_samples: int = 4,
+        probe_backoff_s: float = 1.0,
+        probe_backoff_max_s: float = 30.0,
+        retry_budget_ratio: float = 0.2,
+        retry_budget_initial: float = 10.0,
+        time_fn=time.monotonic,
+    ):
+        assert replicas, "router needs at least one replica URL"
+        self._now = time_fn
+        now = self._now()
+        self.replicas: List[Replica] = []
+        seen = set()
+        for i, spec in enumerate(replicas):
+            name, sep, url = str(spec).partition("=")
+            if not sep:  # bare URL: derive a stable name from host:port
+                url = str(spec)
+                parts = urlsplit(url)
+                name = f"{parts.hostname}-{parts.port or 80}"
+            name = sanitize_site(name)
+            while name in seen:  # two replicas on one host:port — suffix
+                name = f"{name}-{i}"
+            seen.add(name)
+            self.replicas.append(Replica(name, url, now))
+        self.request_timeout_s = float(request_timeout_s)
+        self.attempt_timeout_s = float(attempt_timeout_s)
+        self.hedge_after_s = (
+            None if hedge_after_ms is None else float(hedge_after_ms) / 1e3
+        )
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.eject_after_probe_failures = int(eject_after_probe_failures)
+        self.error_window_s = float(error_window_s)
+        self.error_rate_threshold = float(error_rate_threshold)
+        self.error_min_samples = int(error_min_samples)
+        self.probe_backoff_base_s = float(probe_backoff_s)
+        self.probe_backoff_max_s = float(probe_backoff_max_s)
+        self.budget = RetryBudget(
+            ratio=retry_budget_ratio, initial=retry_budget_initial
+        )
+        # identity for span UIDs and log lines — the PR 9 clamp, so the
+        # router's parent_uid round-trips the header codec
+        self.site = sanitize_site(site) if site else default_site()
+        self.host = sanitize_site(socket.gethostname() or "localhost")
+        self.pid = os.getpid()
+        self.tracer = tracer if tracer is not None else Tracer(max_traces=128)
+        self.exporter = exporter
+        if exporter is not None:
+            exporter.attach(self.tracer)
+        self.log = log
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        self._seed_lock = threading.Lock()
+        self._seed_counter = int(time.time()) & 0x7FFFFFFF
+        self._probe_thread: Optional[threading.Thread] = None
+        self._probe_stop = threading.Event()
+        self._started_at = time.time()
+
+        if registry is None:
+            from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+        self.registry = registry
+        self._m_state = registry.gauge_family(
+            "dalle_router_replica_state",
+            "per-replica routing state (0 healthy, 1 degraded, 2 "
+            "half-open, 3 draining, 4 drained, 5 ejected)",
+            label_name="replica",
+        )
+        self._m_outstanding = registry.gauge_family(
+            "dalle_router_outstanding_rows",
+            "request rows currently dispatched to each replica",
+            label_name="replica",
+        )
+        self._m_requests = registry.counter_family(
+            "dalle_router_requests_total",
+            "dispatch attempts per replica (including retries and hedges)",
+            label_name="replica",
+        )
+        self._m_failovers = registry.counter_family(
+            "dalle_router_failovers_total",
+            "dispatches re-routed to another replica, by failure reason "
+            "(transport: connect/timeout/reset; status: replica 5xx; "
+            "backpressure: replica 429/503 — cooled, not broken)",
+            label_name="reason",
+        )
+        self._m_hedges = registry.counter(
+            "dalle_router_hedges_total",
+            "duplicate dispatches launched for the latency tail "
+            "(--hedge_after_ms; first usable answer wins)",
+        )
+        self._m_hedge_wins = registry.counter(
+            "dalle_router_hedge_wins_total",
+            "hedged duplicates that answered before the primary",
+        )
+        self._m_ejections = registry.counter_family(
+            "dalle_router_ejections_total",
+            "replicas ejected from rotation, by reason (probe: "
+            "consecutive health-probe failures; error_rate: dispatch "
+            "error-rate burst opened the circuit; trial: the half-open "
+            "trial request failed)",
+            label_name="reason",
+        )
+        self._m_probes = registry.counter_family(
+            "dalle_router_probes_total",
+            "health probes by result",
+            label_name="result",
+        )
+        self._m_budget = registry.gauge(
+            "dalle_router_retry_budget",
+            "retry-budget tokens available (refills on success; empty "
+            "during an outage, so retries cannot amplify it)",
+        )
+        self._m_budget.set(self.budget.balance)
+        self._m_unroutable = registry.counter(
+            "dalle_router_unroutable_total",
+            "requests refused because no replica was routable for their "
+            "class (all ejected/draining/cooling)",
+        )
+        for rep in self.replicas:
+            self._m_state.labels(rep.name).set(STATE_VALUES[rep.state()])
+            self._m_outstanding.labels(rep.name).set(0)
+
+    # ------------------------------------------------------------ identity
+
+    def _span_uid(self, span) -> str:
+        # the shared identity format (aggregate.span_uid_for): router
+        # dispatch spans must join in the collector exactly like
+        # exporter-shipped ones
+        return span_uid_for(self.site, self.host, self.pid, span.span_id)
+
+    def next_seed(self, n: int) -> int:
+        """Pin a seed BEFORE the first dispatch for requests that didn't
+        send one: every retry/hedge forwards the identical payload, so
+        duplicated execution returns bit-identical tokens."""
+        with self._seed_lock:
+            s = self._seed_counter
+            self._seed_counter = (self._seed_counter + n) & 0x7FFFFFFF
+            return s
+
+    # ------------------------------------------------------- state machine
+
+    def _set_state_gauge(self, rep: Replica) -> None:
+        self._m_state.labels(rep.name).set(
+            STATE_VALUES.get(rep.state(), 5.0)
+        )
+
+    def _eject(self, rep: Replica, reason: str, now: float) -> None:
+        """Caller holds the lock. closed→open edge of the breaker."""
+        rep.health = "ejected"
+        rep.ejected_reason = reason
+        rep.trial_inflight = False
+        rep.open_count += 1
+        rep.window.clear()
+        rep.probe_backoff_s = min(
+            self.probe_backoff_base_s * (2 ** (rep.open_count - 1)),
+            self.probe_backoff_max_s,
+        )
+        rep.next_probe_at = now + rep.probe_backoff_s
+        self._m_ejections.labels(reason).inc()
+        self._set_state_gauge(rep)
+        if self.log is not None:
+            self.log.event(
+                "replica_ejected", replica=rep.name, reason=reason,
+                probe_backoff_s=round(rep.probe_backoff_s, 3),
+                last_error=rep.last_error,
+            )
+
+    def _record_dispatch(self, rep: Replica, ok: bool) -> None:
+        """Feed one live-dispatch outcome into the breaker."""
+        now = self._now()
+        with self._lock:
+            rep.requests += 1
+            if not ok:
+                rep.failures += 1
+            if rep.health == "half_open":
+                # the one trial request decides the circuit
+                rep.trial_inflight = False
+                if ok:
+                    rep.health = "healthy"
+                    rep.open_count = 0
+                    rep.probe_failures = 0
+                    rep.probe_backoff_s = 0.0
+                    rep.ejected_reason = None
+                    rep.window.clear()
+                    self._set_state_gauge(rep)
+                    if self.log is not None:
+                        self.log.event(
+                            "replica_recovered", replica=rep.name
+                        )
+                else:
+                    self._eject(rep, "trial", now)
+                return
+            rep.window.append((now, ok))
+            while rep.window and now - rep.window[0][0] > self.error_window_s:
+                rep.window.popleft()
+            if not ok and rep.health != "ejected":
+                n, rate = rep.error_rate()
+                if (
+                    n >= self.error_min_samples
+                    and rate >= self.error_rate_threshold
+                ):
+                    self._eject(rep, "error_rate", now)
+
+    def _cool(self, rep: Replica, klass: int, retry_after_s: float) -> None:
+        """Obey a replica's own Retry-After for one priority class."""
+        until = self._now() + max(0.0, float(retry_after_s))
+        with self._lock:
+            rep.cooldowns[klass] = max(rep.cooldowns.get(klass, 0.0), until)
+
+    # -------------------------------------------------------------- probes
+
+    def _probe(self, rep: Replica) -> Tuple[int, Dict]:
+        """The one probe socket touch (stubbed in tests): GET /healthz.
+        Returns (status, parsed detail); raises on transport failure."""
+        req = urllib.request.Request(rep.url + "/healthz", method="GET")
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.probe_timeout_s
+            ) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as exc:  # 503 is an answer, not
+            return exc.code, {}  # a transport failure
+
+    def _probe_one(self, rep: Replica, now: float) -> None:
+        try:
+            status, detail = self._probe(rep)
+        except Exception as exc:
+            self._on_probe(rep, None, {}, now, error=exc)
+        else:
+            self._on_probe(rep, status, detail, now)
+
+    def probe_once(self, now: Optional[float] = None) -> None:
+        """One probe sweep over every due replica — the probe thread's
+        body, callable directly (tests drive it with a stubbed clock).
+        Due replicas are probed CONCURRENTLY: sweep time is the max of
+        the probe latencies, not the sum, so one dark replica's connect
+        timeout cannot delay failure detection on the others."""
+        now = self._now() if now is None else now
+        due = []
+        with self._lock:
+            for rep in self.replicas:
+                if now >= rep.next_probe_at and rep.mode == "active":
+                    due.append(rep)
+        if not due:
+            return
+        if len(due) == 1:
+            self._probe_one(due[0], now)
+            return
+        threads = [
+            threading.Thread(
+                target=self._probe_one, args=(rep, now),
+                name="dalle-router-probe-one", daemon=True,
+            )
+            for rep in due
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.probe_timeout_s + 5.0)
+
+    def _on_probe(self, rep: Replica, status: Optional[int], detail: Dict,
+                  now: float, error: Optional[BaseException] = None) -> None:
+        ok = status == 200
+        self._m_probes.labels("ok" if ok else "fail").inc()
+        with self._lock:
+            if ok:
+                rep.probe_failures = 0
+                tier = (detail or {}).get("status", "ok")
+                if rep.health == "ejected":
+                    # open→half-open: admit ONE trial request; live
+                    # traffic (not the probe) closes the circuit
+                    rep.health = "half_open"
+                    rep.trial_inflight = False
+                elif rep.health != "half_open":
+                    rep.health = (
+                        "degraded" if tier == "degraded" else "healthy"
+                    )
+                rep.next_probe_at = now + self.probe_interval_s
+            else:
+                rep.last_error = (
+                    repr(error) if error is not None else f"healthz {status}"
+                )
+                rep.probe_failures += 1
+                if rep.health == "ejected":
+                    # stay open; keep backing off (capped)
+                    rep.probe_backoff_s = min(
+                        max(
+                            rep.probe_backoff_s * 2,
+                            self.probe_backoff_base_s,
+                        ),
+                        self.probe_backoff_max_s,
+                    )
+                    rep.next_probe_at = now + rep.probe_backoff_s
+                elif rep.probe_failures >= self.eject_after_probe_failures:
+                    self._eject(rep, "probe", now)
+                else:
+                    rep.next_probe_at = now + self.probe_interval_s
+            self._set_state_gauge(rep)
+
+    def start_probes(self) -> "FleetRouter":
+        if self._probe_thread is None:
+            self._probe_stop.clear()
+            self._probe_thread = threading.Thread(
+                target=self._probe_loop, name="dalle-router-probe",
+                daemon=True,
+            )
+            self._probe_thread.start()
+        return self
+
+    def _probe_loop(self) -> None:
+        while not self._probe_stop.is_set():
+            try:
+                self.probe_once()
+            except Exception as exc:  # the probe thread must never die;
+                if self.log is not None:  # next tick retries — the stop
+                    self.log.event(  # wait below is its backoff
+                        "probe_sweep_error", error=repr(exc)
+                    )
+            self._probe_stop.wait(self.probe_interval_s)
+
+    def stop_probes(self) -> None:
+        self._probe_stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=self.probe_timeout_s + 5.0)
+            self._probe_thread = None
+
+    # ----------------------------------------------------------- selection
+
+    def _routable(self, klass: int, exclude) -> List[Replica]:
+        """Candidate replicas for one attempt, best-first: healthy before
+        degraded/half-open (deprioritized, not excluded — except for the
+        low class, which may not touch a degraded replica at all), then
+        least outstanding rows, then name for determinism."""
+        now = self._now()
+        out = []
+        with self._lock:
+            for rep in self.replicas:
+                if rep.name in exclude or rep.mode != "active":
+                    continue
+                if rep.health == "ejected":
+                    continue
+                if rep.health == "half_open" and rep.trial_inflight:
+                    continue
+                if (
+                    rep.health == "degraded"
+                    and klass >= priority_class("low")
+                ):
+                    continue
+                if rep.cooldowns.get(klass, 0.0) > now:
+                    continue
+                out.append(rep)
+            # half_open ranks WITH healthy: the circuit only closes when
+            # the trial request runs, and trial_inflight already caps a
+            # recovering replica at one live request — deprioritizing it
+            # below healthy would starve the trial forever on a fleet
+            # with any healthy capacity
+            out.sort(key=lambda r: (
+                0 if r.health in ("healthy", "half_open") else 1,
+                r.outstanding_rows,
+                r.requests,  # tie-break: an idle fleet round-robins
+                r.name,  # instead of pinning the first name
+            ))
+        return out
+
+    def _retry_after_s(self, klass: int) -> float:
+        """Retry-After for an unroutable request: the soonest a replica
+        could return (cooldown expiry or next probe), clamped to [1, 30]."""
+        now = self._now()
+        etas = []
+        with self._lock:
+            for rep in self.replicas:
+                if rep.mode != "active":
+                    continue
+                if rep.health == "ejected":
+                    etas.append(rep.next_probe_at - now)
+                else:
+                    etas.append(rep.cooldowns.get(klass, now) - now)
+        eta = min((e for e in etas if e > 0), default=1.0)
+        return min(max(1.0, eta), 30.0)
+
+    # ------------------------------------------------------------ dispatch
+
+    def _claim(self, cands: List[Replica]) -> Tuple[
+        Optional[Replica], List[Replica]
+    ]:
+        """Atomically pick the primary from an ordered candidate list.
+        A half-open replica is claimed as THE trial under the same lock
+        that read `trial_inflight` (closing the select-then-dispatch
+        race that would send a burst of live traffic at a still-sick
+        replica); the hedge pool excludes half-open replicas entirely —
+        a duplicate dispatch is load, not a trial."""
+        with self._lock:
+            for i, rep in enumerate(cands):
+                if rep.health == "half_open":
+                    if rep.trial_inflight:
+                        continue  # lost the claim race: not a candidate
+                    rep.trial_inflight = True
+                return rep, [
+                    r for r in cands[i + 1:] if r.health != "half_open"
+                ]
+        return None, []
+
+    def _begin_attempt(self, rep: Replica, rows: int) -> None:
+        with self._lock:
+            rep.outstanding_rows += rows
+            rep.inflight += 1
+            self._m_outstanding.labels(rep.name).set(rep.outstanding_rows)
+        self._m_requests.labels(rep.name).inc()
+
+    def _end_attempt(self, rep: Replica, rows: int) -> None:
+        with self._lock:
+            rep.outstanding_rows = max(0, rep.outstanding_rows - rows)
+            rep.inflight = max(0, rep.inflight - 1)
+            self._m_outstanding.labels(rep.name).set(rep.outstanding_rows)
+            if rep.mode == "draining" and rep.outstanding_rows == 0:
+                rep.mode = "drained"
+                self._set_state_gauge(rep)
+                self._drained.notify_all()
+                if self.log is not None:
+                    self.log.event("replica_drained", replica=rep.name)
+
+    def _post(self, rep: Replica, payload: bytes, headers: Dict[str, str],
+              timeout_s: float, conns: List) -> Tuple[int, bytes, Dict]:
+        """The one dispatch socket touch: POST /generate on `rep`. The
+        connection object is appended to `conns` BEFORE the request so a
+        hedging winner can close the loser mid-flight. Raises on
+        transport failure."""
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=timeout_s
+        )
+        conns.append(conn)
+        try:
+            conn.request(
+                "POST", "/generate", body=payload,
+                headers={"Content-Type": "application/json", **headers},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            keep = {}
+            ra = resp.getheader("Retry-After")
+            if ra is not None:
+                keep["Retry-After"] = ra
+            return resp.status, data, keep
+        finally:
+            conn.close()
+
+    def _classify(self, res: Dict, klass: int) -> str:
+        """One dispatch result -> `pass` (return to client), `failover`
+        (breaker error, try elsewhere) or `cooled` (replica-level
+        backpressure: obey Retry-After for this class, try elsewhere).
+        429 passes THROUGH: it is tenant-scoped (quota), and cooling the
+        replica for the whole class would let one over-quota tenant make
+        the class unroutable for everyone — the offending tenant must
+        see its own 429 + Retry-After instead (the PR 11 isolation
+        contract: a flooding tenant degrades only itself)."""
+        if res["kind"] == "error":
+            return "failover"
+        status = res["status"]
+        if status == 503:
+            return "cooled"
+        if status >= 500 and status != 504:
+            return "failover"
+        # 2xx, 4xx (incl. the tenant-scoped 429), and 504 (the request
+        # consumed its own deadline — retrying cannot meet it) pass
+        return "pass"
+
+    def _settle(self, res: Dict, rep: Replica, klass: int) -> str:
+        """Record one arrived result into the breaker/cooldowns; returns
+        its classification."""
+        kind = self._classify(res, klass)
+        if kind == "failover":
+            with self._lock:
+                rep.last_error = (
+                    repr(res["error"]) if res["kind"] == "error"
+                    else f"http {res['status']}"
+                )
+            self._record_dispatch(rep, ok=False)
+        elif kind == "cooled":
+            try:
+                ra = float(res.get("headers", {}).get("Retry-After", 1.0))
+            except (TypeError, ValueError):
+                ra = 1.0
+            self._cool(rep, klass, ra)
+            # explicit backpressure is a HEALTHY refusal: it must not
+            # open the circuit (a queue-full burst would otherwise eject
+            # the exact replica that is correctly protecting itself)
+            self._record_dispatch(rep, ok=True)
+        else:
+            self._record_dispatch(rep, ok=res["status"] < 500)
+            if res["status"] == 200:
+                self.budget.deposit()
+        self._m_budget.set(self.budget.balance)
+        return kind
+
+    def _dispatch_hedged(
+        self, primary: Replica, hedge_pool: List[Replica], payload: bytes,
+        trace, attempt: int, rows: int, klass: int, timeout_s: float,
+    ) -> Tuple[Dict, str, bool]:
+        """One routing attempt: dispatch to `primary`, optionally hedge
+        to the best of `hedge_pool` after `hedge_after_s`, first usable
+        answer wins (loser's connection closed). Returns (winning
+        result, its classification, hedged?). Each dispatch thread
+        settles its OWN result into the breaker/cooldowns/budget before
+        queueing it — a result abandoned after a hedge win (or an
+        orchestrator timeout) still does its bookkeeping exactly once,
+        so a half-open trial can never be left claimed forever."""
+        results: "queue_mod.Queue[Dict]" = queue_mod.Queue()
+        conns: List = []
+
+        def run(rep: Replica, hedged: bool) -> None:
+            span = trace.begin(
+                "dispatch", replica=rep.name, attempt=attempt,
+                hedged=hedged,
+            )
+            headers = {ROUTE_HEADER: format_route_header(
+                rep.name, attempt, hedged
+            )}
+            if trace:
+                headers[TRACE_HEADER] = format_trace_header(
+                    trace.trace_id, self._span_uid(span)
+                )
+            self._begin_attempt(rep, rows)
+            try:
+                try:
+                    status, data, keep = self._post(
+                        rep, payload, headers, timeout_s, conns
+                    )
+                except Exception as exc:
+                    trace.end(span, error=repr(exc))
+                    res = {
+                        "kind": "error", "replica": rep, "error": exc,
+                        "hedged": hedged,
+                    }
+                else:
+                    trace.end(span, status=status)
+                    res = {
+                        "kind": "http", "replica": rep, "status": status,
+                        "body": data, "headers": keep, "hedged": hedged,
+                    }
+            finally:
+                self._end_attempt(rep, rows)
+            res["disposition"] = self._settle(res, rep, klass)
+            results.put(res)
+
+        threading.Thread(
+            target=run, args=(primary, False),
+            name="dalle-router-dispatch", daemon=True,
+        ).start()
+        launched = 1
+        hedged_used = False
+        first: Optional[Dict] = None
+        if self.hedge_after_s is not None and hedge_pool:
+            try:
+                first = results.get(timeout=self.hedge_after_s)
+            except queue_mod.Empty:
+                # primary is slow: duplicate to the next candidate if the
+                # budget allows (hedges draw from the same budget as
+                # retries — tail insurance must not amplify an outage)
+                if self.budget.withdraw():
+                    self._m_budget.set(self.budget.balance)
+                    self._m_hedges.inc()
+                    hedged_used = True
+                    threading.Thread(
+                        target=run, args=(hedge_pool[0], True),
+                        name="dalle-router-hedge", daemon=True,
+                    ).start()
+                    launched += 1
+        best: Optional[Tuple[Dict, str]] = None
+        for _ in range(launched):
+            if first is not None:
+                res, first = first, None
+            else:
+                try:
+                    # generous wall bound: each attempt's socket timeout
+                    # already caps it; this is belt-and-braces against a
+                    # lost thread
+                    res = results.get(timeout=timeout_s + 10.0)
+                except queue_mod.Empty:
+                    break
+            kind = res["disposition"]  # settled by the dispatch thread
+            if kind == "pass":
+                if res["hedged"]:
+                    self._m_hedge_wins.inc()
+                for conn in conns:  # first wins: cancel the loser
+                    try:
+                        conn.close()
+                    except Exception:
+                        pass
+                return res, kind, hedged_used
+            best = (res, kind)  # keep waiting for a better answer
+        if best is None:  # every dispatch thread got lost past its own
+            res = {  # socket timeout: treat as a transport failure —
+                "kind": "error", "replica": primary,  # NOT settled (the
+                "error": TimeoutError("dispatch produced no result"),
+                "hedged": False,  # lost thread will settle its own)
+                "disposition": "failover",
+            }
+            return res, "failover", hedged_used
+        return best[0], best[1], hedged_used
+
+    # ------------------------------------------------------------ requests
+
+    def handle_generate(self, raw: bytes, inbound_headers) -> Tuple[
+        int, bytes, List[Tuple[str, str]]
+    ]:
+        """Route one client /generate body through the fleet. Returns
+        (status, response body, extra headers) for the HTTP layer."""
+        try:
+            body = json.loads(raw)
+            assert isinstance(body, dict), "body must be a JSON object"
+            priority = body.get("priority", "normal")
+            assert priority in PRIORITY_CLASSES, (
+                f"priority must be one of {list(PRIORITY_CLASSES)}"
+            )
+            rows = int(body.get("num_images", 1))
+            assert rows >= 1, "num_images must be >= 1"
+            timeout_s = float(body.get("timeout_s", self.request_timeout_s))
+            assert 0.0 < timeout_s <= self.request_timeout_s, (
+                f"timeout_s must be in (0, {self.request_timeout_s}]"
+            )
+        except Exception as exc:
+            return 400, json.dumps(
+                {"error": f"bad request: {exc}"}
+            ).encode(), []
+        klass = priority_class(priority)
+        if body.get("seed") is None:
+            body["seed"] = self.next_seed(rows)
+        payload = json.dumps(body).encode("utf-8")
+
+        ctx = parse_trace_header(inbound_headers.get(TRACE_HEADER))
+        trace = self.tracer.start_trace(
+            "route",
+            trace_id=ctx[0] if ctx else None,
+            parent_uid=ctx[1] if ctx else None,
+            rows=rows, priority=priority,
+        )
+        t0 = self._now()
+        deadline = t0 + timeout_s
+        tried: set = set()
+        attempt = 0
+        last: Optional[Tuple[Dict, str]] = None
+        hedged_any = False
+
+        def closed_out(outcome: str, status: int, replica=None, **fields):
+            trace.finish(outcome=outcome)
+            if self.log is not None:
+                self.log.request(
+                    trace_id=trace.trace_id if trace else None,
+                    outcome=outcome, status=status,
+                    latency_ms=round((self._now() - t0) * 1e3, 2),
+                    stages=trace.stage_seconds(),
+                    replica=replica, attempt=attempt, hedged=hedged_any,
+                    priority=priority, rows=rows, **fields,
+                )
+
+        while True:
+            now = self._now()
+            if now >= deadline:
+                closed_out("timeout", 504)
+                return 504, json.dumps({
+                    "error": "router exhausted the request deadline "
+                    "across failover attempts"
+                }).encode(), []
+            cands = self._routable(klass, tried)
+            if not cands and tried:
+                # nothing NEW to try: fall back to the full candidate
+                # set (a flapping fleet beats an instant give-up when
+                # the budget still allows a retry)
+                cands = self._routable(klass, frozenset())
+            if not cands:
+                self._m_unroutable.inc()
+                retry = self._retry_after_s(klass)
+                closed_out(
+                    "unroutable", 503,
+                    replica=last[0]["replica"].name if last else None,
+                )
+                err = (
+                    "no replica routable for priority "
+                    f"{priority!r} (all ejected, draining, or cooling)"
+                )
+                return 503, json.dumps({"error": err}).encode(), [
+                    ("Retry-After", str(int(round(retry))))
+                ]
+            if attempt > 0 and not self.budget.withdraw():
+                # budget empty: surface the LAST failure instead of
+                # hammering recovering replicas with more attempts.
+                # (Checked BEFORE the trial claim below, so an early
+                # return can never leak a claimed half-open trial.)
+                self._m_budget.set(self.budget.balance)
+                closed_out(
+                    "budget_exhausted", 503,
+                    replica=last[0]["replica"].name if last else None,
+                )
+                return 503, json.dumps({
+                    "error": "retry budget exhausted (fleet-wide "
+                    "failures; no retry capacity left)"
+                }).encode(), [("Retry-After", "1")]
+            self._m_budget.set(self.budget.balance)
+            primary, hedge_pool = self._claim(cands)
+            if primary is None:
+                # every remaining candidate is a half-open replica whose
+                # trial another request just claimed: brief condition,
+                # tell the client to come right back
+                self._m_unroutable.inc()
+                closed_out(
+                    "unroutable", 503,
+                    replica=last[0]["replica"].name if last else None,
+                )
+                return 503, json.dumps({
+                    "error": "all routable replicas are mid-trial "
+                    "(recovering); retry shortly"
+                }).encode(), [("Retry-After", "1")]
+            timeout_attempt = min(
+                self.attempt_timeout_s, max(0.1, deadline - now)
+            )
+            res, kind, hedged = self._dispatch_hedged(
+                primary, hedge_pool, payload, trace, attempt, rows,
+                klass, timeout_attempt,
+            )
+            hedged_any = hedged_any or hedged
+            if kind == "pass":
+                status = res["status"]
+                outcome = "ok" if status == 200 else "replica_status"
+                closed_out(
+                    outcome, status, replica=res["replica"].name,
+                )
+                extra = [("x-dalle-replica", res["replica"].name)]
+                extra.extend(res.get("headers", {}).items())
+                return status, res["body"], extra
+            # failover: count it, exclude the loser, loop (bounded by
+            # the retry budget withdrawn at the top of the loop)
+            reason = (
+                "transport" if res["kind"] == "error"
+                else "backpressure" if kind == "cooled"
+                else "status"
+            )
+            self._m_failovers.labels(reason).inc()
+            tried.add(res["replica"].name)
+            last = (res, kind)
+            attempt += 1
+
+    # --------------------------------------------------------------- admin
+
+    def _find(self, name: str) -> Optional[Replica]:
+        for rep in self.replicas:
+            if rep.name == name:
+                return rep
+        return None
+
+    def _propagate_admin(self, rep: Replica, action: str) -> Optional[str]:
+        """Best-effort POST of the replica's own /admin/<action> so
+        direct clients are refused during the drain window too."""
+        try:
+            req = urllib.request.Request(
+                rep.url + f"/admin/{action}", data=b"", method="POST"
+            )
+            with urllib.request.urlopen(
+                req, timeout=self.probe_timeout_s
+            ) as resp:
+                resp.read()
+            return None
+        except Exception as exc:
+            return repr(exc)
+
+    def drain(self, name: str, wait_s: float = 0.0,
+              propagate: bool = False) -> Optional[Dict]:
+        """Stop new admissions to `name`, wait out its outstanding rows
+        (up to `wait_s`), eject it from rotation as `drained`. Returns
+        the replica's state dict, or None for an unknown name."""
+        rep = self._find(name)
+        if rep is None:
+            return None
+        with self._lock:
+            if rep.mode == "active":
+                rep.mode = "draining"
+                if rep.outstanding_rows == 0:
+                    rep.mode = "drained"
+            self._set_state_gauge(rep)
+        if self.log is not None:
+            self.log.event(
+                "replica_drain", replica=name, mode=rep.mode,
+                outstanding_rows=rep.outstanding_rows,
+            )
+        if propagate:
+            err = self._propagate_admin(rep, "drain")
+            if err and self.log is not None:
+                self.log.event(
+                    "replica_drain_propagate_failed", replica=name,
+                    error=err,
+                )
+        if wait_s > 0:
+            # injectable clock like every other timing path, so a
+            # stubbed-clock chaos test can expire the wait
+            # deterministically (real waits still tick via the
+            # 0.1s-capped condition timeout)
+            deadline = self._now() + wait_s
+            with self._lock:
+                while rep.mode == "draining":
+                    remaining = deadline - self._now()
+                    if remaining <= 0:
+                        break
+                    self._drained.wait(timeout=min(remaining, 0.1))
+        return rep.detail(self._now())
+
+    def undrain(self, name: str, propagate: bool = False) -> Optional[Dict]:
+        """Return a drained/draining replica to rotation (health resets
+        to half-open so live traffic must prove it before it carries
+        full load; the next probe runs immediately)."""
+        rep = self._find(name)
+        if rep is None:
+            return None
+        now = self._now()
+        with self._lock:
+            rep.mode = "active"
+            # a replica coming back from a restart proves itself like a
+            # recovering one: one trial closes the circuit
+            rep.health = "half_open"
+            rep.trial_inflight = False
+            rep.probe_failures = 0
+            rep.next_probe_at = now
+            self._set_state_gauge(rep)
+        if propagate:
+            err = self._propagate_admin(rep, "undrain")
+            if err and self.log is not None:
+                self.log.event(
+                    "replica_undrain_propagate_failed", replica=name,
+                    error=err,
+                )
+        if self.log is not None:
+            self.log.event("replica_undrain", replica=name)
+        return rep.detail(now)
+
+    # --------------------------------------------------------------- views
+
+    def health(self) -> Tuple[bool, Dict]:
+        now = self._now()
+        with self._lock:
+            states = {rep.name: rep.state() for rep in self.replicas}
+        n_healthy = sum(1 for s in states.values() if s == "healthy")
+        n_routable = n_healthy + sum(
+            1 for s in states.values() if s in ("degraded", "half_open")
+        )
+        if n_healthy:
+            status = "ok"
+        elif n_routable:
+            status = "degraded"
+        else:
+            status = "unhealthy"
+        detail = {
+            "status": status,
+            "role": "router",
+            "uptime_s": round(time.time() - self._started_at, 1),
+            "replicas": states,
+            "routable": n_routable,
+            "retry_budget": round(self.budget.balance, 2),
+        }
+        return status != "unhealthy", detail
+
+    def detail(self) -> Dict:
+        now = self._now()
+        return {
+            "site": self.site,
+            "pid": self.pid,
+            "host": self.host,
+            "replicas": [rep.detail(now) for rep in self.replicas],
+            "retry_budget": {
+                "balance": round(self.budget.balance, 2),
+                "ratio": self.budget.ratio,
+                "withdrawn": self.budget.withdrawn,
+                "denied": self.budget.denied,
+            },
+            "hedge_after_ms": (
+                None if self.hedge_after_s is None
+                else self.hedge_after_s * 1e3
+            ),
+        }
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    timeout = 120
+
+    def log_message(self, fmt, *args):
+        if self.server.owner.verbose:
+            super().log_message(fmt, *args)
+
+    def _reply(self, code: int, payload, extra_headers=()) -> None:
+        body = (
+            payload if isinstance(payload, (bytes, bytearray))
+            else json.dumps(payload, default=str).encode("utf-8")
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        if code >= 400:
+            self.send_header("Connection", "close")
+            self.close_connection = True
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_GET(self):
+        router = self.server.owner.router
+        path, _, _query = self.path.partition("?")
+        if path == "/healthz":
+            healthy, detail = router.health()
+            self._reply(200 if healthy else 503, detail)
+        elif path == "/metrics":
+            text = router.registry.render().encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            try:
+                self.wfile.write(text)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        elif path == "/debug/replicas":
+            self._reply(200, router.detail())
+        else:
+            self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        router = self.server.owner.router
+        path, _, query = self.path.partition("?")
+        if path in ("/admin/drain", "/admin/undrain"):
+            params = parse_qs(query)
+            name = params.get("replica", [None])[0]
+            if not name:
+                self._reply(400, {"error": "missing ?replica=NAME"})
+                return
+            propagate = params.get("propagate", ["0"])[0] in ("1", "true")
+            if path == "/admin/drain":
+                try:
+                    wait_s = float(params.get("wait_s", ["0"])[0])
+                except (TypeError, ValueError):
+                    self._reply(400, {"error": "wait_s must be a number"})
+                    return
+                detail = router.drain(
+                    name, wait_s=wait_s, propagate=propagate
+                )
+            else:
+                detail = router.undrain(name, propagate=propagate)
+            if detail is None:
+                self._reply(404, {"error": f"unknown replica {name!r}"})
+                return
+            self._reply(200, detail)
+            return
+        if path != "/generate":
+            self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if not 0 < length <= MAX_BODY_BYTES:
+                raise ValueError(f"bad Content-Length {length}")
+        except ValueError as exc:
+            self._reply(400, {"error": f"bad request: {exc}"})
+            return
+        raw = self.rfile.read(length)
+        try:
+            status, body, extra = router.handle_generate(raw, self.headers)
+        except Exception as exc:  # router bug: an orderly 500 beats a
+            self._reply(500, {  # silently dropped connection
+                "error": f"router failure: {exc}"
+            })
+            return
+        self._reply(status, body, extra)
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, owner: "RouterServer"):
+        self.owner = owner
+        super().__init__(addr, _RouterHandler)
+
+
+class RouterServer:
+    """HTTP front for a `FleetRouter` with the same lifecycle surface as
+    `ServingServer`: `start()` serves on a background thread (port 0
+    picks a free one), `shutdown()` stops the probe loop, the listener,
+    and the trace exporter."""
+
+    def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
+                 port: int = 8100, verbose: bool = False,
+                 probes: bool = True):
+        self.router = router
+        self.verbose = verbose
+        self._httpd = _HTTPServer((host, port), self)
+        self._thread: Optional[threading.Thread] = None
+        self._serving = False
+        self._closed = False
+        if probes:
+            router.start_probes()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> "RouterServer":
+        assert self._thread is None, "already started"
+        self._serving = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.05},
+            name="dalle-router-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        if self._closed:
+            return
+        self._serving = True
+        self._httpd.serve_forever(poll_interval=0.05)
+
+    def shutdown(self) -> None:
+        self.router.stop_probes()
+        first_close = not self._closed
+        self._closed = True
+        if self._serving:
+            self._httpd.shutdown()
+            self._serving = False
+        if first_close:
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        if self.router.exporter is not None and first_close:
+            self.router.exporter.stop()
+        if first_close and self.router.log is not None:
+            self.router.log.event("router_shutdown")
+
+
+def add_router_args(p: argparse.ArgumentParser,
+                    require_replicas: bool = True) -> None:
+    """Router-specific flags, shared by `python -m ...serving.router`
+    and `serve.py --router` (which validates --replicas itself, since
+    the flag only applies when --router is set)."""
+    p.add_argument("--replicas", type=str, required=require_replicas,
+                   default=None, metavar="URLS",
+                   help="comma-separated replica base URLs, optionally "
+                   "named: 'http://h1:8000,west=http://h2:8000'")
+    p.add_argument("--attempt_timeout_s", type=float, default=30.0,
+                   help="per-dispatch socket timeout; a slower replica "
+                   "attempt is failed over (the client's own timeout_s "
+                   "still bounds the whole request)")
+    p.add_argument("--hedge_after_ms", type=float, default=None,
+                   help="duplicate a dispatch to the next replica when "
+                   "the primary has not answered within this threshold "
+                   "(first usable answer wins; drawn from the retry "
+                   "budget; default: no hedging)")
+    p.add_argument("--probe_interval_s", type=float, default=1.0,
+                   help="seconds between /healthz probes per replica")
+    p.add_argument("--eject_after", type=int, default=3,
+                   help="consecutive probe failures that eject a replica")
+    p.add_argument("--error_rate_threshold", type=float, default=0.5,
+                   help="rolling dispatch error rate that opens the "
+                   "circuit (with at least --error_min_samples)")
+    p.add_argument("--error_min_samples", type=int, default=4,
+                   help="dispatch outcomes required before the error-"
+                   "rate breaker may open")
+    p.add_argument("--retry_budget_ratio", type=float, default=0.2,
+                   help="retry-budget tokens added per successful "
+                   "dispatch (the sustained retry fraction)")
+    p.add_argument("--retry_budget_initial", type=float, default=10.0,
+                   help="retry-budget tokens at startup (cold-start "
+                   "failover headroom)")
+
+
+def router_from_args(args, registry=None, log=None) -> FleetRouter:
+    """Build a `FleetRouter` from parsed CLI args (shared by both CLIs).
+    Tracing/export flags follow serve.py's."""
+    exporter = None
+    if getattr(args, "trace_export", None):
+        from dalle_pytorch_tpu.obs.aggregate import TraceExporter
+
+        if registry is None:
+            from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+            registry = MetricsRegistry()
+        exporter = TraceExporter(
+            args.trace_export, site=getattr(args, "trace_site", None),
+            registry=registry,
+        )
+    return FleetRouter(
+        [r for r in args.replicas.split(",") if r],
+        registry=registry,
+        tracer=Tracer(
+            enabled=not getattr(args, "no_tracing", False),
+            max_traces=getattr(args, "trace_ring", 256),
+        ),
+        log=log,
+        exporter=exporter,
+        site=getattr(args, "trace_site", None),
+        request_timeout_s=getattr(args, "request_timeout_s", 120.0),
+        attempt_timeout_s=args.attempt_timeout_s,
+        hedge_after_ms=args.hedge_after_ms,
+        probe_interval_s=args.probe_interval_s,
+        eject_after_probe_failures=args.eject_after,
+        error_rate_threshold=args.error_rate_threshold,
+        error_min_samples=args.error_min_samples,
+        retry_budget_ratio=args.retry_budget_ratio,
+        retry_budget_initial=args.retry_budget_initial,
+    )
+
+
+def run_router_server(args, log=None) -> int:
+    """The shared CLI run loop: build the router from parsed args, serve
+    in the foreground with double-signal handling. Both entrypoints
+    (`python -m ...serving.router` and `serve.py --router`) call this so
+    their lifecycle behavior cannot drift."""
+    import signal
+
+    router = router_from_args(args, log=log)
+    server = RouterServer(
+        router, host=args.host, port=args.port,
+        verbose=getattr(args, "verbose", False),
+    )
+
+    stopping = threading.Event()
+
+    def _stop(signum, frame):
+        if stopping.is_set():  # second signal: shutdown is wedged
+            print("[router] second signal: exiting immediately", flush=True)
+            os._exit(1)
+        stopping.set()
+        print(f"[router] signal {signum}: shutting down", flush=True)
+        # shutdown joins the serve loop; run it off the main thread,
+        # which is blocked inside serve_forever
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+
+    # parseable readiness line: tests and orchestrators wait for it
+    print(f"[router] listening on http://{args.host}:{server.port} "
+          f"(replicas={[r.name for r in router.replicas]})", flush=True)
+    server.serve_forever()
+    print("[router] shutdown complete", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    from dalle_pytorch_tpu.obs.logging import StructuredLog
+
+    p = argparse.ArgumentParser(description=__doc__)
+    add_router_args(p)
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8100,
+                   help="0 picks a free port")
+    p.add_argument("--request_timeout_s", type=float, default=120.0)
+    p.add_argument("--trace_export", type=str, default=None, metavar="URL")
+    p.add_argument("--trace_site", type=str, default=None, metavar="NAME")
+    p.add_argument("--trace_ring", type=int, default=256)
+    p.add_argument("--no_tracing", action="store_true")
+    p.add_argument("--verbose", action="store_true")
+    args = p.parse_args(argv)
+
+    log = StructuredLog(component="dalle.router", site=args.trace_site)
+    return run_router_server(args, log=log)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

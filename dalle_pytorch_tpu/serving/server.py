@@ -40,6 +40,15 @@ Endpoints:
                    seconds of live traffic (root-gated -> 403,
                    single-flight -> 409); returns the TensorBoard trace
                    dir.
+  POST /admin/drain -> reversibly pause intake on THIS replica: new
+                   /generate requests get 503 + Retry-After, /healthz
+                   goes 503 `"draining"` (so a health-gated router pulls
+                   it), in-flight requests run to completion. Returns
+                   the drain status (inflight/queued rows, quiesced).
+                   The fleet router's `/admin/drain?replica=&propagate=1`
+                   calls this so direct clients are refused during a
+                   rolling restart too.
+  POST /admin/undrain -> resume intake.
 
 Every /generate request gets a trace ID at ingress — ADOPTED from a valid
 `x-dalle-trace` header (fleet context propagation, obs/aggregate.py:
@@ -65,6 +74,8 @@ from __future__ import annotations
 import base64
 import io
 import json
+import os
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -73,7 +84,13 @@ from urllib.parse import parse_qs
 
 import numpy as np
 
-from dalle_pytorch_tpu.obs.aggregate import TRACE_HEADER, parse_trace_header
+from dalle_pytorch_tpu.obs.aggregate import (
+    TRACE_HEADER,
+    default_site,
+    parse_trace_header,
+    sanitize_site,
+)
+from dalle_pytorch_tpu.serving.router import ROUTE_HEADER, parse_route_header
 from dalle_pytorch_tpu.obs.logging import StructuredLog
 from dalle_pytorch_tpu.obs.profiler import ProfilerBusy, ProfilerCapture
 from dalle_pytorch_tpu.obs.tracing import Tracer
@@ -282,14 +299,46 @@ class _Handler(BaseHTTPRequestHandler):
             )
         self._reply(200, {"trace_dir": str(trace_dir), "seconds": seconds})
 
+    def _drain_body(self) -> bool:
+        """Read and discard a bounded request body (admin POSTs take
+        none, but keep-alive requires draining whatever came). False +
+        a 400 reply on an oversized/malformed length."""
+        try:
+            length = int(self.headers.get("Content-Length", "0") or 0)
+            if not 0 <= length <= MAX_BODY_BYTES:
+                raise ValueError(f"bad Content-Length {length}")
+        except ValueError as exc:
+            self._reply(400, {"error": f"bad request: {exc}"})
+            return False
+        if length:
+            self.rfile.read(length)
+        return True
+
     def do_POST(self):
         owner = self.server.owner
         path, _, query = self.path.partition("?")
         if path == "/debug/profile":
             self._profile(owner, query)
             return
+        if path == "/admin/drain":
+            if self._drain_body():
+                self._reply(200, owner.drain_intake())
+            return
+        if path == "/admin/undrain":
+            if self._drain_body():
+                self._reply(200, owner.undrain_intake())
+            return
         if path != "/generate":
             self._reply(404, {"error": f"unknown path {self.path}"})
+            return
+        if owner.intake_paused:
+            # draining for a rolling restart: refuse BEFORE reading the
+            # body/minting a trace — the router stopped sending already,
+            # this is the direct-client path
+            self._reply(
+                503, {"error": "replica draining (admin)"},
+                [("Retry-After", "5")],
+            )
             return
         try:
             length = int(self.headers.get("Content-Length", "0"))
@@ -358,8 +407,13 @@ class _Handler(BaseHTTPRequestHandler):
 
         # submit-time load context (queue depth, slots, free blocks):
         # stamped just before the submit call so the log line records the
-        # admission conditions this request actually faced
-        admission: dict = {}
+        # admission conditions this request actually faced. Seeded with
+        # the fleet router's routing decision (x-dalle-route:
+        # replica/attempt/hedged) so a fleet log join can attribute
+        # every retry to the attempt that produced it.
+        admission: dict = dict(
+            parse_route_header(self.headers.get(ROUTE_HEADER)) or {}
+        )
 
         def closed_out(outcome: str, status: int, **fields):
             trace.finish(outcome=outcome)
@@ -524,6 +578,7 @@ class ServingServer:
         vitals: Optional[EngineVitals] = None,
         exporter=None,
         tenant_quota_rows: Optional[int] = None,
+        tenant_weights: Optional[dict] = None,
         preempt: bool = True,
         deadline_shed: bool = True,
         reserve_slots: int = 0,
@@ -566,6 +621,7 @@ class ServingServer:
                 max_queue_rows=max_queue_rows,
                 registry=self.registry,
                 tenant_quota_rows=tenant_quota_rows,
+                tenant_weights=tenant_weights,
                 log=log,
                 preempt=preempt,
                 deadline_shed=deadline_shed,
@@ -578,6 +634,7 @@ class ServingServer:
                 max_queue_rows=max_queue_rows,
                 registry=self.registry,
                 tenant_quota_rows=tenant_quota_rows,
+                tenant_weights=tenant_weights,
                 log=log,
             )
         # wire the sampler's host-state sources and launch it (no-op when
@@ -586,6 +643,22 @@ class ServingServer:
             engine=engine, batcher=self.batcher, log=log,
             state_dump_fn=self.state_dump,
         ).start()
+        # preemption-aware SLO burn (ROADMAP §5 follow-on): the batcher's
+        # deadline shed and preemption victim policy consult the
+        # SLOTracker's burn rate — a replica already burning its error
+        # budget sheds earlier and evicts the cheapest-to-redo victim
+        if self.vitals.slo is not None and hasattr(self.batcher, "slo_burn"):
+            self.batcher.slo_burn = self.vitals.slo.max_burn
+        # stable process identity (the PR 9 site/pid/host clamp, shared
+        # with StructuredLog so log lines, traces, and /debug/state all
+        # carry ONE identity a fleet join can key on)
+        self.identity = (
+            dict(log._identity) if log is not None else {
+                "site": default_site(),
+                "pid": os.getpid(),
+                "host": sanitize_site(socket.gethostname() or "localhost"),
+            }
+        )
         try:
             self._httpd = _Server((host, port), self)
         except OSError:
@@ -602,6 +675,10 @@ class ServingServer:
         self._serving = False
         self._closed = False
         self._draining = False
+        # reversible admin drain (POST /admin/drain): intake refused,
+        # /healthz 503, in-flight work completes — distinct from the
+        # terminal shutdown drain above
+        self._intake_paused = False
         self._started_at = time.time()
         self._seed_lock = threading.Lock()
         self._seed_counter = int(time.time()) & 0x7FFFFFFF
@@ -640,13 +717,45 @@ class ServingServer:
     # to clear the error — latching it unhealthy forever.
     error_window_s: float = 60.0
 
+    @property
+    def intake_paused(self) -> bool:
+        return self._intake_paused
+
+    def drain_status(self) -> dict:
+        """Drain progress off the batcher's drain hooks — what a rolling
+        restart polls while waiting for this replica to quiesce."""
+        return {
+            "draining": self._intake_paused or self._draining,
+            "inflight_rows": self.batcher.inflight_rows,
+            "queue_depth_rows": self.batcher.queue_depth_rows,
+            "quiesced": self.batcher.quiesced,
+        }
+
+    def drain_intake(self) -> dict:
+        """POST /admin/drain: reversibly stop admissions (503 to new
+        /generate, 503 `"draining"` on /healthz) while in-flight rows run
+        to completion. The process stays up — `shutdown()` remains the
+        terminal path."""
+        self._intake_paused = True
+        if self.log is not None:
+            self.log.event("drain_intake", **self.drain_status())
+        return self.drain_status()
+
+    def undrain_intake(self) -> dict:
+        """POST /admin/undrain: resume admissions after a drain."""
+        self._intake_paused = False
+        if self.log is not None:
+            self.log.event("undrain_intake")
+        return self.drain_status()
+
     def health(self):
         # snapshot once: the batcher worker can set/clear the error fields
         # concurrently with this probe
         err = self.batcher.last_error
         err_age = self.batcher.error_age_s()
         erroring = err_age is not None and err_age < self.error_window_s
-        healthy = not self._draining and not erroring
+        draining = self._draining or self._intake_paused
+        healthy = not draining and not erroring
         # the degraded tier sits BETWEEN ok and 503: the replica still
         # serves (200 — a health-gated router must not pull it), but a
         # recent watchdog stall or a burning SLO budget says "shed load /
@@ -688,8 +797,9 @@ class ServingServer:
             detail["last_error"] = repr(err)
             if err_age is not None:
                 detail["last_error_age_s"] = round(err_age, 1)
-        if self._draining:
+        if draining:
             detail["draining"] = True
+            detail["drain"] = self.drain_status()
         return healthy, detail
 
     def state_dump(self) -> dict:
@@ -702,7 +812,11 @@ class ServingServer:
         dump = {
             "ts": round(time.time(), 3),
             "uptime_s": round(time.time() - self._started_at, 1),
-            "draining": self._draining,
+            "draining": self._draining or self._intake_paused,
+            # stable replica identity (site/pid/host, the PR 9 clamp):
+            # a fleet postmortem joins this dump against log lines and
+            # collector traces without guessing which process wrote it
+            "identity": self.identity,
         }
         engine_dump = getattr(self.engine, "state_dump", None)
         dump["engine"] = (

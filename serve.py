@@ -18,9 +18,37 @@ import signal
 import sys
 
 
+def parse_tenant_weights(text):
+    """'a=4,b=1' -> {"a": 4.0, "b": 1.0}; raises ValueError on junk."""
+    out = {}
+    for pair in (text or "").split(","):
+        if not pair:
+            continue
+        tenant, sep, weight = pair.partition("=")
+        if not sep or not tenant:
+            raise ValueError(f"expected tenant=weight, got {pair!r}")
+        w = float(weight)
+        if w <= 0:
+            raise ValueError(f"tenant {tenant!r} weight must be > 0")
+        out[tenant] = w
+    return out
+
+
 def parse_args(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--dalle_path", type=str, required=True)
+    p.add_argument("--dalle_path", type=str, default=None,
+                   help="DALL-E checkpoint to serve (required unless "
+                   "--router)")
+    p.add_argument("--router", action="store_true",
+                   help="run the replica fleet ROUTER instead of an "
+                   "engine replica: front the --replicas URLs with "
+                   "health-aware routing, failover retries under a "
+                   "success-fraction retry budget, optional hedging, "
+                   "and graceful drain (POST /admin/drain?replica=). "
+                   "No checkpoint loads in this mode")
+    from dalle_pytorch_tpu.serving.router import add_router_args
+
+    add_router_args(p, require_replicas=False)
     p.add_argument("--clip_path", type=str, default=None,
                    help="optional CLIP checkpoint enabling rerank=true requests")
     p.add_argument("--host", type=str, default="127.0.0.1")
@@ -93,6 +121,13 @@ def parse_args(argv=None):
     p.add_argument("--tenant_quota_rows", type=int, default=None,
                    help="per-tenant cap on queued request rows; a tenant "
                    "past it gets 429 + Retry-After (default: no quota)")
+    p.add_argument("--tenant_weights", type=str, default=None,
+                   metavar="T=W,...",
+                   help="proportional per-tenant admission shares within "
+                   "each priority class, e.g. 'a=4,b=1' (a backlogged "
+                   "weight-4 tenant gets ~4x the rows of a weight-1 "
+                   "one; unlisted tenants weigh 1; weights are shares, "
+                   "--tenant_quota_rows stays the hard cap)")
     p.add_argument("--reserve_slots", type=int, default=0,
                    help="cache slots reserved for priority 'high' "
                    "requests (continuous engine): high arrivals admit at "
@@ -155,6 +190,24 @@ def parse_args(argv=None):
     p.add_argument("--slo_window_s", type=float, default=300.0,
                    help="rolling window for SLO burn-rate computation")
     args = p.parse_args(argv)
+    if args.router:
+        if not args.replicas:
+            p.error("--router needs --replicas URL[,URL...]")
+        if args.dalle_path is not None:
+            p.error("--router does not load a checkpoint; drop "
+                    "--dalle_path (replicas load their own)")
+        if args.no_tracing and args.trace_export is not None:
+            p.error("--trace_export needs the span tracer; drop "
+                    "--no_tracing")
+        return args
+    if args.dalle_path is None:
+        p.error("--dalle_path is required (unless running --router)")
+    if args.replicas is not None:
+        p.error("--replicas only applies with --router")
+    try:
+        args.tenant_weights = parse_tenant_weights(args.tenant_weights) or None
+    except ValueError as exc:
+        p.error(f"bad --tenant_weights: {exc}")
     if args.mesh is not None:
         # fail at parse time, not after the checkpoint loads: both the
         # engine/layout combination and the mesh string itself
@@ -190,8 +243,21 @@ def parse_args(argv=None):
     return args
 
 
+def run_router(args):
+    """`serve.py --router`: the fleet admission router in front of N
+    replicas — no jax, no checkpoint, stdlib HTTP only. One shared run
+    loop with `python -m dalle_pytorch_tpu.serving.router`."""
+    from dalle_pytorch_tpu.obs.logging import StructuredLog
+    from dalle_pytorch_tpu.serving.router import run_router_server
+
+    log = StructuredLog(component="dalle.router", site=args.trace_site)
+    return run_router_server(args, log=log)
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.router:
+        return run_router(args)
     import jax
     import os as _os
 
@@ -301,6 +367,7 @@ def main(argv=None):
         trace_dump_path=args.trace_dump,
         vitals=vitals,
         tenant_quota_rows=args.tenant_quota_rows,
+        tenant_weights=args.tenant_weights,
         preempt=not args.no_preempt,
         deadline_shed=not args.no_shed,
         reserve_slots=args.reserve_slots,

@@ -1,0 +1,77 @@
+"""TL010 negative fixture: every safe retry shape — budget-gated,
+backoff-gated, loop-exiting handlers, bare-except that re-raises, and
+narrow handlers; none may fire."""
+
+import time
+
+
+def budget_gated(dispatch, budget):
+    attempt = 0
+    while True:
+        try:
+            return dispatch()
+        except Exception:
+            attempt += 1
+            if not budget.withdraw():  # budget call: bounded retries
+                raise
+
+
+def backoff_gated(dispatch, stop):
+    backoff_s = 0.1
+    while not stop.is_set():
+        try:
+            return dispatch()
+        except Exception:
+            stop.wait(backoff_s)  # wait(): the loop cannot run hot
+            backoff_s = min(backoff_s * 2, 5.0)
+
+
+def sleep_in_loop_body(dispatch, log):
+    while True:
+        time.sleep(0.5)  # backoff anywhere in the loop body counts
+        try:
+            dispatch()
+        except Exception as exc:
+            log(exc)
+
+
+def handler_exits_loop(dispatch, log):
+    while True:
+        try:
+            return dispatch()
+        except Exception as exc:
+            log(exc)
+            break  # failure ends the loop: not a retry loop
+
+
+def bare_except_reraises(dispatch, cleanup):
+    while True:
+        try:
+            return dispatch()
+        except:  # noqa: E722 -- re-raised below, interrupts survive
+            cleanup()
+            raise
+
+
+def base_exception_named_reraise(dispatch, cleanup):
+    while True:
+        try:
+            return dispatch()
+        except BaseException as exc:
+            cleanup()
+            raise exc  # named re-raise swallows nothing either
+
+
+def narrow_handler(dispatch):
+    while True:
+        try:
+            return dispatch()
+        except ConnectionError:
+            continue  # narrow catches are the caller's policy call
+
+
+def try_outside_loop(dispatch, log):
+    try:
+        dispatch()
+    except Exception as exc:
+        log(exc)  # no enclosing while: nothing to amplify

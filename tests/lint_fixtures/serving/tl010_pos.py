@@ -1,0 +1,33 @@
+"""TL010 positive fixture (path carries `serving/`, so the rule is in
+scope): broad handlers inside retry loops that swallow interrupts or
+retry hot with no backoff/budget discipline."""
+
+import time
+
+
+def swallows_interrupt(dispatch):
+    while True:
+        try:
+            return dispatch()
+        except:  # noqa: E722 -- deliberately bare for the fixture
+            time.sleep(0.1)  # backoff does not excuse eating Ctrl-C
+
+
+def swallows_base_exception(dispatch, log):
+    while True:
+        try:
+            return dispatch()
+        except BaseException as exc:
+            log(exc)  # no bare raise: shutdown sentinels die here
+            time.sleep(0.1)
+
+
+def hot_retry_no_backoff(dispatch, log):
+    done = False
+    while not done:
+        try:
+            dispatch()
+            done = True
+        except Exception as exc:
+            log(exc)  # loops straight back into dispatch() — no
+            continue  # sleep/wait/budget call anywhere in the loop

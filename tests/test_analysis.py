@@ -49,6 +49,7 @@ class TestRuleCorpus:
             ("tl007_pos.py", "TL007", 3),
             ("tl008_pos.py", "TL008", 3),
             ("tl009_pos.py", "TL009", 3),
+            ("serving/tl010_pos.py", "TL010", 3),
         ],
     )
     def test_positive_fixture_caught(self, fixture, code, expected):
@@ -75,6 +76,7 @@ class TestRuleCorpus:
             "tl007_neg.py",
             "tl008_neg.py",
             "tl009_neg.py",
+            "serving/tl010_neg.py",
         ],
     )
     def test_negative_fixture_clean(self, fixture):
@@ -90,6 +92,45 @@ class TestRuleCorpus:
         f = tmp_path / "elsewhere.py"
         f.write_text(
             "import jax.numpy as jnp\n\ndef g(n):\n    return jnp.zeros(n)\n"
+        )
+        assert lint_paths([f]).clean
+
+    def test_tl010_scoped_to_serving(self, tmp_path):
+        """The same hot retry loop outside serving/ is out of scope —
+        training scripts and tooling loop under different contracts."""
+        src = (
+            "def f(dispatch, log):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            dispatch()\n"
+            "        except Exception as exc:\n"
+            "            log(exc)\n"
+            "            continue\n"
+        )
+        outside = tmp_path / "elsewhere.py"
+        outside.write_text(src)
+        assert lint_paths([outside]).clean
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        inside = serving / "loops.py"
+        inside.write_text(src)
+        assert codes(lint_paths([inside])) == ["TL010"]
+
+    def test_tl010_backoff_in_loop_body_counts(self, tmp_path):
+        """The backoff/budget call may live anywhere in the loop, not
+        just the handler — `sleep` before the try is still discipline."""
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        f = serving / "loops.py"
+        f.write_text(
+            "import time\n\n"
+            "def f(dispatch, log):\n"
+            "    while True:\n"
+            "        time.sleep(0.2)\n"
+            "        try:\n"
+            "            dispatch()\n"
+            "        except Exception as exc:\n"
+            "            log(exc)\n"
         )
         assert lint_paths([f]).clean
 

@@ -172,6 +172,54 @@ class TestWeightedFairQueue:
 # --------------------------------------------------- fake-engine QoS policy
 
 
+class TestTenantWeights:
+    """Per-tenant weighted shares (ROADMAP §5 follow-on): fairness within
+    a class is proportional to configured weights, not equal."""
+
+    def test_weights_split_service_proportionally(self):
+        q = WeightedFairQueue(tenant_weights={"a": 4.0, "b": 1.0})
+        for i in range(30):
+            q.push(_R(f"a{i}", tenant="a"))
+            q.push(_R(f"b{i}", tenant="b"))
+        served = {"a": 0, "b": 0}
+        for _ in range(25):
+            served[q.pop().tenant] += 1
+        # stride scheduling over rows_served/weight: a backlogged 4:1
+        # pair splits admissions exactly 4:1
+        assert served == {"a": 20, "b": 5}
+
+    def test_unlisted_tenants_weigh_one(self):
+        q = WeightedFairQueue(tenant_weights={"vip": 2.0})
+        for i in range(20):
+            q.push(_R(f"v{i}", tenant="vip"))
+            q.push(_R(f"p{i}", tenant="pleb"))
+        served = {"vip": 0, "pleb": 0}
+        for _ in range(12):
+            served[q.pop().tenant] += 1
+        assert served == {"vip": 8, "pleb": 4}
+
+    def test_idle_weighted_tenant_banks_no_credit(self):
+        """The reactivation clamp scales by weight: a weight-4 tenant
+        that sat idle re-enters at the current minimum RATIO (not raw
+        rows), so it gets its 4:1 share from now on — not a catch-up
+        burst for the idle period."""
+        q = WeightedFairQueue(tenant_weights={"a": 4.0})
+        for i in range(30):
+            q.push(_R(f"b{i}", tenant="b"))
+        for _ in range(20):
+            q.pop()
+        for i in range(30):
+            q.push(_R(f"a{i}", tenant="a"))
+        wins = {"a": 0, "b": 0}
+        for _ in range(10):
+            wins[q.pop().tenant] += 1
+        assert wins == {"a": 8, "b": 2}
+
+    def test_nonpositive_weight_rejected(self):
+        with pytest.raises(AssertionError):
+            WeightedFairQueue(tenant_weights={"a": 0.0})
+
+
 class StepEngine(FakeContinuousEngine):
     """FakeContinuousEngine whose chunk boundary advances only when the
     test releases a permit — deterministic stepping for policy tests."""
@@ -482,6 +530,87 @@ class TestShedQuotaRetryAfter:
     def _drain(self, eng, b, running):
         _finish(eng, running)
         b.shutdown(drain=False)
+
+
+class TestSLOBurnAware:
+    """Preemption-aware SLO burn (ROADMAP §5 follow-on): the batcher's
+    `slo_burn` hook (wired to SLOTracker.max_burn by ServingServer)
+    tightens admission and changes the preemption victim policy while
+    the error budget burns."""
+
+    def test_burn_tightens_deadline_shed_deterministically(self):
+        eng = FakeContinuousEngine(chunk=4)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        burn = {"v": 0.0}
+        b.slo_burn = lambda: burn["v"]
+        # settle one request so the worker idles, then pin the cost
+        # model: image time = 2 chunks x 1.0s EMA = 2.0s, empty backlog
+        b.submit([spec(1)], timeout_s=30.0).future.result(timeout=10)
+        b._chunk_ema = 1.0
+        # burn <= 1: est completion 2.0s fits a 4s timeout -> admit is
+        # exactly the burn-blind behavior
+        burn["v"] = 0.5
+        b._chunk_ema = 1.0
+        b.submit([spec(2)], timeout_s=4.0).future.result(timeout=10)
+        # burn 4x: admission budget tightens to 4s/4 = 1s < 2s -> shed,
+        # attributed to the burn (the request WOULD fit its raw timeout)
+        burn["v"] = 4.0
+        b._chunk_ema = 1.0
+        with pytest.raises(ShedError) as e:
+            b.submit([spec(3)], timeout_s=4.0)
+        assert e.value.reason == "slo_burn"
+        assert e.value.retry_after_s >= 1.0
+        fam = eng.registry.get("dalle_serving_shed_total")
+        assert dict(fam.items())["slo_burn"].value == 1
+        # a deadline-impossible request stays reason=deadline even while
+        # burning (the burn did not cause that rejection)
+        with pytest.raises(ShedError) as e:
+            b.submit([spec(4)], timeout_s=1.0)
+        assert e.value.reason == "deadline"
+        # a broken burn source must not break admission
+        b.slo_burn = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        b._chunk_ema = 1.0
+        b.submit([spec(5)], timeout_s=4.0).future.result(timeout=10)
+        b.shutdown()
+
+    def test_burn_prefers_cheapest_redo_victim(self):
+        """Victim selection under burn: evict the lower-class request
+        with the LEAST decode progress (cheapest redo) instead of the
+        youngest. Setup makes the two policies disagree: an OLDER
+        single-row request has less total progress than a YOUNGER
+        two-row one."""
+        eng = StepEngine(chunk=2)
+        eng.image_seq_len = 32  # long decode: nothing completes mid-test
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        b.slo_burn = lambda: 2.0
+        old = b.submit([spec(1)], priority="low")
+        assert eng.chunk_entered.wait(10)
+        _until(eng, lambda: b.allocator.n_active == 1)
+        young = b.submit([spec(2), spec(3)], priority="low")
+        _until(eng, lambda: b.allocator.n_active == 3)
+        _step(eng, 3)
+        # precondition: the policies disagree — the older request's one
+        # row has less summed progress than the younger's two rows
+        def progress(req):
+            return sum(
+                int(eng.pos[s])
+                for s, (r, _) in b._inflight.items() if r is req
+            )
+
+        assert progress(old) < progress(young), (
+            f"setup broken: old={progress(old)} young={progress(young)}"
+        )
+        assert old.admitted_seq < young.admitted_seq
+        high = b.submit([spec(9), spec(10)], priority="high")
+        _step(eng, 2)  # boundary 1: preempt; boundary 2: high admits
+        assert old.preemptions == 1, (
+            "burning: the cheapest-redo victim (least progress) must go"
+        )
+        assert young.preemptions == 0
+        _finish(eng, [old, young, high])
+        for r in (old, young, high):
+            r.future.result(timeout=10)
+        b.shutdown()
 
 
 # ------------------------------------------- real engines: bit-identity
