@@ -1168,6 +1168,302 @@ def main_fleet(n_replicas, hedge_after_ms=None):
         s.shutdown()
 
 
+def _toy_checkpoint(path):
+    """A loadable single-file DALLE checkpoint with randomly initialized
+    toy weights — the restart bench measures BOOT cost (checkpoint load +
+    compile), which does not care whether the model was trained."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+    from dalle_pytorch_tpu.training.config import TrainConfig
+    from dalle_pytorch_tpu.training.pipeline import (
+        build_tokenizer,
+        dalle_from_config,
+        dvae_hparams,
+        save_dalle_checkpoint,
+    )
+
+    cfg = TrainConfig()
+    cfg.model.dim = int(os.environ.get("SERVE_DIM", "64"))
+    cfg.model.depth = int(os.environ.get("SERVE_DEPTH", "2"))
+    cfg.model.heads = 2
+    cfg.model.dim_head = cfg.model.dim // 2
+    cfg.model.text_seq_len = int(os.environ.get("SERVE_TEXT_SEQ", "16"))
+    cfg.model.shift_tokens = False
+    cfg.model.rotary_emb = True
+    fmap = int(os.environ.get("SERVE_FMAP", "4"))
+    vae = DiscreteVAE(
+        image_size=4 * fmap, num_layers=2, num_tokens=64,
+        codebook_dim=32, hidden_dim=16,
+    )
+    vae_params = jax.jit(vae.init)(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4 * fmap, 4 * fmap, 3))
+    )["params"]
+    tokenizer = build_tokenizer(cfg)
+    model = dalle_from_config(
+        cfg, num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
+        vocab_size=max(tokenizer.vocab_size, 1),
+    )
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, cfg.model.text_seq_len), jnp.int32),
+        jnp.zeros((1, fmap * fmap), jnp.int32),
+    )
+    save_dalle_checkpoint(
+        str(path), cfg, variables["params"], vae_params, 0,
+        "DiscreteVAE", vae_hparams=dvae_hparams(vae),
+    )
+    return path
+
+
+class _ReplicaProc:
+    """One serve.py subprocess with its stdout harvested into structured
+    log records (the boot bench reads warmup_done; the supervised bench
+    reads replica_start/replica_ready pids and timings)."""
+
+    def __init__(self, argv, env=None):
+        import subprocess
+        import sys
+
+        self.t0 = time.perf_counter()
+        self.proc = subprocess.Popen(
+            [sys.executable] + argv, text=True, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        )
+        self.lines = []
+        self.events = []
+        self.ready_at = None
+        self.port = None
+        self._ready = threading.Event()
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
+
+    def _pump(self):
+        for line in self.proc.stdout:
+            self.lines.append(line)
+            if "listening on http://" in line:
+                self.ready_at = time.perf_counter()
+                self.port = int(
+                    line.split("http://")[1].split()[0].rsplit(":", 1)[1]
+                )
+                self._ready.set()
+            elif line.startswith("{"):
+                try:
+                    self.events.append(json.loads(line))
+                except ValueError:
+                    pass
+        self._ready.set()  # EOF: unblock waiters (boot failed)
+
+    def wait_ready(self, timeout=600.0):
+        assert self._ready.wait(timeout) and self.port is not None, (
+            "replica never came up:\n" + "".join(self.lines[-40:])
+        )
+        return self.ready_at - self.t0
+
+    def event(self, name, default=None):
+        for rec in reversed(self.events):
+            if rec.get("event") == name:
+                return rec
+        return default
+
+    def stop(self, sig=None):
+        import signal as _signal
+
+        if self.proc.poll() is None:
+            self.proc.send_signal(sig or _signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=60)
+            except Exception:
+                self.proc.kill()
+        self._reader.join(timeout=5)
+
+
+def _serve_argv(ckpt, cache_dir, port, chunk_tokens):
+    from pathlib import Path
+
+    return [
+        str(Path(__file__).parent / "serve.py"),
+        "--dalle_path", str(ckpt), "--port", str(port),
+        "--engine", "continuous", "--batch_shapes", "1,4",
+        "--chunk_tokens", str(chunk_tokens),
+        "--compile_cache", str(cache_dir),
+        "--no_request_log",
+    ]
+
+
+def main_restart_bench():
+    """`--restart_bench`: two JSON lines.
+
+    1. serving_restart — boot-to-first-token of the SAME checkpoint,
+       cold compile cache vs warm (the crash-fast recovery claim: a
+       restarted replica's boot cost is cache load, not XLA).
+    2. serving_supervised_restart — a 2-replica fleet behind a real
+       router, replica 0 under `serve.py --supervise` with a warm
+       cache; its serving child is SIGKILLed mid-window; the line
+       reports completion (must be 1.0), the supervisor's restart
+       count, the child's time-to-ready, and the router's
+       ejected->half_open->healthy rejoin accounting.
+    """
+    import os as _os
+    import signal as _signal
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving.router import FleetRouter, RouterServer
+    from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+    chunk_tokens = int(_os.environ.get("SERVE_CHUNK_TOKENS", "4"))
+    work = Path(tempfile.mkdtemp(prefix="dalle_restart_bench_"))
+    ckpt = _toy_checkpoint(work / "dalle.npz")
+    cache_dir = work / "compile_cache"
+    env = dict(_os.environ)
+    env["DALLE_TPU_FORCE_PLATFORM"] = env.get(
+        "DALLE_TPU_FORCE_PLATFORM", ""
+    ) or env.get("JAX_PLATFORMS", "") or "cpu"
+
+    def boot_once():
+        rep = _ReplicaProc(
+            _serve_argv(ckpt, cache_dir, 0, chunk_tokens), env=env
+        )
+        boot_s = rep.wait_ready()
+        t0 = time.perf_counter()
+        out = fleet_request(
+            rep.port, {"prompt": "restart bench", "seed": 1234},
+            timeout=300,
+        )
+        assert out["ok"], out
+        first_s = time.perf_counter() - t0
+        warmup = rep.event("warmup_done", {})
+        rep.stop()
+        return {
+            "boot_s": round(boot_s, 2),
+            "first_request_s": round(first_s, 3),
+            "boot_to_first_token_s": round(boot_s + first_s, 2),
+            "compiles": warmup.get("compiles"),
+            "cache_hits": warmup.get("cache_hits"),
+            "uncached_compiles": warmup.get("uncached_compiles"),
+            "boot_cache_mode": warmup.get("boot_cache_mode"),
+            "boot_seconds": warmup.get("boot_seconds"),
+        }
+
+    cold = boot_once()
+    warm = boot_once()
+    print(json.dumps({
+        "bench": "serving_restart",
+        "engine": "continuous",
+        "chunk_tokens": chunk_tokens,
+        "cold": cold,
+        "warm": warm,
+        "boot_speedup": round(
+            cold["boot_to_first_token_s"]
+            / max(warm["boot_to_first_token_s"], 1e-6), 2,
+        ),
+        "value": warm["boot_to_first_token_s"],
+        "metric": "warm_boot_to_first_token_seconds",
+        "unit": "s",
+    }), flush=True)
+
+    # ---- supervised kill -> restart -> rejoin window -------------------
+    duration_s = float(_os.environ.get("SERVE_RESTART_SECONDS", "30"))
+    import socket as _socket
+
+    probe = _socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    r0_port = probe.getsockname()[1]
+    probe.close()
+    sup = _ReplicaProc(
+        _serve_argv(ckpt, cache_dir, r0_port, chunk_tokens)
+        + ["--supervise"],
+        env=env,
+    )
+    r1 = _ReplicaProc(
+        _serve_argv(ckpt, cache_dir, 0, chunk_tokens), env=env
+    )
+    sup.wait_ready()
+    r1.wait_ready()
+    router = FleetRouter(
+        [
+            f"r0=http://127.0.0.1:{r0_port}",
+            f"r1=http://127.0.0.1:{r1.port}",
+        ],
+        registry=MetricsRegistry(),
+        probe_interval_s=0.25,
+    )
+    front = RouterServer(router, port=0).start()
+    try:
+        warm_lat = []
+        for i in range(6):
+            out = fleet_request(
+                front.port, {"prompt": "warm", "seed": 20_000 + i},
+                timeout=300,
+            )
+            assert out["ok"], out
+            warm_lat.append(out["latency_s"])
+        image_s = max(min(warm_lat[-2:]), 1e-3)
+        rate = 0.25 * 2 * 4 / image_s  # 25% of optimistic fleet capacity
+        rate = float(_os.environ.get("SERVE_RESTART_RPS", rate))
+        rng = np.random.default_rng(0)
+        n = max(8, int(rate * duration_s))
+        arrivals = np.sort(rng.uniform(0.0, duration_s, size=n))
+        seeds = rng.integers(0, 2**31 - 1, size=n)
+
+        start_rec = sup.event("replica_start")
+        child_pid = int(start_rec["pid"])
+        kill_at = 0.25 * duration_s
+
+        def kill():
+            _os.kill(child_pid, _signal.SIGKILL)
+
+        window = run_fleet_window(
+            front.port, arrivals, seeds, timeout_s=120.0,
+            on_offset=(kill_at, kill),
+        )
+        # wait out the rejoin so the attribution below is complete
+        deadline = time.monotonic() + 120
+        rep0 = router.replicas[0]
+        while rep0.restarts < 1 and time.monotonic() < deadline:
+            fleet_request(
+                front.port,
+                {"prompt": "rejoin", "seed": int(time.monotonic() * 1e3)},
+                timeout=300,
+            )
+            time.sleep(0.25)
+        ready = sup.event("replica_ready", {})
+        line = {
+            "bench": "serving_supervised_restart",
+            "engine": "continuous",
+            "replicas": 2,
+            "rate_rps": round(rate, 3),
+            "duration_s": duration_s,
+            "kill_at_s": round(kill_at, 2),
+            "window": window,
+            "supervisor": {
+                "restarts": int(ready.get("restarts", 0)),
+                "time_to_ready_s": ready.get("time_to_ready_s"),
+            },
+            "router": {
+                "r0_restarts": rep0.restarts,
+                "r0_rejoin_s": (
+                    round(rep0.last_rejoin_s, 2)
+                    if rep0.last_rejoin_s is not None else None
+                ),
+                "r0_down_reason": rep0.last_down_reason,
+                "r0_state": rep0.state(),
+            },
+            "value": window["completed"] / max(1, window["offered"]),
+            "metric": "supervised_restart_completion",
+            "unit": "fraction",
+        }
+        print(json.dumps(line), flush=True)
+    finally:
+        front.shutdown()
+        sup.stop()
+        r1.stop()
+
+
 def main_closed_loop():
     sweep = [
         int(c) for c in os.environ.get("SERVE_SWEEP", "1,4,8").split(",")
@@ -1254,6 +1550,15 @@ def main():
         "(SERVE_FLEET_SECONDS / SERVE_FLEET_RPS / SERVE_HEDGE_MS)",
     )
     p.add_argument(
+        "--restart_bench", action="store_true",
+        default=os.environ.get("SERVE_RESTART_BENCH", "0") in ("1", "true"),
+        help="crash-fast recovery mode: (1) boot-to-first-token of the "
+        "same checkpoint cold vs warm compile cache, (2) a supervised "
+        "replica SIGKILLed mid-window behind a real router — restart, "
+        "half-open rejoin, completion fraction; one JSON line each "
+        "(SERVE_RESTART_SECONDS / SERVE_RESTART_RPS)",
+    )
+    p.add_argument(
         "--trace_export", action="store_true",
         default=os.environ.get("SERVE_TRACE_EXPORT", "0") in ("1", "true"),
         help="open-loop: trace every measured request through an "
@@ -1263,7 +1568,9 @@ def main():
         "engine's JSON line",
     )
     args = p.parse_args()
-    if args.replicas:
+    if args.restart_bench:
+        main_restart_bench()
+    elif args.replicas:
         hedge = os.environ.get("SERVE_HEDGE_MS")
         main_fleet(
             args.replicas,

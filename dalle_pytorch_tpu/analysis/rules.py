@@ -57,6 +57,15 @@ TL010  retry-hygiene in `serving/` loops: (a) a bare `except` /
        router's success-fraction retry budget exists to prevent.
        Handlers that `break`/`return`/`raise` are safe (the loop ends);
        anything outside `serving/` is out of scope.
+TL011  warmup-coverage drift: a `jax.jit`/`pjit` program constructed in
+       `serving/` that is never registered with the warmup/AOT-export
+       ladder — it cold-compiles mid-traffic, so a warm-cache boot's
+       zero-compile contract (and the compile cache's artifact
+       inventory) silently drifts. Covered shapes: construction inside
+       a ladder-named function (warmup/capture/register/export/
+       sharded_program), as an argument to a ladder-named call, or
+       assigned to a handle some ladder function references (the
+       lazily-built `_decode_pixels_jit` idiom). `serving/` only.
 TL009  a `Trace.begin(...)` span whose matching `end()` is unreachable
        on the exception path: begin and end in the SAME function, every
        `end` in straight-line code — an exception between them leaks the
@@ -1039,6 +1048,104 @@ class RetryHygieneRule(Rule):
                 )
 
 
+class WarmupCoverageRule(Rule):
+    code = "TL011"
+    name = "warmup-coverage"
+    description = (
+        "a jax.jit/pjit program constructed in serving/ that is never "
+        "registered with the warmup/AOT-export ladder — it cold-compiles "
+        "mid-traffic, so a warm-cache boot's zero-compile contract (and "
+        "the compile cache's artifact inventory) silently drifts"
+    )
+
+    #: warmup discipline is a serving-engine contract; models/ops build
+    #: jitted programs through their own cached builders, and training
+    #: scripts compile eagerly by design
+    SCOPED_DIRS = ("serving",)
+
+    #: function/call name fragments that count as the warmup/AOT ladder.
+    #: A jit call is covered when it is constructed INSIDE one of these
+    #: (warmup methods, `_capture_cost`-style registration, the sharded
+    #: engine's `_sharded_program` memo), or when its assignment target
+    #: is referenced by one (the lazily-built `_decode_pixels_jit` that
+    #: `_capture_decode_pixels_cost` registers). Heuristic with
+    #: false-negative bias, like the rest of the pack.
+    LADDER_FRAGMENTS = (
+        "warmup", "capture", "register", "export", "sharded_program",
+    )
+
+    def _in_scope(self, ctx: FileContext) -> bool:
+        return any(d in ctx.path.parts for d in self.SCOPED_DIRS)
+
+    @staticmethod
+    def _is_jit_call(call: ast.Call) -> bool:
+        terminal = terminal_name(call.func)
+        if terminal not in ("jit", "pjit"):
+            return False
+        dotted = dotted_name(call.func) or terminal
+        # `self.jit(...)`-style methods are not program construction
+        return not dotted.startswith("self.")
+
+    @classmethod
+    def _is_ladder_name(cls, name: str) -> bool:
+        low = (name or "").lower()
+        return any(f in low for f in cls.LADDER_FRAGMENTS)
+
+    def _ladder_refs(self, tree: ast.Module) -> Set[str]:
+        """Every identifier referenced inside a ladder-named function —
+        the set a jit handle must intersect to count as registered."""
+        refs: Set[str] = set()
+        for func in _functions(tree):
+            if self._is_ladder_name(getattr(func, "name", "")):
+                for node in ast.walk(func):
+                    if isinstance(node, ast.Attribute):
+                        refs.add(node.attr)
+                    elif isinstance(node, ast.Name):
+                        refs.add(node.id)
+        return refs
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        refs = self._ladder_refs(ctx.tree)
+        yield from self._scan(ctx, ctx.tree, False, refs)
+
+    def _scan(self, ctx: FileContext, node: ast.AST, covered: bool,
+              refs: Set[str]) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            covered = covered or self._is_ladder_name(node.name)
+        elif isinstance(node, ast.Assign):
+            # `self.X = jax.jit(...)` / `X = jax.jit(...)`: the handle
+            # being referenced by a ladder function registers the program
+            handles = set()
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    handles.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    handles.add(t.id)
+            if handles & refs:
+                covered = True
+        elif isinstance(node, ast.Call):
+            callee = (dotted_name(node.func) or "").lower()
+            if self._is_jit_call(node) and not covered:
+                yield ctx.finding(
+                    self.code, node,
+                    "jit program constructed outside the warmup/AOT-"
+                    "export ladder: it will cold-compile mid-traffic "
+                    "after a warm-cache boot. Dispatch it from warmup() "
+                    "(or register it through the `_capture_cost`/"
+                    "`_sharded_program` ladder) so the compile cache "
+                    "and the zero-recompile contract cover it",
+                )
+            if self._is_ladder_name(callee):
+                # arguments of a ladder call (the sharded engine's
+                # `_sharded_program("chunk", lambda: jax.jit(...))`)
+                # are registered by construction
+                covered = True
+        for child in ast.iter_child_nodes(node):
+            yield from self._scan(ctx, child, covered, refs)
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -1050,4 +1157,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     MeshAxisRule(),
     SpanLeakRule(),
     RetryHygieneRule(),
+    WarmupCoverageRule(),
 )

@@ -316,6 +316,13 @@ class ProgramCostTable:
                 self._errors[name] = repr(exc)
             return False
 
+    def record_error(self, name: str, exc: BaseException) -> None:
+        """Record a capture failure from a caller that did its own
+        lower/compile (the engines' shared AOT ladder pre-compiles once
+        and feeds both this table and the compile-cache export)."""
+        with self._lock:
+            self._errors[name] = repr(exc)
+
     # ---------------------------------------------------------- live wall
 
     def record_wall(self, name: str, seconds: float,

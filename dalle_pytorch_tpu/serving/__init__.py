@@ -29,8 +29,16 @@ queue feeding fixed-shape compiled sampler programs.
     tenant quotas (`TenantQuotaError` → 429) and deadline-aware
     admission shedding (`ShedError` → 503 + Retry-After).
   * `faults.py`   — `FaultInjector`: deterministic fail-Nth / stall-Nth
-    seam on engine dispatches, for recovery-invariant tests and chaos
-    drills (attach to `engine.faults`).
+    / crash-Nth seam on engine dispatches plus compile-cache artifact
+    corruption, for recovery-invariant tests and chaos drills (attach
+    to `engine.faults` / `CompileCache.faults`).
+  * `supervisor.py` — `ReplicaSupervisor`: crash-fast replica restart —
+    spawn the serve.py subprocess, gate readiness on its real /healthz,
+    restart abnormal exits with capped exponential backoff, hold down
+    crash loops (N exits in a window) with a structured `crash_loop`
+    event. `serve.py --supervise` or
+    `python -m dalle_pytorch_tpu.serving.supervisor -- cmd...`; pair
+    with `serve.py --compile_cache` so a restart rejoins in seconds.
   * `router.py`   — `FleetRouter` + `RouterServer`: ONE admission router
     in front of N replicas (`python -m dalle_pytorch_tpu.serving.router`
     / `serve.py --router --replicas ...`): /healthz-probed per-replica
@@ -86,10 +94,13 @@ from dalle_pytorch_tpu.serving.qos import (
 )
 from dalle_pytorch_tpu.serving.router import (
     FleetRouter,
+    QuarantineTracker,
     RetryBudget,
     RouterServer,
+    request_fingerprint,
 )
 from dalle_pytorch_tpu.serving.server import ServingServer
+from dalle_pytorch_tpu.serving.supervisor import ReplicaSupervisor
 
 __all__ = [
     "ContinuousBatcher",
@@ -105,8 +116,11 @@ __all__ = [
     "WeightedFairQueue",
     "engine_from_checkpoint",
     "FleetRouter",
+    "QuarantineTracker",
+    "ReplicaSupervisor",
     "RetryBudget",
     "RouterServer",
+    "request_fingerprint",
     "MicroBatcher",
     "QueueFullError",
     "RequestCancelled",

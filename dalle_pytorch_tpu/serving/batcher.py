@@ -157,6 +157,12 @@ class GenRequest:
         self.preempt_snapshots: Dict[int, np.ndarray] = {}
         self.preemptions = 0
         self.dispatch_retries = 0
+        #: incident ids of the CONSECUTIVE failed engine dispatches this
+        #: request was in flight for (a successful chunk dispatch clears
+        #: the streak) — the batcher-side half of poison-request
+        #: attribution (the HTTP layer maps a request that died with
+        #: `quarantine_after`+ incidents to a terminal 422 carrying them)
+        self.incidents: List[str] = []
         #: admission order stamp (continuous batcher) — the preemption
         #: victim policy releases the YOUNGEST lower-class request
         self.admitted_seq: Optional[int] = None
@@ -280,6 +286,10 @@ class MicroBatcher:
         self._drain = True
         self.last_error: Optional[BaseException] = None
         self._last_error_at: Optional[float] = None
+        #: monotonically-numbered engine dispatch failures; every request
+        #: in flight at failure time carries the incident id (poison
+        #: attribution — `GenRequest.incidents`)
+        self._incident_seq = 0
 
         if registry is None:
             from dalle_pytorch_tpu.training.metrics import MetricsRegistry
@@ -473,6 +483,20 @@ class MicroBatcher:
         base batcher has no service-time model, so 1s; the continuous
         batcher overrides with a chunk-wall-EMA drain estimate."""
         return 1.0
+
+    def _mint_incident(self, reqs, exc: BaseException) -> str:
+        """Attribute one failed engine dispatch to every request in
+        flight for it. Worker-thread only."""
+        self._incident_seq += 1
+        inc_id = f"disp-{self._incident_seq:06d}"
+        for req in _unique_requests(reqs):
+            req.incidents.append(inc_id)
+        if self.log is not None:
+            self.log.event(
+                "dispatch_incident", incident=inc_id, error=repr(exc),
+                implicated=len(_unique_requests(reqs)),
+            )
+        return inc_id
 
     def _admission_cap(self, req) -> int:
         """Largest row count this request could EVER admit with — the
@@ -708,6 +732,7 @@ class MicroBatcher:
             self._last_error_at = time.monotonic()
             self.last_error = exc
             self._m_errors.inc()
+            self._mint_incident(batch, exc)
             # errored batches still observe the stage so /metrics and the
             # traces keep agreeing (same contract as the harvest path)
             self.stage_seconds.labels("generate").observe(
@@ -1131,6 +1156,23 @@ class ContinuousBatcher(MicroBatcher):
                 img_pos, _active = self.engine.step_chunk()
                 chunk_s = time.monotonic() - t0
                 stage_name = None
+                for req in chunk_reqs:
+                    if req.incidents:
+                        # streaks end on DECODE PROGRESS (a successful
+                        # chunk), so a bystander of one old incident
+                        # that keeps decoding and later dies in an
+                        # unrelated one isn't mislabeled poison (422).
+                        # Deliberately NOT cleared by a successful
+                        # prefill: re-admission after a retry always
+                        # prefills, so a chunk-poison request would
+                        # reset its own streak every cycle and never be
+                        # caught — at the cost that an innocent doomed
+                        # by two back-to-back incidents with no chunk
+                        # between them reads as poison (its 422 is
+                        # terminal for THAT attempt only; the replica
+                        # tracks no fingerprint, so a resubmission on a
+                        # healthy engine serves normally)
+                        req.incidents.clear()
                 chunk_index = getattr(
                     self.engine, "chunk_index", self._chunks_dispatched
                 )
@@ -1449,10 +1491,13 @@ class ContinuousBatcher(MicroBatcher):
         the same (seed, position)-keyed determinism preemption relies
         on); requests already retried once fail with the error. Falls
         back to `_fail_all` when nothing is retryable, preserving the
-        original fail-fast behavior."""
+        original fail-fast behavior. Every request in flight for the
+        failed dispatch carries its incident id — repeat implication is
+        the poison-request signal the HTTP layer turns into a 422."""
+        self._mint_incident(list(partial), exc)
         retryable = [r for r in partial if r.dispatch_retries < 1]
         if not retryable:
-            self._fail_all(exc, inflight, partial)
+            self._fail_all(exc, inflight, partial, attributed=True)
             return
         self._last_error_at = time.monotonic()
         self.last_error = exc
@@ -1481,9 +1526,11 @@ class ContinuousBatcher(MicroBatcher):
             pass
         self._set_slots_gauge()
 
-    def _fail_all(self, exc, inflight, partial) -> None:
+    def _fail_all(self, exc, inflight, partial, attributed=False) -> None:
         """Engine failure: error every live request, free every slot, and
         best-effort reset the engine so the next admission starts clean."""
+        if not attributed:
+            self._mint_incident(list(partial), exc)
         self._last_error_at = time.monotonic()
         self.last_error = exc
         self._m_errors.inc()
@@ -1546,6 +1593,7 @@ class ContinuousBatcher(MicroBatcher):
             self._last_error_at = time.monotonic()
             self.last_error = exc
             self._m_errors.inc()
+            self._mint_incident([req for req, _ in done], exc)
             # errored harvests still observe the stage so /metrics and the
             # traces keep agreeing on where the time went
             self.stage_seconds.labels("harvest").observe(

@@ -110,6 +110,12 @@ class GenerationEngine:
 
         self.vitals = NULL_VITALS
         self.cost_table = None
+        # persistent compile cache (utils/compile_cache.py): when a
+        # CompileCache is attached BEFORE warmup, every program in the
+        # warmup ladder exports its AOT executable into the cache's
+        # artifact store (sharing the cost table's one extra compile),
+        # so the NEXT boot of this config is warm
+        self.compile_cache = None
         # fault-injection seam (serving/faults.py): every dispatch calls
         # `_fault_point(program)`, a no-op until a test/chaos harness sets
         # a FaultInjector here — the injected failure then takes the SAME
@@ -145,17 +151,23 @@ class GenerationEngine:
     # -------------------------------------------------------------- vitals
 
     def _capture_cost(self, name: str, fn, *args) -> None:
-        """Record `fn(*args)`'s XLA cost/memory analysis into the attached
-        `ProgramCostTable` under `name` (no-op without one, or once
-        captured). AOT lowering wraps the already-jitted model op in an
-        outer `jax.jit` — params/state ride as REAL arguments, never
-        closure constants, so the lowered HLO matches the dispatched
-        program's traffic. Warmup-only by construction (every call site is
-        gated on its `_warmup` flag): the `.compile()` inside
-        `ProgramCostTable.capture` is one extra backend compile that must
-        never land on the serving path."""
-        table = self.cost_table
-        if table is None or table.has(name):
+        """The warmup AOT ladder: lower + compile `fn(*args)` ONCE and
+        feed every attached consumer — the `ProgramCostTable` records the
+        XLA cost/memory analysis, the `CompileCache` exports the
+        serialized executable as the warm-boot artifact. AOT lowering
+        wraps the already-jitted model op in an outer `jax.jit` —
+        params/state ride as REAL arguments, never closure constants, so
+        the lowered HLO matches the dispatched program's traffic.
+        Warmup-only by construction (every call site is gated on its
+        `_warmup` flag): the `.compile()` here is one extra backend
+        compile that must never land on the serving path (and is itself
+        a persistent-cache hit on a warm boot). Failures are recorded on
+        the consumers, never raised — a backend without cost analysis or
+        executable serialization must not break warmup."""
+        table, cache = self.cost_table, self.compile_cache
+        need_cost = table is not None and not table.has(name)
+        need_export = cache is not None and cache.wants(name)
+        if not (need_cost or need_export):
             return
         import jax
 
@@ -167,9 +179,27 @@ class GenerationEngine:
             [f"{d.platform}:{d.id}" for d in mesh.devices.flat]
             if mesh is not None else None
         )
-        table.capture(
-            name, lambda: jax.jit(fn).lower(*args), devices=devices
-        )
+        try:
+            compiled = jax.jit(fn).lower(*args).compile()
+        except Exception as exc:
+            if need_cost:
+                table.record_error(name, exc)
+            if need_export:
+                cache.record_error(name, exc)
+            return
+        if need_cost:
+            try:
+                table.add(name, compiled, devices=devices)
+            except Exception as exc:
+                table.record_error(name, exc)
+        if need_export:
+            cache.export(name, compiled)
+
+    def program_ladder(self) -> Tuple[str, ...]:
+        """Names of every program `warmup()` compiles — the fixed-shape
+        contract surface. The boot fingerprint hashes this list, so an
+        engine growing a program invalidates stale warm-cache claims."""
+        return tuple(f"generate:{b}" for b in self.batch_shapes)
 
     def state_dump(self) -> dict:
         """Host-side engine state for `/debug/state` and stall reports.
@@ -835,17 +865,32 @@ class ContinuousEngine(GenerationEngine):
 
     def _capture_decode_pixels_cost(self) -> None:
         """The pixel-decode jit exists only after the warmup decode built
-        it (and only for the fused DiscreteVAE path)."""
-        if self.cost_table is None or self._decode_pixels_jit is None:
+        it (and only for the fused DiscreteVAE path). Routed through the
+        shared AOT ladder so the compile cache exports this program too."""
+        if self._decode_pixels_jit is None:
             return
         import jax.numpy as jnp
 
-        self.cost_table.capture(
+        self._capture_cost(
             "decode_pixels",
-            lambda: self._decode_pixels_jit.lower(
-                jnp.zeros((self.max_batch, self.image_seq_len), jnp.int32)
-            ),
+            lambda t: self._decode_pixels_jit(t),
+            jnp.zeros((self.max_batch, self.image_seq_len), jnp.int32),
         )
+
+    def program_ladder(self) -> Tuple[str, ...]:
+        out = ["prefill", "chunk", "release"]
+        if self._has_fused_pixel_decode():
+            out.append("decode_pixels")
+        return tuple(out)
+
+    def _has_fused_pixel_decode(self) -> bool:
+        """Only a fused DiscreteVAE builds the jitted pixel-decode
+        program; pretrained wrappers decode host-side and a VAE-less
+        engine returns tokens only — neither compiles anything, so the
+        ladder (and the boot fingerprint) must not claim the program."""
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        return isinstance(self.vae, DiscreteVAE)
 
     # -------------------------------------------------------- observability
 
@@ -1357,6 +1402,15 @@ class PagedContinuousEngine(ContinuousEngine):
             ),
             self.variables, self._state, self.kv.table,
         )
+
+    def program_ladder(self) -> Tuple[str, ...]:
+        out = ["prefill"]
+        if self.kv.cache.enabled:
+            out.append("admit_hit")
+        out += ["chunk", "release"]
+        if self._has_fused_pixel_decode():
+            out.append("decode_pixels")
+        return tuple(out)
 
     def state_dump(self) -> dict:
         out = super().state_dump()

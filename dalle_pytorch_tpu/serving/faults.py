@@ -16,7 +16,10 @@ XLA error. Dispatch counting includes warmup dispatches; tests attach the
 injector AFTER warmup so rule indices count serving traffic only.
 
 Strictly a test/chaos seam: nothing in the serving stack constructs one
-unless asked (`serve.py` has no flag for it; tests set `engine.faults`).
+unless asked (tests set `engine.faults`; the one production-adjacent
+hook is the `DALLE_SERVE_CRASH=program:nth` env var `serve.py` honors so
+the supervised-restart bench and recovery drills can kill a REAL replica
+subprocess at a chosen dispatch).
 """
 
 from __future__ import annotations
@@ -31,12 +34,20 @@ class InjectedFault(RuntimeError):
 
 
 class FaultInjector:
-    """Fail or stall the Nth dispatch of a named engine program.
+    """Fail, stall, or CRASH the Nth dispatch of a named engine program,
+    and corrupt named compile-cache artifacts before they load.
 
     Rules are one-shot and deterministic: `fail_nth("chunk", 3)` raises
     `InjectedFault` on the third chunk dispatch after attachment and never
     again; `stall_nth("prefill", 1, seconds=2)` sleeps inside the first
-    prefill dispatch (watchdog fodder) then lets it proceed. `fired`
+    prefill dispatch (watchdog fodder) then lets it proceed;
+    `crash_nth("chunk", 2)` hard-aborts the PROCESS at the second chunk
+    dispatch (`os._exit` through the overridable `_abort` seam — no
+    cleanup, no drain: the supervisor/router recovery paths must handle
+    exactly this). `corrupt_cache("chunk", mode="truncate")` truncates or
+    garbles the named AOT cache artifact on disk the Nth time the
+    compile cache is about to read it (`utils/compile_cache.py` calls
+    `on_artifact_load`), exercising the torn-write reject path. `fired`
     records every rule that triggered, for assertions.
     """
 
@@ -45,6 +56,10 @@ class FaultInjector:
         self._counts: Dict[str, int] = {}
         # program -> {nth: rule dict}; one rule per (program, nth)
         self._rules: Dict[str, Dict[int, dict]] = {}
+        # artifact-load rules live in their own namespace: cache reads
+        # are a boot-time event stream, not a dispatch stream
+        self._cache_counts: Dict[str, int] = {}
+        self._cache_rules: Dict[str, Dict[int, dict]] = {}
         self.fired: List[dict] = []
 
     def fail_nth(self, program: str, nth: int,
@@ -67,14 +82,60 @@ class FaultInjector:
             }
         return self
 
+    def crash_nth(self, program: str, nth: int,
+                  exit_code: int = 70) -> "FaultInjector":
+        """Hard process abort at the Nth dispatch of `program` — the
+        replica dies mid-request exactly as a segfaulting runtime or an
+        OOM-killed container would. `_abort` is the seam: unit tests
+        override it; real chaos (`serve.py` DALLE_SERVE_CRASH, the
+        restart bench) lets it `os._exit`."""
+        assert nth >= 1
+        with self._lock:
+            self._rules.setdefault(program, {})[int(nth)] = {
+                "kind": "crash",
+                "exit_code": int(exit_code),
+            }
+        return self
+
+    def corrupt_cache(self, artifact: str, nth: int = 1,
+                      mode: str = "truncate") -> "FaultInjector":
+        """Truncate or garble the named compile-cache artifact the Nth
+        time it is about to be read (attach the injector to
+        `CompileCache.faults`). `mode="truncate"` cuts the file mid-
+        payload (torn write); `mode="garble"` flips payload bytes
+        (bit rot) — both must land in the REJECT branch of the boot
+        plan, never in a crashed boot."""
+        assert nth >= 1 and mode in ("truncate", "garble")
+        with self._lock:
+            self._cache_rules.setdefault(artifact, {})[int(nth)] = {
+                "kind": "corrupt_cache",
+                "mode": mode,
+            }
+        return self
+
     def dispatches(self, program: str) -> int:
         with self._lock:
             return self._counts.get(program, 0)
 
+    def _abort(self, program: str, nth: int, exit_code: int) -> None:
+        """The crash rule's process exit — overridable so unit tests can
+        observe the call instead of dying. Deliberately `os._exit`, not
+        `sys.exit`: no atexit hooks, no drain, no flushed sockets."""
+        import os
+        import sys
+
+        print(
+            f"[faults] crash rule fired: {program} dispatch #{nth} -> "
+            f"os._exit({exit_code})",
+            file=sys.stderr, flush=True,
+        )
+        os._exit(exit_code)
+
     def on_dispatch(self, program: str) -> None:
         """Called by the engine at every dispatch of `program`. Raises
         `InjectedFault` (or the rule's exception) for a matching fail
-        rule; sleeps for a stall rule; counts and returns otherwise."""
+        rule; sleeps for a stall rule; aborts the process for a crash
+        rule; counts and returns otherwise."""
         with self._lock:
             n = self._counts.get(program, 0) + 1
             self._counts[program] = n
@@ -86,9 +147,38 @@ class FaultInjector:
         if rule["kind"] == "stall":
             time.sleep(rule["seconds"])
             return
+        if rule["kind"] == "crash":
+            self._abort(program, n, rule["exit_code"])
+            return  # only reachable with a stubbed _abort
         exc = rule["exc"]
         if exc is None:
             exc = InjectedFault(
                 f"injected failure: {program} dispatch #{n}"
             )
         raise exc
+
+    def on_artifact_load(self, artifact: str, path) -> None:
+        """Called by `CompileCache` before reading `artifact` at `path`.
+        A matching corrupt_cache rule mutates the file ON DISK (missing
+        files are left missing — that's the miss branch, not a reject)
+        and lets the load proceed into the validator."""
+        with self._lock:
+            n = self._cache_counts.get(artifact, 0) + 1
+            self._cache_counts[artifact] = n
+            rule = self._cache_rules.get(artifact, {}).pop(n, None)
+            if rule is not None:
+                self.fired.append({"artifact": artifact, "nth": n, **rule})
+        if rule is None:
+            return
+        try:
+            raw = path.read_bytes()
+        except FileNotFoundError:
+            return
+        if rule["mode"] == "truncate":
+            path.write_bytes(raw[: max(1, len(raw) // 2)])
+        else:  # garble: flip a run of payload bytes, keep the length
+            mid = len(raw) // 2
+            garbled = bytearray(raw)
+            for i in range(mid, min(mid + 16, len(garbled))):
+                garbled[i] ^= 0xFF
+            path.write_bytes(bytes(garbled))

@@ -54,6 +54,20 @@ Mechanisms, in the order a request meets them:
     returns it to rotation. `?propagate=1` additionally drains/undrains
     the replica's own intake (`ServingServer` /admin/drain) so direct
     clients are refused too.
+  * POISON-REQUEST QUARANTINE — every replica CRASH (transport failure:
+    connect refused, reset, severed mid-response) is an INCIDENT
+    attributed to the requests in flight on that replica at the time. A
+    request implicated in `quarantine_after` CONSECUTIVE incidents (a
+    success absolves) is quarantined: the client gets a terminal 422
+    carrying the incident ids, and an identical resubmission is refused
+    at ingress — the missing complement to the retry budget, because a
+    request that CAUSES crashes would otherwise fail over forever and
+    serially kill the fleet, while innocent requests caught in the same
+    crashes are cleared by their own failover success. Replica 5xx
+    answers deliberately do NOT implicate: the replica survived, and
+    request-scoped engine poison is the REPLICA's quarantine (the
+    batcher's dispatch-incident ledger -> its own 422, which passes
+    through here like any 4xx).
 
 Observability: the router adopts or mints `x-dalle-trace` at ingress and
 parents every dispatch span into the caller's context, so its
@@ -145,6 +159,174 @@ def parse_route_header(value) -> Optional[Dict]:
     }
 
 
+def request_fingerprint(body: Dict) -> str:
+    """Content identity of one /generate body for quarantine tracking.
+    Excludes `timeout_s` (client patience is not content) and is
+    computed BEFORE the router pins a seed, so a seedless client
+    re-sending the same poison prompt maps to the same key even though
+    each submission would have drawn a fresh seed."""
+    import hashlib
+
+    essence = {k: v for k, v in body.items() if k != "timeout_s"}
+    return hashlib.sha256(
+        json.dumps(essence, sort_keys=True, default=str).encode()
+    ).hexdigest()[:24]
+
+
+class QuarantineTracker:
+    """Consecutive-incident accounting per request fingerprint.
+
+    `implicate(key, incident)` charges every request in flight during
+    one incident; `absolve(key)` (called on any successful completion)
+    resets the streak — so an innocent request that merely shared a
+    replica with a poison one is cleared by its own failover success,
+    while the poison request's streak only grows. At `after` consecutive
+    implications the key is quarantined.
+
+    ONE replica death is ONE incident: transport failures against the
+    same replica within `coalesce_window_s` share an incident id (N
+    in-flight dispatch threads all report the same severed box), and a
+    key is charged at most once per incident — a bystander must not
+    reach the threshold off a single crash reported twice (once as a
+    bystander, once by its own failed dispatch). Bounded LRU over
+    `capacity` keys; incident metadata rides in a bounded ring for
+    /debug.
+    """
+
+    def __init__(self, after: int = 3, capacity: int = 1024,
+                 coalesce_window_s: float = 5.0, ttl_s: float = 600.0,
+                 time_fn=time.monotonic):
+        assert after >= 1 and capacity >= 1 and ttl_s > 0
+        self.after = int(after)
+        self.capacity = int(capacity)
+        self.coalesce_window_s = float(coalesce_window_s)
+        #: implication streaks EXPIRE: quarantine is protection, not a
+        #: permanent blocklist. Without a TTL, a fleet-wide transport
+        #: blip that walks one request across `after` dead replicas
+        #: would brick its fingerprint forever (quarantined keys are
+        #: refused at ingress, so the absolve-on-success path can never
+        #: run for them). A true replica-killer re-trips within one
+        #: failover walk anyway.
+        self.ttl_s = float(ttl_s)
+        self._now = time_fn
+        self._lock = threading.Lock()
+        #: key -> {"count": consecutive implications, "incidents": [ids]}
+        #: — insertion/refresh ordered, so eviction drops the key with
+        #: the OLDEST most-recent implication (absolve just pops; a
+        #: side ordering structure would go stale on absolve and evict
+        #: live marks)
+        from collections import OrderedDict
+
+        self._marks: "OrderedDict" = OrderedDict()
+        self._incident_seq = 0
+        #: replica -> (incident id, minted at) for coalescing
+        self._last_by_replica: Dict[str, Tuple[str, float]] = {}
+        self.incidents: deque = deque(maxlen=64)
+        self.quarantined_keys = 0
+
+    def mint_incident(self, replica: str, error: str, keys) -> str:
+        """New incident id — or the open one for `replica` when its last
+        death is younger than the coalesce window."""
+        now = self._now()
+        with self._lock:
+            last = self._last_by_replica.get(replica)
+            if last is not None and now - last[1] <= self.coalesce_window_s:
+                return last[0]
+            self._incident_seq += 1
+            inc_id = f"inc-{self._incident_seq:06d}"
+            self._last_by_replica[replica] = (inc_id, now)
+            self.incidents.append({
+                "id": inc_id,
+                "replica": replica,
+                "error": error,
+                "implicated": len(list(keys)),
+                "ts": time.time(),
+            })
+            return inc_id
+
+    def implicate(self, key: str, incident_id: str) -> int:
+        """Charge one key with one incident (idempotent per incident);
+        returns its consecutive implication count."""
+        now = self._now()
+        with self._lock:
+            mark = self._marks.get(key)
+            if mark is not None and now - mark["last_at"] > self.ttl_s:
+                self._marks.pop(key)
+                mark = None  # expired streak: start fresh
+            if mark is None:
+                mark = {"count": 0, "incidents": [], "last_at": now}
+                self._marks[key] = mark
+                while len(self._marks) > self.capacity:
+                    # evict the oldest NON-quarantined mark (never the
+                    # key being charged right now): a quarantined key is
+                    # refused at ingress, so it never refreshes its
+                    # position — plain LRU would let churn silently
+                    # forget a replica-killer. Only when every OTHER
+                    # tracked key is quarantined does the oldest of
+                    # those go (bounded memory wins).
+                    victim = next(
+                        (
+                            k for k, m in self._marks.items()
+                            if k != key and m["count"] < self.after
+                        ),
+                        next(k for k in self._marks if k != key),
+                    )
+                    self._marks.pop(victim)
+            else:
+                # freshly implicated keys are the ones worth keeping
+                self._marks.move_to_end(key)
+            mark["last_at"] = now
+            if incident_id in mark["incidents"]:
+                return mark["count"]
+            mark["count"] += 1
+            mark["incidents"].append(incident_id)
+            if mark["count"] == self.after:
+                self.quarantined_keys += 1
+            return mark["count"]
+
+    def absolve(self, key: str) -> None:
+        """A success ends the streak: the request demonstrably does not
+        kill replicas (it was a bystander)."""
+        with self._lock:
+            self._marks.pop(key, None)
+
+    def is_quarantined(self, key: str) -> bool:
+        with self._lock:
+            mark = self._marks.get(key)
+            if mark is None:
+                return False
+            if self._now() - mark["last_at"] > self.ttl_s:
+                self._marks.pop(key)  # expired: the quarantine lifts
+                return False
+            return mark["count"] >= self.after
+
+    def incidents_for(self, key: str) -> List[str]:
+        with self._lock:
+            mark = self._marks.get(key)
+            return list(mark["incidents"]) if mark else []
+
+    def detail(self) -> Dict:
+        now = self._now()
+        with self._lock:
+            live = {
+                k: m for k, m in self._marks.items()
+                if now - m["last_at"] <= self.ttl_s
+            }
+            quarantined = {
+                k: list(m["incidents"])
+                for k, m in live.items()
+                if m["count"] >= self.after
+            }
+            return {
+                "after": self.after,
+                "ttl_s": self.ttl_s,
+                "tracked_keys": len(live),
+                "quarantined": quarantined,
+                "quarantined_total": self.quarantined_keys,
+                "recent_incidents": list(self.incidents),
+            }
+
+
 class RetryBudget:
     """Token-bucket retry budget that refills on SUCCESS, not on time.
 
@@ -226,6 +408,18 @@ class Replica:
         self.ejected_reason: Optional[str] = None
         self.requests = 0
         self.failures = 0
+        #: request fingerprints currently dispatched here (key -> count)
+        #: — the attribution set a crash incident implicates
+        self.inflight_keys: Dict[str, int] = {}
+        # ---- restart/crash attribution (supervised-restart visibility):
+        #: completed down->up cycles (ejected, then a successful trial)
+        self.restarts = 0
+        #: when the current outage began (None while up)
+        self.down_at: Optional[float] = None
+        #: why the most recent outage began ("<reason>: <last_error>")
+        self.last_down_reason: Optional[str] = None
+        #: ejection-to-recovered wall seconds of the most recent restart
+        self.last_rejoin_s: Optional[float] = None
 
     def state(self) -> str:
         """Single display state: admin mode wins over health."""
@@ -264,6 +458,16 @@ class Replica:
             },
             "ejected_reason": self.ejected_reason,
             "last_error": self.last_error,
+            "restarts": self.restarts,
+            "down_for_s": (
+                round(now - self.down_at, 3)
+                if self.down_at is not None else None
+            ),
+            "last_down_reason": self.last_down_reason,
+            "last_rejoin_s": (
+                round(self.last_rejoin_s, 3)
+                if self.last_rejoin_s is not None else None
+            ),
         }
 
 
@@ -294,6 +498,7 @@ class FleetRouter:
         probe_backoff_max_s: float = 30.0,
         retry_budget_ratio: float = 0.2,
         retry_budget_initial: float = 10.0,
+        quarantine_after: int = 3,
         time_fn=time.monotonic,
     ):
         assert replicas, "router needs at least one replica URL"
@@ -327,6 +532,14 @@ class FleetRouter:
         self.probe_backoff_max_s = float(probe_backoff_max_s)
         self.budget = RetryBudget(
             ratio=retry_budget_ratio, initial=retry_budget_initial
+        )
+        # poison-request quarantine (0 disables): consecutive crash
+        # implications before a request fingerprint is refused outright
+        # (tracker shares the injectable clock so chaos tests drive the
+        # incident-coalescing window deterministically)
+        self.quarantine = (
+            QuarantineTracker(after=int(quarantine_after), time_fn=time_fn)
+            if int(quarantine_after) > 0 else None
         )
         # identity for span UIDs and log lines — the PR 9 clamp, so the
         # router's parent_uid round-trips the header codec
@@ -407,6 +620,12 @@ class FleetRouter:
             "requests refused because no replica was routable for their "
             "class (all ejected/draining/cooling)",
         )
+        self._m_quarantined = registry.counter(
+            "dalle_router_quarantined_total",
+            "requests refused as poison: implicated in K consecutive "
+            "replica crash incidents (terminal 422 with incident ids "
+            "instead of endless failover)",
+        )
         for rep in self.replicas:
             self._m_state.labels(rep.name).set(STATE_VALUES[rep.state()])
             self._m_outstanding.labels(rep.name).set(0)
@@ -439,6 +658,14 @@ class FleetRouter:
         """Caller holds the lock. closed→open edge of the breaker."""
         rep.health = "ejected"
         rep.ejected_reason = reason
+        if rep.down_at is None:
+            # outage start (repeat ejections while flapping keep the
+            # ORIGINAL down timestamp — time-to-rejoin measures the
+            # whole outage, not the last flap)
+            rep.down_at = now
+            rep.last_down_reason = (
+                f"{reason}: {rep.last_error}" if rep.last_error else reason
+            )
         rep.trial_inflight = False
         rep.open_count += 1
         rep.window.clear()
@@ -473,10 +700,23 @@ class FleetRouter:
                     rep.probe_backoff_s = 0.0
                     rep.ejected_reason = None
                     rep.window.clear()
+                    if rep.down_at is not None:
+                        # restart attribution: one completed down->up
+                        # cycle, measured from the ejection that began
+                        # the outage to THIS closing trial
+                        rep.restarts += 1
+                        rep.last_rejoin_s = now - rep.down_at
+                        rep.down_at = None
                     self._set_state_gauge(rep)
                     if self.log is not None:
                         self.log.event(
-                            "replica_recovered", replica=rep.name
+                            "replica_recovered", replica=rep.name,
+                            restarts=rep.restarts,
+                            rejoin_s=(
+                                round(rep.last_rejoin_s, 3)
+                                if rep.last_rejoin_s is not None else None
+                            ),
+                            down_reason=rep.last_down_reason,
                         )
                 else:
                     self._eject(rep, "trial", now)
@@ -691,17 +931,27 @@ class FleetRouter:
                 ]
         return None, []
 
-    def _begin_attempt(self, rep: Replica, rows: int) -> None:
+    def _begin_attempt(self, rep: Replica, rows: int,
+                       key: Optional[str] = None) -> None:
         with self._lock:
             rep.outstanding_rows += rows
             rep.inflight += 1
+            if key is not None:
+                rep.inflight_keys[key] = rep.inflight_keys.get(key, 0) + 1
             self._m_outstanding.labels(rep.name).set(rep.outstanding_rows)
         self._m_requests.labels(rep.name).inc()
 
-    def _end_attempt(self, rep: Replica, rows: int) -> None:
+    def _end_attempt(self, rep: Replica, rows: int,
+                     key: Optional[str] = None) -> None:
         with self._lock:
             rep.outstanding_rows = max(0, rep.outstanding_rows - rows)
             rep.inflight = max(0, rep.inflight - 1)
+            if key is not None:
+                n = rep.inflight_keys.get(key, 0) - 1
+                if n <= 0:
+                    rep.inflight_keys.pop(key, None)
+                else:
+                    rep.inflight_keys[key] = n
             self._m_outstanding.labels(rep.name).set(rep.outstanding_rows)
             if rep.mode == "draining" and rep.outstanding_rows == 0:
                 rep.mode = "drained"
@@ -755,16 +1005,60 @@ class FleetRouter:
         # consumed its own deadline — retrying cannot meet it) pass
         return "pass"
 
-    def _settle(self, res: Dict, rep: Replica, klass: int) -> str:
-        """Record one arrived result into the breaker/cooldowns; returns
-        its classification."""
+    def _implicate_crash(self, rep: Replica, key: Optional[str],
+                         error: str) -> None:
+        """Quarantine attribution for one TRANSPORT failure: it reads as
+        a replica crash/severed connection and implicates every request
+        in flight there at that moment — the crash took them all down,
+        and only repetition across incidents separates the cause from
+        the bystanders. Replica 5xx answers never reach here (the
+        replica survived; request-scoped poison is the replica's own
+        batcher-side quarantine). Caller does NOT hold the lock."""
+        if self.quarantine is None:
+            return
+        with self._lock:
+            keys = set(rep.inflight_keys)
+        if key is not None:
+            keys.add(key)  # own attempt already _end_attempt-ed
+        if not keys:
+            return
+        inc_id = self.quarantine.mint_incident(rep.name, error, keys)
+        counts = {k: self.quarantine.implicate(k, inc_id) for k in keys}
+        if self.log is not None:
+            self.log.event(
+                "crash_incident", incident=inc_id, replica=rep.name,
+                error=error, implicated=len(keys),
+                quarantined=[
+                    k for k, c in counts.items()
+                    if c >= self.quarantine.after
+                ],
+            )
+
+    def _settle(self, res: Dict, rep: Replica, klass: int,
+                key: Optional[str] = None) -> str:
+        """Record one arrived result into the breaker/cooldowns (and the
+        quarantine ledger); returns its classification."""
         kind = self._classify(res, klass)
         if kind == "failover":
+            transport = res["kind"] == "error"
+            error = (
+                repr(res["error"]) if transport else f"http {res['status']}"
+            )
             with self._lock:
-                rep.last_error = (
-                    repr(res["error"]) if res["kind"] == "error"
-                    else f"http {res['status']}"
-                )
+                rep.last_error = error
+            if (
+                transport
+                and not isinstance(res.get("error"), TimeoutError)
+                and not res.get("cancelled")
+            ):
+                # crash evidence only: connect refused / reset / severed
+                # mid-response. A client-side SOCKET TIMEOUT means the
+                # replica was slow, not dead (socket.timeout is a
+                # TimeoutError alias), and a hedge-win CANCELLATION
+                # means WE closed the loser's connection — implicating
+                # on either would let a slow spell or routine hedging
+                # quarantine innocent prompts against healthy replicas.
+                self._implicate_crash(rep, key, error)
             self._record_dispatch(rep, ok=False)
         elif kind == "cooled":
             try:
@@ -780,12 +1074,17 @@ class FleetRouter:
             self._record_dispatch(rep, ok=res["status"] < 500)
             if res["status"] == 200:
                 self.budget.deposit()
+                if self.quarantine is not None and key is not None:
+                    # a completed request demonstrably doesn't kill
+                    # replicas: end its implication streak
+                    self.quarantine.absolve(key)
         self._m_budget.set(self.budget.balance)
         return kind
 
     def _dispatch_hedged(
         self, primary: Replica, hedge_pool: List[Replica], payload: bytes,
         trace, attempt: int, rows: int, klass: int, timeout_s: float,
+        key: Optional[str] = None,
     ) -> Tuple[Dict, str, bool]:
         """One routing attempt: dispatch to `primary`, optionally hedge
         to the best of `hedge_pool` after `hedge_after_s`, first usable
@@ -797,6 +1096,11 @@ class FleetRouter:
         so a half-open trial can never be left claimed forever."""
         results: "queue_mod.Queue[Dict]" = queue_mod.Queue()
         conns: List = []
+        #: set by the winner BEFORE it closes the loser's connection, so
+        #: the loser's resulting transport error reads as CANCELLATION —
+        #: not crash evidence against a healthy replica (the quarantine
+        #: ledger must never fill with hedge-win artifacts)
+        won = threading.Event()
 
         def run(rep: Replica, hedged: bool) -> None:
             span = trace.begin(
@@ -810,7 +1114,7 @@ class FleetRouter:
                 headers[TRACE_HEADER] = format_trace_header(
                     trace.trace_id, self._span_uid(span)
                 )
-            self._begin_attempt(rep, rows)
+            self._begin_attempt(rep, rows, key=key)
             try:
                 try:
                     status, data, keep = self._post(
@@ -820,7 +1124,7 @@ class FleetRouter:
                     trace.end(span, error=repr(exc))
                     res = {
                         "kind": "error", "replica": rep, "error": exc,
-                        "hedged": hedged,
+                        "hedged": hedged, "cancelled": won.is_set(),
                     }
                 else:
                     trace.end(span, status=status)
@@ -829,8 +1133,8 @@ class FleetRouter:
                         "body": data, "headers": keep, "hedged": hedged,
                     }
             finally:
-                self._end_attempt(rep, rows)
-            res["disposition"] = self._settle(res, rep, klass)
+                self._end_attempt(rep, rows, key=key)
+            res["disposition"] = self._settle(res, rep, klass, key=key)
             results.put(res)
 
         threading.Thread(
@@ -872,7 +1176,8 @@ class FleetRouter:
             if kind == "pass":
                 if res["hedged"]:
                     self._m_hedge_wins.inc()
-                for conn in conns:  # first wins: cancel the loser
+                won.set()  # before the close: the loser's error is a
+                for conn in conns:  # cancellation, not crash evidence
                     try:
                         conn.close()
                     except Exception:
@@ -914,6 +1219,25 @@ class FleetRouter:
                 {"error": f"bad request: {exc}"}
             ).encode(), []
         klass = priority_class(priority)
+        # quarantine key BEFORE the seed pin: content identity, so an
+        # identical resubmission (which would draw a fresh seed) is still
+        # recognized as the same poison request
+        qkey = (
+            request_fingerprint(body) if self.quarantine is not None
+            else None
+        )
+        if qkey is not None and self.quarantine.is_quarantined(qkey):
+            self._m_quarantined.inc()
+            incidents = self.quarantine.incidents_for(qkey)
+            if self.log is not None:
+                self.log.event(
+                    "quarantine_refused", key=qkey, incidents=incidents,
+                )
+            return 422, json.dumps({
+                "error": "request quarantined: implicated in "
+                f"{len(incidents)} consecutive replica crash incidents",
+                "incidents": incidents,
+            }).encode(), []
         if body.get("seed") is None:
             body["seed"] = self.next_seed(rows)
         payload = json.dumps(body).encode("utf-8")
@@ -1006,7 +1330,7 @@ class FleetRouter:
             )
             res, kind, hedged = self._dispatch_hedged(
                 primary, hedge_pool, payload, trace, attempt, rows,
-                klass, timeout_attempt,
+                klass, timeout_attempt, key=qkey,
             )
             hedged_any = hedged_any or hedged
             if kind == "pass":
@@ -1018,6 +1342,25 @@ class FleetRouter:
                 extra = [("x-dalle-replica", res["replica"].name)]
                 extra.extend(res.get("headers", {}).items())
                 return status, res["body"], extra
+            if (
+                qkey is not None
+                and self.quarantine.is_quarantined(qkey)
+            ):
+                # THIS request's implication streak just crossed the
+                # threshold: stop failing over — re-dispatching a
+                # replica-killer serially takes down the fleet
+                self._m_quarantined.inc()
+                incidents = self.quarantine.incidents_for(qkey)
+                closed_out(
+                    "quarantined", 422, replica=res["replica"].name,
+                    incidents=incidents,
+                )
+                return 422, json.dumps({
+                    "error": "request quarantined: implicated in "
+                    f"{len(incidents)} consecutive replica crash "
+                    "incidents",
+                    "incidents": incidents,
+                }).encode(), []
             # failover: count it, exclude the loser, loop (bounded by
             # the retry budget withdrawn at the top of the loop)
             reason = (
@@ -1163,6 +1506,10 @@ class FleetRouter:
             "hedge_after_ms": (
                 None if self.hedge_after_s is None
                 else self.hedge_after_s * 1e3
+            ),
+            "quarantine": (
+                self.quarantine.detail()
+                if self.quarantine is not None else {"after": 0}
             ),
         }
 
@@ -1365,6 +1712,11 @@ def add_router_args(p: argparse.ArgumentParser,
     p.add_argument("--retry_budget_initial", type=float, default=10.0,
                    help="retry-budget tokens at startup (cold-start "
                    "failover headroom)")
+    p.add_argument("--quarantine_after", type=int, default=3,
+                   help="consecutive replica-crash incidents a request "
+                   "may be implicated in before it is quarantined "
+                   "(terminal 422 with incident ids; a success clears "
+                   "the streak; 0 disables the quarantine)")
 
 
 def router_from_args(args, registry=None, log=None) -> FleetRouter:
@@ -1401,6 +1753,7 @@ def router_from_args(args, registry=None, log=None) -> FleetRouter:
         error_min_samples=args.error_min_samples,
         retry_budget_ratio=args.retry_budget_ratio,
         retry_budget_initial=args.retry_budget_initial,
+        quarantine_after=getattr(args, "quarantine_after", 3),
     )
 
 

@@ -488,7 +488,29 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(504, {"error": str(exc)})
             return
         except Exception as exc:
-            closed_out("error", 500, error=repr(exc))
+            incidents = list(getattr(req, "incidents", ()) or ())
+            if (
+                owner.quarantine_after
+                and len(incidents) >= owner.quarantine_after
+            ):
+                # poison-request quarantine (batcher half): this request
+                # was in flight for `quarantine_after`+ consecutive
+                # failed engine dispatches — it plausibly CAUSES them.
+                # A terminal 4xx (with the incident ids) tells the
+                # client and the fleet router not to redispatch it; a
+                # 500 would read as replica failure and invite failover.
+                owner.count_quarantined()
+                closed_out(
+                    "quarantined", 422, error=repr(exc),
+                    incidents=incidents,
+                )
+                self._reply(422, {
+                    "error": "request quarantined after "
+                    f"{len(incidents)} failed engine dispatches: {exc}",
+                    "incidents": incidents,
+                })
+                return
+            closed_out("error", 500, error=repr(exc), incidents=incidents)
             self._reply(500, {"error": f"generation failed: {exc}"})
             return
 
@@ -582,11 +604,24 @@ class ServingServer:
         preempt: bool = True,
         deadline_shed: bool = True,
         reserve_slots: int = 0,
+        quarantine_after: int = 2,
     ):
         self.engine = engine
         self.registry = engine.registry
         self.request_timeout_s = float(request_timeout_s)
         self.verbose = verbose
+        # batcher-half poison quarantine: a request that died carrying
+        # this many dispatch-failure incident ids gets a terminal 422
+        # (with the ids) instead of a failover-inviting 500. The default
+        # of 2 pairs with the batcher's one bounded retry: first failure
+        # retries, a request whose retry ALSO failed is the common
+        # factor of two consecutive incidents. 0 disables.
+        self.quarantine_after = int(quarantine_after)
+        self._m_quarantined = self.registry.counter(
+            "dalle_serving_quarantined_total",
+            "requests failed as poison: in flight for quarantine_after+ "
+            "consecutive failed engine dispatches (terminal 422)",
+        )
         # vitals default OFF (the inert, counter-gated zero-allocation
         # object) — serve.py passes an enabled sampler; tests opt in
         self.vitals = (
@@ -693,6 +728,9 @@ class ServingServer:
             s = self._seed_counter
             self._seed_counter = (self._seed_counter + n) & 0x7FFFFFFF
             return s
+
+    def count_quarantined(self) -> None:
+        self._m_quarantined.inc()
 
     def log_request(self, trace, outcome: str, status: int,
                     latency_ms: float, **fields) -> None:

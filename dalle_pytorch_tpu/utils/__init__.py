@@ -1,8 +1,13 @@
 from dalle_pytorch_tpu.utils.compile_guard import (
     RecompileError,
     assert_no_recompiles,
+    cache_hit_count,
     compile_count,
     track_compiles,
+)
+from dalle_pytorch_tpu.utils.compile_cache import (
+    CompileCache,
+    boot_fingerprint,
 )
 from dalle_pytorch_tpu.utils.images import save_image_grid, to_uint8
 from dalle_pytorch_tpu.utils.trees import param_count, tree_bytes

@@ -35,10 +35,17 @@ from typing import Deque, Iterator, List
 
 #: the event jax.monitoring emits once per backend (XLA) compilation
 _COMPILE_EVENT_SUFFIX = "backend_compile"
+#: the event the persistent compilation cache emits once per CACHE HIT —
+#: measured on jax 0.4.37: a hit still fires the backend_compile event
+#: (around the executable load), so `compiles - cache_hits` is the count
+#: of compilations that actually ran XLA. The warm-boot contract
+#: (`utils/compile_cache.py`) pins `uncached == 0` on a second boot.
+_CACHE_HIT_EVENT_SUFFIX = "cache_retrieval_time_sec"
 
 _lock = threading.Lock()
 _installed = False
 _compile_count = 0
+_cache_hit_count = 0
 #: recent event names only (error-message context) — a bare counter plus a
 #: bounded deque keeps a long-lived process from accumulating one string
 #: per compilation forever
@@ -62,6 +69,10 @@ def _install_listener() -> None:
                 with _lock:
                     _compile_count += 1
                     _recent_events.append(name)
+            elif _CACHE_HIT_EVENT_SUFFIX in name:
+                global _cache_hit_count
+                with _lock:
+                    _cache_hit_count += 1
 
         jax.monitoring.register_event_duration_secs_listener(_on_event)
         _installed = True
@@ -81,6 +92,16 @@ def compile_count() -> int:
     listener; 0 forever before that — readers treat it as a delta
     source, not an absolute truth)."""
     return _compile_count
+
+
+def cache_hit_count() -> int:
+    """Backend compilations that were served from the persistent
+    compilation cache (`jax_compilation_cache_dir`) rather than run
+    through XLA. Each hit ALSO fires the backend-compile event, so
+    `compile_count() - cache_hit_count()` is the number of compilations
+    that actually paid XLA time. 0 forever when no cache dir is
+    configured."""
+    return _cache_hit_count
 
 
 def recent_events() -> List[str]:
@@ -103,10 +124,24 @@ class CompileTally:
 
     _start: int = 0
     allowed: int = 0
+    _start_hits: int = 0
 
     @property
     def count(self) -> int:
         return _compile_count - self._start
+
+    @property
+    def cache_hits(self) -> int:
+        """Compilations in the block that loaded from the persistent
+        compilation cache instead of running XLA."""
+        return _cache_hit_count - self._start_hits
+
+    @property
+    def uncached(self) -> int:
+        """Compilations that actually paid XLA time — the warm-boot
+        contract (`utils/compile_cache.py`) pins this at zero on a
+        second boot against a populated cache."""
+        return max(0, self.count - self.cache_hits)
 
     @property
     def events(self) -> List[str]:
@@ -119,7 +154,7 @@ class CompileTally:
 def track_compiles() -> Iterator[CompileTally]:
     """Count backend compilations in a block without asserting."""
     _install_listener()
-    yield CompileTally(_start=_compile_count)
+    yield CompileTally(_start=_compile_count, _start_hits=_cache_hit_count)
 
 
 @contextlib.contextmanager
@@ -127,7 +162,9 @@ def assert_no_recompiles(allowed: int = 0) -> Iterator[CompileTally]:
     """Raise `RecompileError` if the block triggers more than `allowed`
     backend compilations (default: zero — the steady-state contract)."""
     _install_listener()
-    tally = CompileTally(_start=_compile_count, allowed=allowed)
+    tally = CompileTally(
+        _start=_compile_count, allowed=allowed, _start_hits=_cache_hit_count
+    )
     yield tally
     if tally.count > allowed:
         raise RecompileError(

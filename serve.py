@@ -128,6 +128,14 @@ def parse_args(argv=None):
                    "weight-4 tenant gets ~4x the rows of a weight-1 "
                    "one; unlisted tenants weigh 1; weights are shares, "
                    "--tenant_quota_rows stays the hard cap)")
+    p.add_argument("--replica_quarantine_after", type=int, default=2,
+                   help="replica-side poison threshold: a request that "
+                   "died in flight for this many CONSECUTIVE failed "
+                   "engine dispatches gets a terminal 422 with the "
+                   "incident ids instead of a failover-inviting 500 "
+                   "(default 2 pairs with the batcher's one bounded "
+                   "retry; 0 disables — distinct from the router-level "
+                   "--quarantine_after, which tracks replica CRASHES)")
     p.add_argument("--reserve_slots", type=int, default=0,
                    help="cache slots reserved for priority 'high' "
                    "requests (continuous engine): high arrivals admit at "
@@ -139,6 +147,23 @@ def parse_args(argv=None):
     p.add_argument("--no_warmup", action="store_true",
                    help="skip compiling all batch shapes at startup (first "
                    "request per shape then pays compile latency)")
+    p.add_argument("--compile_cache", type=str, default=None, metavar="DIR",
+                   help="persistent compile cache: jax's XLA executable "
+                   "store plus fingerprinted AOT artifacts for every "
+                   "warmed program live under DIR, so a restarted "
+                   "replica (same checkpoint/config/jax/mesh) warms up "
+                   "in seconds instead of recompiling — a mismatched or "
+                   "corrupt cache degrades to a normal cold boot, never "
+                   "a failed one (counted in "
+                   "dalle_boot_cache_{hits,misses,rejects}_total)")
+    p.add_argument("--supervise", action="store_true",
+                   help="run this replica under the crash-fast "
+                   "supervisor: the server becomes a subprocess that is "
+                   "restarted on abnormal exit with capped exponential "
+                   "backoff and crash-loop hold-down, readiness gated "
+                   "on its real /healthz (pair with --compile_cache so "
+                   "restarts rejoin in seconds). Needs an explicit "
+                   "--port")
     p.add_argument("--verbose", action="store_true", help="HTTP access logs")
     p.add_argument("--trace-dump", "--trace_dump", dest="trace_dump",
                    type=str, default=None, metavar="PATH",
@@ -190,6 +215,15 @@ def parse_args(argv=None):
     p.add_argument("--slo_window_s", type=float, default=300.0,
                    help="rolling window for SLO burn-rate computation")
     args = p.parse_args(argv)
+    if args.supervise:
+        if args.router:
+            p.error("--supervise supervises an engine replica; run the "
+                    "router under its own process manager")
+        if args.port == 0:
+            p.error("--supervise needs an explicit --port (the "
+                    "supervisor probes http://host:port/healthz for "
+                    "readiness; port 0 would pick a fresh one per "
+                    "restart)")
     if args.router:
         if not args.replicas:
             p.error("--router needs --replicas URL[,URL...]")
@@ -230,6 +264,8 @@ def parse_args(argv=None):
                 "drop --no_vitals")
     if args.tenant_quota_rows is not None and args.tenant_quota_rows < 1:
         p.error("--tenant_quota_rows must be >= 1 (omit it for no quota)")
+    if args.replica_quarantine_after < 0:
+        p.error("--replica_quarantine_after must be >= 0 (0 disables)")
     max_shape = max(
         (int(b) for b in args.batch_shapes.split(",") if b), default=1
     )
@@ -241,6 +277,15 @@ def parse_args(argv=None):
         # finishes any — fail loudly, not with a silently idle exporter
         p.error("--trace_export needs the span tracer; drop --no_tracing")
     return args
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _null_phase(name):
+    """Boot-phase timer stand-in when no compile cache is configured."""
+    yield
 
 
 def run_router(args):
@@ -258,6 +303,13 @@ def main(argv=None):
     args = parse_args(argv)
     if args.router:
         return run_router(args)
+    if args.supervise:
+        # BEFORE the jax import: the supervisor process only spawns and
+        # probes — the child pays the runtime, and pays it again per
+        # restart (which is exactly what --compile_cache amortizes)
+        from dalle_pytorch_tpu.serving.supervisor import supervise_serve
+
+        return supervise_serve(args, argv)
     import jax
     import os as _os
 
@@ -269,6 +321,11 @@ def main(argv=None):
         SLOTracker, StallWatchdog, StructuredLog, TraceExporter, Tracer,
     )
     from dalle_pytorch_tpu.serving import ServingServer, engine_from_checkpoint
+    from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+    from dalle_pytorch_tpu.utils import compile_guard
+    from dalle_pytorch_tpu.utils.compile_cache import (
+        CompileCache, boot_fingerprint,
+    )
 
     # structured JSONL on stdout replaces the old ad-hoc status prints;
     # the one surviving print is the "[serve] listening" readiness line,
@@ -278,21 +335,49 @@ def main(argv=None):
     # fleet logs merge and join against collector traces by trace_id.
     log = StructuredLog(site=args.trace_site)
 
+    registry = MetricsRegistry()
+    cache = None
+    if args.compile_cache:
+        # install BEFORE anything compiles: the persistent XLA store must
+        # see the warmup ladder's compiles (and serve them back next boot)
+        cache = CompileCache(
+            args.compile_cache, registry=registry, log=log
+        ).install()
+
     batch_shapes = tuple(int(b) for b in args.batch_shapes.split(",") if b)
-    engine = engine_from_checkpoint(
-        args.dalle_path,
-        clip_path=args.clip_path,
-        batch_shapes=batch_shapes,
-        cond_scale=args.cond_scale,
-        mode=args.engine,
-        chunk_tokens=args.chunk_tokens,
-        prefill_batch=args.prefill_batch,
-        kv_layout=args.kv_layout,
-        page_size=args.page_size,
-        kv_pages=args.kv_pages,
-        prefix_entries=args.prefix_entries,
-        mesh=args.mesh,
-    )
+    phases = cache.boot_phase if cache is not None else _null_phase
+    with phases("checkpoint"):
+        engine = engine_from_checkpoint(
+            args.dalle_path,
+            clip_path=args.clip_path,
+            batch_shapes=batch_shapes,
+            cond_scale=args.cond_scale,
+            registry=registry,
+            mode=args.engine,
+            chunk_tokens=args.chunk_tokens,
+            prefill_batch=args.prefill_batch,
+            kv_layout=args.kv_layout,
+            page_size=args.page_size,
+            kv_pages=args.kv_pages,
+            prefix_entries=args.prefix_entries,
+            mesh=args.mesh,
+        )
+    if cache is not None:
+        # identity of this compiled-ladder universe: any drift (jax
+        # upgrade, backend, mesh, model config, new program) turns the
+        # on-disk artifacts into counted misses and the boot goes cold
+        with phases("plan"):
+            cache.bind(
+                boot_fingerprint(
+                    backend=jax.default_backend(),
+                    mesh_shape=args.mesh,
+                    model_config=engine.cfg,
+                    programs=engine.program_ladder(),
+                ),
+                engine.program_ladder(),
+            )
+            cache.plan_boot()
+        engine.compile_cache = cache
     if not args.no_program_costs:
         # attach BEFORE warmup: capture happens while the ladder compiles
         # (one extra AOT compile per program — the price of
@@ -300,11 +385,32 @@ def main(argv=None):
         engine.cost_table = ProgramCostTable(registry=engine.registry)
     if not args.no_warmup:
         log.event("warmup_start", batch_shapes=list(engine.batch_shapes))
-        engine.warmup()
+        with compile_guard.track_compiles() as tally:
+            with phases("warmup"):
+                engine.warmup()
+        # compiles vs cache_hits is the warm-boot receipt: a second boot
+        # against a matching cache logs uncached_compiles=0 (pinned by
+        # the slow-tier recovery test)
         log.event(
             "warmup_done",
             compiled_shapes=list(engine.stats.compiled_shapes),
+            compiles=tally.count,
+            cache_hits=tally.cache_hits,
+            uncached_compiles=tally.uncached,
+            boot_cache_mode=cache.plan["mode"] if cache is not None else None,
+            boot_seconds=dict(cache.boot_seconds) if cache is not None else None,
         )
+
+    crash_spec = _os.environ.get("DALLE_SERVE_CRASH")
+    if crash_spec:
+        # chaos-only seam (recovery drills, the supervised-restart
+        # bench): hard-abort this replica at the Nth dispatch of a named
+        # program, e.g. DALLE_SERVE_CRASH=chunk:3
+        from dalle_pytorch_tpu.serving import FaultInjector
+
+        prog, _, nth = crash_spec.partition(":")
+        engine.faults = FaultInjector().crash_nth(prog, int(nth or 1))
+        log.event("chaos_crash_armed", program=prog, nth=int(nth or 1))
 
     slo_targets = []
     if args.slo_ttft_ms is not None:
@@ -371,6 +477,7 @@ def main(argv=None):
         preempt=not args.no_preempt,
         deadline_shed=not args.no_shed,
         reserve_slots=args.reserve_slots,
+        quarantine_after=args.replica_quarantine_after,
     )
 
     import threading

@@ -1,0 +1,36 @@
+"""TL011 negatives: every serving-side jit flows through a recognized
+warmup/AOT-export ladder shape."""
+
+import jax
+
+
+class CoveredEngine:
+    def __init__(self):
+        self._pixels_jit = None
+
+    def decode(self, x):
+        # lazily built, but `_capture_decode_cost` (a ladder-named
+        # function) references the handle — the engine.py idiom
+        if self._pixels_jit is None:
+            self._pixels_jit = jax.jit(lambda t: t)
+        return self._pixels_jit(x)
+
+    def _capture_decode_cost(self):
+        return self._pixels_jit
+
+    def warmup(self):
+        # constructed inside warmup(): compiled before traffic by
+        # definition
+        probe = jax.jit(lambda x: x - 1)
+        self.decode(probe(0))
+
+
+class ShardedLike:
+    def _sharded_program(self, name, build):
+        return build()
+
+    def _chunk_op(self, s):
+        # the sharded-engine memo: the jit is an argument of a
+        # ladder-named call
+        fn = self._sharded_program("chunk", lambda: jax.jit(lambda v: v))
+        return fn(s)

@@ -1,0 +1,22 @@
+"""TL011 positives: serving-side jit programs outside the warmup ladder.
+
+Each of the three constructions below builds a compiled program that no
+warmup/AOT-export ladder ever registers — after a warm-cache boot it
+would cold-compile in the middle of live traffic.
+"""
+
+import jax
+
+# module-level program used only by the serve path below
+_scale = jax.jit(lambda x: x * 3)  # finding: never referenced by a ladder
+
+
+class LeakyEngine:
+    def __init__(self):
+        # finding: handle `_hot` is never referenced by any
+        # warmup/capture/register function
+        self._hot = jax.jit(lambda x: x * 2)
+
+    def serve(self, x):
+        # finding: constructed mid-request, invoked immediately
+        return jax.jit(lambda y: y + 1)(self._hot(x)) + _scale(x)

@@ -50,6 +50,7 @@ class TestRuleCorpus:
             ("tl008_pos.py", "TL008", 3),
             ("tl009_pos.py", "TL009", 3),
             ("serving/tl010_pos.py", "TL010", 3),
+            ("serving/tl011_pos.py", "TL011", 3),
         ],
     )
     def test_positive_fixture_caught(self, fixture, code, expected):
@@ -77,6 +78,7 @@ class TestRuleCorpus:
             "tl008_neg.py",
             "tl009_neg.py",
             "serving/tl010_neg.py",
+            "serving/tl011_neg.py",
         ],
     )
     def test_negative_fixture_clean(self, fixture):
@@ -115,6 +117,50 @@ class TestRuleCorpus:
         inside = serving / "loops.py"
         inside.write_text(src)
         assert codes(lint_paths([inside])) == ["TL010"]
+
+    def test_tl011_scoped_to_serving(self, tmp_path):
+        """The same unregistered jit outside serving/ is out of scope —
+        models/ops build programs through their own cached builders."""
+        src = (
+            "import jax\n\n"
+            "def g(x):\n"
+            "    return jax.jit(lambda y: y)(x)\n"
+        )
+        outside = tmp_path / "elsewhere.py"
+        outside.write_text(src)
+        assert lint_paths([outside]).clean
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        inside = serving / "prog.py"
+        inside.write_text(src)
+        assert codes(lint_paths([inside])) == ["TL011"]
+
+    def test_tl011_ladder_handle_reference_covers(self, tmp_path):
+        """A jit assigned to a handle that ANY ladder-named function
+        references is registered (the engine.py `_decode_pixels_jit` /
+        `_capture_decode_pixels_cost` idiom); dropping the ladder
+        function flips it to a finding."""
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        covered = (
+            "import jax\n\n"
+            "class E:\n"
+            "    def build(self):\n"
+            "        self._p = jax.jit(lambda x: x)\n"
+            "    def _capture_cost_of_p(self):\n"
+            "        return self._p\n"
+        )
+        f = serving / "covered.py"
+        f.write_text(covered)
+        assert lint_paths([f]).clean
+        g = serving / "uncovered.py"
+        g.write_text(
+            "import jax\n\n"
+            "class E:\n"
+            "    def build(self):\n"
+            "        self._p = jax.jit(lambda x: x)\n"
+        )
+        assert codes(lint_paths([g])) == ["TL011"]
 
     def test_tl010_backoff_in_loop_body_counts(self, tmp_path):
         """The backoff/budget call may live anywhere in the loop, not
