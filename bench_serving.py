@@ -1168,6 +1168,241 @@ def main_fleet(n_replicas, hedge_after_ms=None):
         s.shutdown()
 
 
+def main_drain_bench():
+    """`--drain_bench`: rolling drain of one of two replicas mid-window,
+    three flavors over identical Poisson schedules of 2-row requests:
+
+      * `migrate`  — `drain?migrate=1`: the replica exports decode-state
+        checkpoints at a chunk boundary; the router re-dispatches each
+        in-flight request as a RESUME on the healthy replica.
+      * `wait`     — the PR 12 graceful drain: stop admissions, wait out
+        every outstanding row (zero re-decode, but the drain takes a
+        full decode).
+      * `failover` — the non-migrating baseline: a dispatch failure
+        (FaultInjector) destroys the replica's decode state mid-window;
+        recovery re-admits everything in flight FROM SCRATCH (the PR 11
+        bounded retry) — the re-decode cost migration exists to cut.
+
+    One JSON line: per-flavor client-visible errors, drain wall time,
+    decoded/resumed token counters, and `re_decoded` (tokens decoded
+    beyond what the completed requests strictly needed). The acceptance
+    claim is migrate: zero errors, re_decoded strictly below kill's,
+    drain wall far below wait's.
+    """
+    import numpy as np
+
+    from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+    from dalle_pytorch_tpu.serving.engine import ContinuousEngine
+    from dalle_pytorch_tpu.serving.router import FleetRouter, RouterServer
+    from dalle_pytorch_tpu.serving.server import ServingServer
+    from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+    # a bigger toy image than the other modes: the instrument measures
+    # WORK IN FLIGHT at drain time, so decode must take long enough for
+    # the drain to catch requests mid-image
+    os.environ.setdefault("SERVE_FMAP", "8")
+    chunk_tokens = int(os.environ.get("SERVE_CHUNK_TOKENS", "1"))
+    max_batch = int(os.environ.get("SERVE_FLEET_SLOTS", "4"))
+    duration_s = float(os.environ.get("SERVE_DRAIN_SECONDS", "8"))
+    num_images = 2
+    model, params, vae, vae_params, _text_ids = build_toy()
+    image_seq = model.image_seq_len
+
+    def build_fleet():
+        servers = []
+        for _ in range(2):
+            eng = ContinuousEngine(
+                model=model, variables=params, vae=vae,
+                vae_params=vae_params, max_batch=max_batch,
+                chunk_tokens=chunk_tokens, prefill_batch=max_batch,
+                registry=MetricsRegistry(), resume_enabled=True,
+            )
+            eng.tokenizer = ByteTokenizer()
+            servers.append(
+                ServingServer(
+                    eng, port=0, request_timeout_s=120,
+                    max_queue_rows=max(64, 8 * max_batch),
+                ).start()
+            )
+        router = FleetRouter(
+            [f"r{i}=http://127.0.0.1:{s.port}"
+             for i, s in enumerate(servers)],
+            registry=MetricsRegistry(), probe_interval_s=0.25,
+        )
+        front = RouterServer(router, port=0).start()
+        return servers, router, front
+
+    servers, router, front = build_fleet()
+    port = front.port
+
+    warm_lat = []
+    for i in range(6):
+        out = fleet_request(
+            port, {"prompt": "warm", "seed": 10_000 + i,
+                   "num_images": num_images},
+        )
+        assert out["ok"], f"warmup request failed: {out}"
+        warm_lat.append(out["latency_s"])
+    image_s = max(min(warm_lat[-2:]), 1e-3)
+    # 40% of optimistic fleet capacity: high enough that the drained
+    # replica holds real in-flight work, low enough that the surviving
+    # replica can absorb the post-drain window without shedding
+    rate = 0.3 * 2 * max_batch / num_images / image_s
+    rate = float(os.environ.get("SERVE_DRAIN_RPS", rate))
+    rng = np.random.default_rng(
+        int(os.environ.get("SERVE_ARRIVAL_SEED", "0"))
+    )
+    n = max(4, int(rate * duration_s))
+    arrivals = np.sort(rng.uniform(0.0, duration_s, size=n))
+    base_seeds = rng.integers(0, 2**30 - 1, size=n)
+    drain_at = 0.3 * duration_s
+
+    def counters(which):
+        out = 0
+        for s in servers:
+            c = s.registry.get(f"dalle_serving_{which}_tokens_total")
+            out += int(c.value) if c is not None else 0
+        return out
+
+    def run_window(mode, seeds):
+        before_dec = counters("decoded")
+        before_res = counters("resumed")
+        drain_wall = {}
+
+        def trigger():
+            # wait (bounded) for r0 to actually HOLD work, so every
+            # flavor measures a loaded-replica drain, not an empty one
+            rep0 = router._find("r0")
+            t_deadline = time.monotonic() + 5.0
+            while rep0.outstanding_rows == 0 \
+                    and time.monotonic() < t_deadline:
+                time.sleep(0.002)
+            drain_wall["caught_rows"] = rep0.outstanding_rows
+            t0 = time.monotonic()
+            if mode == "migrate":
+                router.drain("r0", wait_s=60.0, migrate=True)
+            elif mode == "wait":
+                router.drain("r0", wait_s=120.0, propagate=True)
+            else:
+                # failover baseline: one injected chunk-dispatch failure
+                # destroys r0's donated decode state; every in-flight
+                # request suspends and re-admits FROM SCRATCH (the PR 11
+                # bounded retry) — the exact re-decode a crash costs
+                # today, without migration
+                from dalle_pytorch_tpu.serving.faults import FaultInjector
+
+                servers[0].engine.faults = FaultInjector().fail_nth(
+                    "chunk", 1
+                )
+            drain_wall["s"] = time.monotonic() - t0
+
+        results = [None] * len(arrivals)
+        threads = []
+        fired = threading.Event()
+        trigger_thread = None
+        t_start = time.monotonic()
+        for i, (offset, seed) in enumerate(zip(arrivals, seeds)):
+            delay = t_start + offset - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            if not fired.is_set() and offset >= drain_at:
+                fired.set()
+                trigger_thread = threading.Thread(
+                    target=trigger, daemon=True
+                )
+                trigger_thread.start()
+
+            def client(i=i, seed=seed):
+                results[i] = fleet_request(
+                    port,
+                    {"prompt": f"drain bench {seed}", "seed": int(seed),
+                     "num_images": num_images, "timeout_s": 90},
+                    timeout=95.0,
+                )
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=120.0)
+        if trigger_thread is not None:
+            # the wait-drain blocks until outstanding hits zero — join it
+            # so drain_wall_s is the real number, not a race with the
+            # last client's completion
+            trigger_thread.join(timeout=150.0)
+        done = [r for r in results if r is not None]
+        completed = sum(1 for r in done if r["ok"])
+        lat = sorted(r["latency_s"] for r in done if r["ok"])
+        errors_by = {}
+        for r in done:
+            if not r["ok"]:
+                key = str(r["status"] or r["error"])
+                errors_by[key] = errors_by.get(key, 0) + 1
+        decoded = counters("decoded") - before_dec
+        resumed = counters("resumed") - before_res
+        needed = completed * num_images * image_seq
+        return {
+            "offered": len(arrivals),
+            "completed": completed,
+            "errors": len(arrivals) - completed,
+            "errors_by": errors_by,
+            "drain_caught_rows": drain_wall.get("caught_rows", 0),
+            "drain_wall_s": round(drain_wall.get("s", 0.0), 3),
+            "decoded_tokens": decoded,
+            "resumed_tokens": resumed,
+            "needed_tokens": needed,
+            # decode work beyond what the completed requests strictly
+            # required — the lost-work number migration exists to cut
+            "re_decoded_tokens": max(0, decoded - needed),
+            "latency_p95_ms": (
+                round(1000 * _percentile(lat, 0.95), 1) if lat else None
+            ),
+        }
+
+    windows = {}
+    windows["migrate"] = run_window("migrate", base_seeds)
+    router.undrain("r0", propagate=True)
+    # let the half-open trial re-admit r0 before the next window
+    for i in range(4):
+        fleet_request(port, {"prompt": "rejoin", "seed": 20_000 + i,
+                             "num_images": num_images})
+    windows["wait"] = run_window("wait", base_seeds + 1)
+    router.undrain("r0", propagate=True)
+    for i in range(4):
+        fleet_request(port, {"prompt": "rejoin2", "seed": 30_000 + i,
+                             "num_images": num_images})
+    windows["failover"] = run_window("failover", base_seeds + 2)
+
+    migs = router.registry.get("dalle_router_migrations_total")
+    line = {
+        "bench": "serving_drain",
+        "engine": "continuous",
+        "max_batch": max_batch,
+        "chunk_tokens": chunk_tokens,
+        "num_images": num_images,
+        "rate_rps": round(rate, 3),
+        "drain_at_s": round(drain_at, 3),
+        "windows": windows,
+        "router_migrations": (
+            {label: int(c.value) for label, c in migs.items()}
+            if migs is not None else {}
+        ),
+        "value": (
+            1.0 if windows["migrate"]["errors"] == 0
+            and windows["migrate"]["re_decoded_tokens"]
+            < max(1, windows["failover"]["re_decoded_tokens"])
+            else 0.0
+        ),
+        "metric": "migrating_drain_zero_error_and_less_redecode",
+        "unit": "bool",
+    }
+    print(json.dumps(line), flush=True)
+
+    front.shutdown()
+    for s in servers:
+        s.shutdown()
+
+
 def _toy_checkpoint(path):
     """A loadable single-file DALLE checkpoint with randomly initialized
     toy weights — the restart bench measures BOOT cost (checkpoint load +
@@ -1550,6 +1785,18 @@ def main():
         "(SERVE_FLEET_SECONDS / SERVE_FLEET_RPS / SERVE_HEDGE_MS)",
     )
     p.add_argument(
+        "--drain_bench", action="store_true",
+        default=os.environ.get("SERVE_DRAIN_BENCH", "0") in ("1", "true"),
+        help="zero-lost-work drain mode: two continuous replicas behind "
+        "a real router, one drained mid-window three ways — "
+        "drain?migrate=1 (decode-state checkpoints re-dispatched as "
+        "resumes), graceful wait-drain, and an injected state-loss "
+        "failure (the non-migrating failover baseline); one JSON line "
+        "with per-flavor errors, drain wall "
+        "time, and re-decoded token counts "
+        "(SERVE_DRAIN_SECONDS / SERVE_DRAIN_RPS)",
+    )
+    p.add_argument(
         "--restart_bench", action="store_true",
         default=os.environ.get("SERVE_RESTART_BENCH", "0") in ("1", "true"),
         help="crash-fast recovery mode: (1) boot-to-first-token of the "
@@ -1568,7 +1815,9 @@ def main():
         "engine's JSON line",
     )
     args = p.parse_args()
-    if args.restart_bench:
+    if args.drain_bench:
+        main_drain_bench()
+    elif args.restart_bench:
         main_restart_bench()
     elif args.replicas:
         hedge = os.environ.get("SERVE_HEDGE_MS")

@@ -66,6 +66,19 @@ TL011  warmup-coverage drift: a `jax.jit`/`pjit` program constructed in
        sharded_program), as an argument to a ladder-named call, or
        assigned to a handle some ladder function references (the
        lazily-built `_decode_pixels_jit` idiom). `serving/` only.
+TL012  mid-chunk decode-state snapshot: a host snapshot/serialization
+       call (`snapshot_rows`, `harvest`-as-snapshot, checkpoint
+       `encode_checkpoint`) inside a `serving/` `while` loop with NO
+       chunk-boundary guard around it. The migration/beacon machinery
+       (serving/migrate.py) must only leave the device at chunk
+       boundaries, and at a bounded cadence — an unguarded snapshot in
+       the worker loop adds a device sync to EVERY iteration, the exact
+       stall class TL002's hotloop tier polices. Guards recognized: an
+       enclosing `if` whose test names a boundary condition (chunk /
+       boundary / beacon / migrat / spool / due / pending) or carries a
+       `%`-cadence expression. `serving/` only; calls inside helper
+       methods (not loops) stay silent — false-negative bias like the
+       rest of the pack.
 TL009  a `Trace.begin(...)` span whose matching `end()` is unreachable
        on the exception path: begin and end in the SAME function, every
        `end` in straight-line code — an exception between them leaks the
@@ -1146,6 +1159,120 @@ class WarmupCoverageRule(Rule):
             yield from self._scan(ctx, child, covered, refs)
 
 
+class ChunkBoundarySnapshotRule(Rule):
+    code = "TL012"
+    name = "mid-chunk-snapshot"
+    description = (
+        "host decode-state snapshot/serialization call inside a serving "
+        "loop without a chunk-boundary guard — migration/beacon work "
+        "must leave the device only at chunk boundaries, at a bounded "
+        "cadence, or every loop iteration pays a device sync"
+    )
+
+    #: chunk-boundary discipline is a serving-stack contract (the worker
+    #: loop of serving/batcher.py); nothing else runs a chunk loop
+    SCOPED_DIRS = ("serving",)
+
+    #: call-name fragments that read or serialize decode state on the
+    #: host. `harvest` is deliberately absent: the retirement harvest is
+    #: the designed boundary sync, and flagging it would just force a
+    #: suppression on the one legitimate call
+    SNAPSHOT_FRAGMENTS = ("snapshot_rows", "encode_checkpoint")
+
+    #: guard-test name fragments that count as a chunk-boundary /
+    #: cadence condition (heuristic, false-negative bias like TL010's
+    #: backoff hints)
+    GUARD_HINTS = (
+        "chunk", "boundary", "beacon", "migrat", "spool", "due", "pending",
+    )
+
+    def _in_scope(self, ctx: FileContext) -> bool:
+        return any(d in ctx.path.parts for d in self.SCOPED_DIRS)
+
+    @classmethod
+    def _is_boundary_guard(cls, test: ast.AST) -> bool:
+        """Does an `if` test look like a chunk-boundary/cadence guard?
+        Any mentioned name containing a guard hint, or a `%` cadence
+        expression (`chunk_index % every == 0`)."""
+        for node in ast.walk(test):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod):
+                return True
+            name = None
+            if isinstance(node, ast.Attribute):
+                name = node.attr
+            elif isinstance(node, ast.Name):
+                name = node.id
+            if name and any(h in name.lower() for h in cls.GUARD_HINTS):
+                return True
+        return False
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        for func in _functions(ctx.tree):
+            if isinstance(func, ast.Lambda):
+                continue
+            yield from self._outermost_loops(ctx, func)
+
+    def _outermost_loops(self, ctx: FileContext,
+                         func: ast.AST) -> Iterator[Finding]:
+        """Visit each function's OUTERMOST `while` loops only — the loop
+        scan itself descends into nested ones (guard context intact), so
+        one unguarded call yields exactly one finding."""
+
+        def rec(node: ast.AST) -> Iterator[ast.While]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _ALL_FUNCS):
+                    continue  # nested defs get their own check() pass
+                if isinstance(child, ast.While):
+                    yield child
+                else:
+                    yield from rec(child)
+
+        for loop in rec(func):
+            yield from self._check_loop(ctx, loop)
+
+    def _check_loop(self, ctx: FileContext,
+                    loop: ast.While) -> Iterator[Finding]:
+        """Walk the loop body (nested `while` loops included — they are
+        not visited separately) tracking whether each node sits under a
+        boundary-guard `if`; snapshot calls outside every guard are the
+        findings. Nested functions are not descended into."""
+
+        def scan(node: ast.AST, guarded: bool) -> Iterator[Finding]:
+            if isinstance(node, _ALL_FUNCS):
+                return
+            if isinstance(node, ast.If):
+                covered = guarded or self._is_boundary_guard(node.test)
+                for child in node.body:
+                    yield from scan(child, covered)
+                for child in node.orelse:
+                    # the else of a boundary guard is NOT at the boundary
+                    yield from scan(child, guarded)
+                return
+            if isinstance(node, ast.Call):
+                dotted = (dotted_name(node.func) or "").lower()
+                if (
+                    any(f in dotted for f in self.SNAPSHOT_FRAGMENTS)
+                    and not guarded
+                ):
+                    yield ctx.finding(
+                        self.code, node,
+                        "decode-state snapshot/serialization inside a "
+                        "serving loop with no chunk-boundary guard: this "
+                        "host read runs EVERY iteration — gate it on a "
+                        "boundary condition or a %-cadence (recognized "
+                        "guard names: "
+                        f"{', '.join(self.GUARD_HINTS)}) so migration "
+                        "never adds a mid-chunk device sync",
+                    )
+            for child in ast.iter_child_nodes(node):
+                yield from scan(child, guarded)
+
+        for stmt in loop.body:
+            yield from scan(stmt, False)
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -1158,4 +1285,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     SpanLeakRule(),
     RetryHygieneRule(),
     WarmupCoverageRule(),
+    ChunkBoundarySnapshotRule(),
 )

@@ -466,6 +466,55 @@ class DALLE(nn.Module):
         out, cache = self.transformer(emb, cache=cache)
         return self.to_logits(out)[:, 0].astype(jnp.float32), cache
 
+    def decode_resume(self, text: jnp.ndarray, image_tokens: jnp.ndarray,
+                      image_pos, cache: dict):
+        """Teacher-forced re-prefill of prompt + generated image prefix in
+        ONE cached forward — the decode-state migration fast path: a row
+        resuming at position k pays one parallel prefill instead of k
+        sequential decode steps.
+
+        `image_tokens` is the [B, image_seq_len] generated-token buffer
+        (zeros beyond each row's prefix), `image_pos` [B] the per-row
+        resume positions k. The forward runs the SAME per-position math
+        as the incremental path — embeddings as `decode_image_step`,
+        batch token-shift (value-equal to the streaming ring shift),
+        causal cached attention from position 0 — over the fixed length
+        text_len + image_seq_len - 1 (the last image token's K/V is never
+        read: decode at the final position attends only below it). K/V
+        beyond a row's k is garbage from the zero padding; decode never
+        reads past the stamped index and overwrites those positions as it
+        advances, the same stale-content argument the slot reuse and
+        paging paths already rely on. Shift rings are rebuilt per row at
+        the window BELOW text_len + k (`shift_ring_from_prefill_at` via
+        the cache's `ring_end` leaf, stripped from the result). Returns
+        (pending logits for each row's position k [B, V], cache) — for
+        k = 0 this degenerates to exactly `decode_prefill`.
+        """
+        _, tokens = self.embed_text(text, null_cond_prob=0.0)
+        text_len = tokens.shape[1]  # text_seq_len + 1 (<bos>)
+        # image tokens 0..image_seq_len-2, embedded exactly as
+        # decode_image_step embeds token j at grid position j
+        img_tok = image_tokens[:, : self.image_seq_len - 1].astype(jnp.int32)
+        img = self.image_emb(img_tok)
+        if not self.rotary_emb:
+            img = img + self.image_pos_emb(self.image_seq_len)[
+                None, : self.image_seq_len - 1
+            ]
+        seq = jnp.concatenate([tokens, img.astype(tokens.dtype)], axis=1)
+        image_pos = jnp.asarray(image_pos, jnp.int32)
+        cache = dict(cache)
+        ring_end = text_len + image_pos  # [B] global resume positions
+        cache = _with_ring_end(cache, ring_end, self.executor, self.depth)
+        out, cache = self.transformer(seq, cache=cache)
+        # pending logits for per-row position k live at global position
+        # text_len - 1 + k (the output of feeding token k-1; k = 0 reads
+        # the last text position, exactly decode_prefill's slot-0 logits)
+        sel = jax.vmap(
+            lambda o, p: jax.lax.dynamic_slice_in_dim(o, p, 1, axis=0)
+        )(out, text_len - 1 + image_pos)  # [B, 1, dim]
+        row = self.to_logits(sel)[:, 0].astype(jnp.float32)
+        return row, cache
+
 
 def init_decode_cache(model: DALLE, batch: int, dtype=None) -> dict:
     """Fixed-shape decode cache for `generate_images_cached`.
@@ -958,6 +1007,100 @@ def _prefill_slots_builder(model, key):
 _prefill_slots_builder._donate_argnums = (1,)  # state
 
 
+def resume_into_slots(
+    model: DALLE,
+    variables,
+    state: dict,
+    texts: jnp.ndarray,
+    img_tokens: jnp.ndarray,
+    img_pos,
+    slots,
+    seeds,
+    temperatures,
+    keep_ks,
+):
+    """Admit up to R MID-DECODE rows into their cache slots in ONE
+    donated dispatch (decode-state migration, serving/migrate.py).
+
+    Like `prefill_into_slots`, but each row arrives with a generated
+    image prefix: `img_tokens` [R, image_seq_len] (zeros beyond the
+    prefix) and `img_pos` [R] resume positions. `DALLE.decode_resume`
+    re-prefills prompt + prefix in one teacher-forced forward — K/V,
+    shift rings (per-row window), pending logits and position all land
+    exactly where the incremental decode would have left them, so the
+    next chunk dispatch continues from position k instead of 0. Padding,
+    donation and scatter semantics match `prefill_into_slots`.
+    """
+    texts = jnp.asarray(texts, jnp.int32)
+    prefill_batch = int(texts.shape[0])
+    return _jit_sample(
+        _resume_slots_builder, model, (prefill_batch,),
+        variables, state, texts,
+        jnp.asarray(img_tokens, jnp.int32), jnp.asarray(img_pos, jnp.int32),
+        jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
+        jnp.asarray(temperatures, jnp.float32), jnp.asarray(keep_ks, jnp.int32),
+    )
+
+
+def _resume_slots_builder(model, key):
+    (prefill_batch,) = key
+    batch_axis = 1 if model.executor == "scan" else 0
+
+    def fn(variables, state, texts, img_tokens, img_pos, slots, seeds,
+           temperatures, keep_ks):
+        rows, cache_r = model.apply(
+            variables,
+            texts,
+            img_tokens,
+            img_pos,
+            init_decode_cache(model, prefill_batch),
+            method=DALLE.decode_resume,
+        )
+
+        def write(path, s_leaf, p_leaf):
+            # `index` leaves are not scattered: the chunk step stamps
+            # every layer's index from the per-slot `img_pos`
+            if getattr(path[-1], "key", None) == "index":
+                return s_leaf
+            out = s_leaf
+            for r in range(prefill_batch):
+                p_row = jax.lax.dynamic_slice_in_dim(
+                    p_leaf, r, 1, axis=batch_axis
+                )
+                out = jax.lax.dynamic_update_slice_in_dim(
+                    out, p_row.astype(out.dtype), slots[r], axis=batch_axis
+                )
+            return out
+
+        new_cache = jax.tree_util.tree_map_with_path(
+            write, state["cache"], cache_r
+        )
+        out = dict(state)
+        out["cache"] = new_cache
+        row_buf = state["row"]
+        tok_buf = state["img_tokens"]
+        for r in range(prefill_batch):
+            row_buf = jax.lax.dynamic_update_slice(
+                row_buf, rows[r : r + 1].astype(row_buf.dtype), (slots[r], 0)
+            )
+            tok_buf = jax.lax.dynamic_update_slice(
+                tok_buf, img_tokens[r : r + 1], (slots[r], 0)
+            )
+        out["row"] = row_buf
+        out["img_tokens"] = tok_buf
+        out["img_pos"] = state["img_pos"].at[slots].set(img_pos)
+        out["active"] = state["active"].at[slots].set(True)
+        out["seeds"] = state["seeds"].at[slots].set(seeds)
+        out["temps"] = state["temps"].at[slots].set(temperatures)
+        out["keep_k"] = state["keep_k"].at[slots].set(keep_ks)
+        return out
+
+    return fn
+
+
+_resume_slots_builder._donate_argnums = (1,)  # state
+
+
 def release_slots(model: DALLE, state: dict, mask) -> dict:
     """Deactivate the slots where `mask` is True (jitted, fixed shape;
     `state` is donated — replace your reference with the return value)."""
@@ -1122,6 +1265,17 @@ def _with_page_table(cache, page_table, executor, depth):
         name: {**layer, "attn": {**layer["attn"], "page_table": pt}}
         for name, layer in cache.items()
     }
+
+
+def _with_ring_end(cache, ring_end, executor, depth):
+    """Inject the per-row resume window `ring_end` [B] into a decode
+    cache so `shift_with_ring` rebuilds rings per row (decode_resume).
+    Same smuggling idiom as `_with_page_table`; the transformer's output
+    cache is rebuilt without the leaf, so nothing strips it."""
+    re_ = jnp.asarray(ring_end, jnp.int32)
+    if executor == "scan":
+        return {**cache, "ring_end": jnp.broadcast_to(re_, (depth,) + re_.shape)}
+    return {name: {**layer, "ring_end": re_} for name, layer in cache.items()}
 
 
 def _without_page_table(cache, executor):
@@ -1348,6 +1502,145 @@ def _prefill_slots_paged_builder(model, key):
 
 
 _prefill_slots_paged_builder._donate_argnums = (1,)  # state
+
+
+def resume_into_slots_paged(
+    model: DALLE,
+    variables,
+    state: dict,
+    texts: jnp.ndarray,
+    img_tokens: jnp.ndarray,
+    img_pos,
+    slots,
+    seeds,
+    temperatures,
+    keep_ks,
+    page_rows,
+    page_size: int,
+):
+    """Paged-layout mid-decode admission: the same teacher-forced
+    re-prefill as `resume_into_slots`, scattered into PAGES.
+
+    `page_rows` is [R, pages_per_row]: the physical page for each of row
+    r's blocks — real pages up to the block covering the row's resume
+    position, the garbage page beyond (the fixed-shape scatter writes
+    every block; writes past the prefix land in the garbage page exactly
+    like released rows' stale writes, and `ensure` maps real pages ahead
+    of decode as usual). Resume rows never share prefix-cache pages: the
+    dispatch rewrites every mapped page, and a row's own mid-decode K/V
+    must not overwrite content other rows map (the host allocates fresh
+    pages — `PagedKVManager.admit_resume`).
+    """
+    texts = jnp.asarray(texts, jnp.int32)
+    prefill_batch = int(texts.shape[0])
+    page_rows = jnp.asarray(page_rows, jnp.int32)
+    n_pages_row = int(page_rows.shape[1])
+    return _jit_sample(
+        _resume_slots_paged_builder, model,
+        (prefill_batch, int(page_size), n_pages_row),
+        variables, state, texts,
+        jnp.asarray(img_tokens, jnp.int32), jnp.asarray(img_pos, jnp.int32),
+        jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
+        jnp.asarray(temperatures, jnp.float32), jnp.asarray(keep_ks, jnp.int32),
+        page_rows,
+    )
+
+
+def _resume_slots_paged_builder(model, key):
+    prefill_batch, page_size, n_pages_row = key
+    batch_axis = 1 if model.executor == "scan" else 0
+
+    def block_of(p_leaf, r, j):
+        """Row r's K/V slice for block j, zero-padded to page_size past
+        the resume cache's end (static shapes throughout)."""
+        if batch_axis == 1:
+            row_kv = p_leaf[:, r]
+            seq_ax = 2
+        else:
+            row_kv = p_leaf[r]
+            seq_ax = 1
+        max_len = row_kv.shape[seq_ax]
+        lo = j * page_size
+        hi = min(lo + page_size, max_len)
+        if hi <= lo:
+            shape = list(row_kv.shape)
+            shape[seq_ax] = page_size
+            return jnp.zeros(shape, row_kv.dtype)
+        blk = jax.lax.slice_in_dim(row_kv, lo, hi, axis=seq_ax)
+        if hi - lo < page_size:
+            pad = [(0, 0)] * row_kv.ndim
+            pad[seq_ax] = (0, page_size - (hi - lo))
+            blk = jnp.pad(blk, pad)
+        return blk
+
+    def fn(variables, state, texts, img_tokens, img_pos, slots, seeds,
+           temperatures, keep_ks, page_rows):
+        rows, cache_r = model.apply(
+            variables,
+            texts,
+            img_tokens,
+            img_pos,
+            init_decode_cache(model, prefill_batch),
+            method=DALLE.decode_resume,
+        )
+
+        def write(path, s_leaf, p_leaf):
+            key_ = getattr(path[-1], "key", None)
+            if key_ == "index":
+                return s_leaf
+            if key_ in ("k", "v"):
+                out = s_leaf
+                for r in range(prefill_batch):
+                    for j in range(n_pages_row):
+                        blk = block_of(p_leaf, r, j).astype(out.dtype)
+                        if batch_axis == 1:
+                            out = jax.lax.dynamic_update_slice(
+                                out, blk[:, None],
+                                (0, page_rows[r, j], 0, 0, 0),
+                            )
+                        else:
+                            out = jax.lax.dynamic_update_slice(
+                                out, blk[None], (page_rows[r, j], 0, 0, 0)
+                            )
+                return out
+            # shift rings: per-slot row scatter, same as the slotted path
+            out = s_leaf
+            for r in range(prefill_batch):
+                p_row = jax.lax.dynamic_slice_in_dim(
+                    p_leaf, r, 1, axis=batch_axis
+                )
+                out = jax.lax.dynamic_update_slice_in_dim(
+                    out, p_row.astype(out.dtype), slots[r], axis=batch_axis
+                )
+            return out
+
+        new_cache = jax.tree_util.tree_map_with_path(
+            write, state["cache"], cache_r
+        )
+        out = dict(state)
+        out["cache"] = new_cache
+        row_buf = state["row"]
+        tok_buf = state["img_tokens"]
+        for r in range(prefill_batch):
+            row_buf = jax.lax.dynamic_update_slice(
+                row_buf, rows[r : r + 1].astype(row_buf.dtype), (slots[r], 0)
+            )
+            tok_buf = jax.lax.dynamic_update_slice(
+                tok_buf, img_tokens[r : r + 1], (slots[r], 0)
+            )
+        out["row"] = row_buf
+        out["img_tokens"] = tok_buf
+        out["img_pos"] = state["img_pos"].at[slots].set(img_pos)
+        out["active"] = state["active"].at[slots].set(True)
+        out["seeds"] = state["seeds"].at[slots].set(seeds)
+        out["temps"] = state["temps"].at[slots].set(temperatures)
+        out["keep_k"] = state["keep_k"].at[slots].set(keep_ks)
+        return out
+
+    return fn
+
+
+_resume_slots_paged_builder._donate_argnums = (1,)  # state
 
 
 def slice_prefix_sidecar(model: DALLE, sidecar: dict, r: int):

@@ -69,6 +69,7 @@ from dalle_pytorch_tpu.ops.rotary import build_dalle_rotary
 from dalle_pytorch_tpu.ops.shift import (
     shift_tokens_dalle,
     shift_ring_from_prefill,
+    shift_ring_from_prefill_at,
     shift_token_step,
 )
 
@@ -154,20 +155,24 @@ def _build_static_mask(
     raise ValueError(f'attention type "{attn_type}" is not valid')
 
 
-def shift_with_ring(h, ring, pos, text_len, fmap):
+def shift_with_ring(h, ring, pos, text_len, fmap, ring_end=None):
     """Token-shift dispatch shared by both executors' cached paths.
 
     ring None: pure batch shift (uncached). Prefill (n > 1, necessarily
-    from position 0): batch shift + build the ring from trailing tokens.
+    from position 0): batch shift + build the ring from trailing tokens
+    — or, when `ring_end` ([B] per-row positions) is set, from each
+    row's OWN trailing window below ring_end (the decode-resume path:
+    one teacher-forced forward restores per-row mid-decode ring state).
     Single-token decode: streaming shift at traced position `pos`.
     Returns (shifted h, new ring or None).
     """
     if ring is None:
         return shift_tokens_dalle(h, text_len, fmap), None
     if h.shape[1] > 1:
-        return shift_tokens_dalle(h, text_len, fmap), shift_ring_from_prefill(
-            h, fmap
-        )
+        shifted = shift_tokens_dalle(h, text_len, fmap)
+        if ring_end is not None:
+            return shifted, shift_ring_from_prefill_at(h, fmap, ring_end)
+        return shifted, shift_ring_from_prefill(h, fmap)
     return shift_token_step(h, ring, pos, text_len, fmap)
 
 
@@ -210,12 +215,16 @@ class _ScanBlock(nn.Module):
         )
         cached = cache is not None
         pos = cache["attn"]["index"] if cached else None
+        # per-row resume window (decode_resume injects it; absent on the
+        # ordinary prefill/decode paths and dropped from the new cache)
+        ring_end = cache.get("ring_end") if cached else None
 
         def shift(h, ring):
             if not self.shift_tokens:
                 return h, None
             return shift_with_ring(
-                h, ring, pos, self.text_len, self.image_fmap_size
+                h, ring, pos, self.text_len, self.image_fmap_size,
+                ring_end=ring_end,
             )
 
         h = nn.LayerNorm(dtype=self.dtype, name="norm_attn")(x)
@@ -577,11 +586,14 @@ class Transformer(nn.Module):
             dtype=self.dtype,
         )
 
-    def _shift(self, h: jnp.ndarray, ring, pos):
+    def _shift(self, h: jnp.ndarray, ring, pos, ring_end=None):
         """Token-shift h; in cached mode also maintain the ring buffer
         (see `shift_with_ring` — shared with the scan executor)."""
         assert self.image_fmap_size is not None
-        return shift_with_ring(h, ring, pos, self.text_len, self.image_fmap_size)
+        return shift_with_ring(
+            h, ring, pos, self.text_len, self.image_fmap_size,
+            ring_end=ring_end,
+        )
 
     def _half_attn(self, i, x, key_mask, layer_cache, deterministic=True):
         """Attention half-block f (norm → shift → attn → [sandwich] → scale),
@@ -594,7 +606,8 @@ class Transformer(nn.Module):
         ring = None
         if self.shift_tokens:
             h, ring = self._shift(
-                h, layer_cache.get("shift_attn") if cached else None, pos
+                h, layer_cache.get("shift_attn") if cached else None, pos,
+                ring_end=layer_cache.get("ring_end") if cached else None,
             )
         h, attn_cache = self.attn_layers[i](
             h,
@@ -616,7 +629,8 @@ class Transformer(nn.Module):
         ring = None
         if self.shift_tokens:
             h, ring = self._shift(
-                h, layer_cache.get("shift_ff") if cached else None, pos
+                h, layer_cache.get("shift_ff") if cached else None, pos,
+                ring_end=layer_cache.get("ring_end") if cached else None,
             )
         h = self.ff_layers[i](h, deterministic=deterministic)
         if self.sandwich_norm:

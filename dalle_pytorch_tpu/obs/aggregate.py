@@ -153,6 +153,7 @@ class TraceExporter:
         backoff_s: float = 0.5,
         backoff_max_s: float = 30.0,
         timeout_s: float = 2.0,
+        thread: bool = True,
     ):
         self.url = str(url).rstrip("/")
         self.site = sanitize_site(site) if site else default_site()
@@ -166,6 +167,12 @@ class TraceExporter:
         self.backoff_base_s = float(backoff_s)
         self.backoff_max_s = float(backoff_max_s)
         self.timeout_s = float(timeout_s)
+        #: thread=False skips the shipper thread entirely — intake and
+        #: overflow accounting run unchanged on export(), and callers
+        #: drive delivery synchronously via `flush()`/`_flush_once()`.
+        #: The deterministic mode tests use so their timing budgets
+        #: never ride on thread-scheduling under CPU contention.
+        self._thread_enabled = bool(thread)
         self.enabled = True
         self._buf: deque = deque()
         self._lock = threading.Lock()
@@ -225,7 +232,7 @@ class TraceExporter:
         """Hook a tracer's finish path and start the shipper thread."""
         self._tracer = tracer
         tracer.exporter = self
-        if self._thread is None:
+        if self._thread is None and self._thread_enabled:
             self._stop.clear()
             self._thread = threading.Thread(
                 target=self._run, name="dalle-trace-export", daemon=True

@@ -75,6 +75,33 @@ def shift_ring_from_prefill(h: jnp.ndarray, fmap: int) -> jnp.ndarray:
     return ring.at[:, slots].set(h[:, start:])
 
 
+def shift_ring_from_prefill_at(
+    h: jnp.ndarray, fmap: int, end: jnp.ndarray
+) -> jnp.ndarray:
+    """Ring buffer as if only positions 0..end[b]-1 had been prefilled.
+
+    The decode-resume path (models/dalle.py `decode_resume`) runs ONE
+    teacher-forced forward over the whole prompt + generated-image
+    prefix, but each row resumes at its OWN position `end[b]` — the ring
+    must hold the pre-shift values of the last `fmap` positions BELOW
+    that end, exactly as the incremental decode would have left it, not
+    the trailing window of the full padded sequence. For every slot j,
+    that is h at the largest position p < end with p ≡ j (mod fmap);
+    positions below 0 (end < fmap) stay zero, matching
+    `shift_ring_from_prefill`'s unwritten-slot semantics — and with
+    end == n this IS `shift_ring_from_prefill`, value for value.
+    """
+    b, n, d = h.shape
+    end = jnp.asarray(end, jnp.int32)  # [B] global resume positions
+    slots = jnp.arange(fmap, dtype=jnp.int32)[None, :]  # [1, fmap]
+    last = end[:, None] - 1  # [B, 1] last prefilled position per row
+    p = last - jnp.mod(last - slots, fmap)  # [B, fmap], p ≡ slot (mod fmap)
+    vals = jax.vmap(
+        lambda row, idx: row[jnp.clip(idx, 0, n - 1)]
+    )(h, p)  # [B, fmap, d]
+    return jnp.where((p >= 0)[..., None], vals, jnp.zeros_like(vals))
+
+
 def shift_token_step(
     h: jnp.ndarray, ring: jnp.ndarray, pos: jnp.ndarray, text_len: int, fmap: int
 ):

@@ -28,6 +28,13 @@ queue feeding fixed-shape compiled sampler programs.
     `WeightedFairQueue` stride scheduler with per-tenant accounting,
     tenant quotas (`TenantQuotaError` → 429) and deadline-aware
     admission shedding (`ShedError` → 503 + Retry-After).
+  * `migrate.py`  — decode-state checkpoints: `RowCheckpoint` /
+    `RequestCheckpoint` + the fingerprint-stamped codec, the
+    `CheckpointSpool` crash-beacon journal, and `MigratedError` (the
+    chunk-boundary export of `drain?migrate=1`). A drained or crashed
+    replica's in-flight requests MOVE — completed rows restore verbatim
+    on the resuming replica, unfinished rows restart bit-identically —
+    instead of being waited out or re-decoded from scratch.
   * `faults.py`   — `FaultInjector`: deterministic fail-Nth / stall-Nth
     / crash-Nth seam on engine dispatches plus compile-cache artifact
     corruption, for recovery-invariant tests and chaos drills (attach
@@ -86,6 +93,18 @@ from dalle_pytorch_tpu.serving.batcher import (
     ShuttingDownError,
 )
 from dalle_pytorch_tpu.serving.faults import FaultInjector, InjectedFault
+from dalle_pytorch_tpu.serving.migrate import (
+    CheckpointCorrupt,
+    CheckpointMismatch,
+    CheckpointSpool,
+    MigratedError,
+    RequestCheckpoint,
+    RowCheckpoint,
+    decode_checkpoint,
+    encode_checkpoint,
+    from_wire,
+    to_wire,
+)
 from dalle_pytorch_tpu.serving.qos import (
     PRIORITY_CLASSES,
     ShedError,
@@ -103,9 +122,19 @@ from dalle_pytorch_tpu.serving.server import ServingServer
 from dalle_pytorch_tpu.serving.supervisor import ReplicaSupervisor
 
 __all__ = [
+    "CheckpointCorrupt",
+    "CheckpointMismatch",
+    "CheckpointSpool",
     "ContinuousBatcher",
     "ContinuousEngine",
     "FaultInjector",
+    "MigratedError",
+    "RequestCheckpoint",
+    "RowCheckpoint",
+    "decode_checkpoint",
+    "encode_checkpoint",
+    "from_wire",
+    "to_wire",
     "GenerationEngine",
     "InjectedFault",
     "PRIORITY_CLASSES",

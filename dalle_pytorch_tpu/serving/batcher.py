@@ -168,6 +168,18 @@ class GenRequest:
         self.admitted_seq: Optional[int] = None
         self._preempt_span = NULL_SPAN
         self._suspend_reason: Optional[str] = None
+        # ------------------------- migration identity (serving/migrate.py)
+        #: router content key (x-dalle-request-key) — the join key for
+        #: crash-spool recovery and fleet log attribution
+        self.request_key: Optional[str] = None
+        #: True when this request arrived with a decode-state resume
+        #: checkpoint (the exporting replica's site, when known, rides
+        #: `migrated_from`)
+        self.migrated = False
+        self.migrated_from: Optional[str] = None
+        self.resumed_at_chunk: Optional[int] = None
+        self.checkpoint_bytes: Optional[int] = None
+        self._migrate_counted = False
         # request-scoped trace (obs/tracing.py), minted at HTTP ingress and
         # carried through the worker so stage spans land on one tree; the
         # default NULL_TRACE makes every span call a no-op for callers
@@ -202,6 +214,34 @@ class GenRequest:
             (i, s) for i, s in enumerate(self.specs)
             if i not in self.resume_tokens
         ]
+
+    def apply_resume(self, checkpoint, nbytes: Optional[int] = None) -> None:
+        """Install a decode-state checkpoint (serving/migrate.py
+        `RequestCheckpoint`) as this request's resume state: completed
+        rows restore verbatim into `resume_tokens` (never re-decoded),
+        partial rows' snapshots land in `preempt_snapshots` (the
+        bit-identity oracle — the row itself restarts at position 0,
+        which regenerates the same tokens via the (seed, position)-keyed
+        RNG). Caller has already validated the checkpoint against this
+        request's specs/fingerprint."""
+        for row in checkpoint.rows:
+            i = int(row.row_index)
+            if not 0 <= i < len(self.specs):
+                continue
+            toks = np.asarray(row.tokens, np.int32)
+            if row.done:
+                self.resume_tokens[i] = toks
+            elif len(toks):
+                self.preempt_snapshots[i] = toks
+                # engines with resume support continue THIS row from its
+                # checkpointed position (one teacher-forced re-prefill);
+                # others ignore the fields and restart at 0
+                self.specs[i].resume_tokens = toks
+                self.specs[i].resume_pos = len(toks)
+        self.migrated = True
+        self.migrated_from = checkpoint.site
+        self.resumed_at_chunk = int(checkpoint.chunk_index)
+        self.checkpoint_bytes = nbytes
 
     def cancel(self) -> None:
         """Best-effort: a request already handed to the engine completes."""
@@ -405,6 +445,9 @@ class MicroBatcher:
         trace=NULL_TRACE,
         priority: str = "normal",
         tenant: str = "",
+        request_key: Optional[str] = None,
+        resume=None,
+        resume_bytes: Optional[int] = None,
     ) -> GenRequest:
         """Enqueue one request; returns it (result via `req.future.result()`).
 
@@ -414,34 +457,44 @@ class MicroBatcher:
         of blocking the caller. `trace` (a `Trace` from `obs/tracing.py`)
         rides on the request; the worker records stage spans onto it.
         `priority` ("high"/"normal"/"low") and `tenant` feed the
-        weighted-fair scheduler.
+        weighted-fair scheduler. `resume` (a validated
+        `migrate.RequestCheckpoint`) installs a migrated request's
+        decode-state resume — it enters like a preempt-resume, at the
+        FRONT of its own (class, tenant) queue, and every admission
+        bound below charges only its PENDING rows (rows the checkpoint
+        already completed occupy nothing).
         """
         req = GenRequest(
             specs, timeout_s=timeout_s, trace=trace,
             priority=priority, tenant=tenant,
         )
+        req.request_key = request_key
+        if resume is not None:
+            req.apply_resume(resume, nbytes=resume_bytes)
         with self._cond:
             if self._closed:
                 raise ShuttingDownError("batcher is shutting down")
             cap = self._admission_cap(req)
-            if req.rows > cap:
+            if req.pending_rows > cap:
                 # permanent: this request could NEVER admit (its class's
                 # usable slots are max_batch minus any high-class
                 # reserve), and all-or-nothing admission means queueing
                 # it would head-of-line-block its class forever
                 self._m_rejected.inc()
                 raise QueueFullError(
-                    f"request of {req.rows} rows exceeds max batch "
+                    f"request of {req.pending_rows} rows exceeds max batch "
                     f"{cap} admissible at priority {req.priority!r}"
                 )
             can_ever = getattr(self.engine, "can_ever_admit", None)
-            if can_ever is not None and not can_ever(req.specs):
+            if can_ever is not None and not can_ever(
+                [s for _, s in req.pending_row_specs()]
+            ):
                 # paged engine: the request's worst case exceeds the WHOLE
                 # block pool — it would queue forever, so reject now
                 self._m_rejected.inc()
                 raise QueueFullError(
-                    f"request of {req.rows} rows exceeds the engine's KV "
-                    "block pool capacity"
+                    f"request of {req.pending_rows} rows exceeds the "
+                    "engine's KV block pool capacity"
                 )
             # class-horizon queue bound: a request competes only against
             # rows its class must wait behind (its own class and better),
@@ -449,7 +502,7 @@ class MicroBatcher:
             # still see room — overload rejections land on the class
             # causing them
             ahead = self._queue.rows_at_or_better(req.klass)
-            if ahead + req.rows > self.max_queue_rows:
+            if ahead + req.pending_rows > self.max_queue_rows:
                 self._m_rejected.inc()
                 exc = QueueFullError(
                     f"queue full ({ahead}/{self.max_queue_rows} rows at "
@@ -458,7 +511,7 @@ class MicroBatcher:
                 exc.retry_after_s = self.retry_after_s()
                 raise exc
             if self.tenant_quota_rows is not None and (
-                self._queue.tenant_rows(req.tenant) + req.rows
+                self._queue.tenant_rows(req.tenant) + req.pending_rows
                 > self.tenant_quota_rows
             ):
                 self._m_shed.labels("quota").inc()
@@ -472,7 +525,13 @@ class MicroBatcher:
             if shed is not None:
                 self._m_shed.labels(shed.reason).inc()
                 raise shed
-            self._queue.push(req)
+            if resume is not None:
+                # migrated resume enters like a preempt-resume: next in
+                # line WITHIN its own (class, tenant) queue — it already
+                # waited (and decoded) once on the exporting replica
+                self._queue.push_front(req)
+            else:
+                self._queue.push(req)
             self._m_requests.inc()
             self._set_depth_gauges()
             self._cond.notify_all()
@@ -830,6 +889,8 @@ class ContinuousBatcher(MicroBatcher):
         preempt: bool = True,
         deadline_shed: bool = True,
         reserve_slots: int = 0,
+        spool=None,
+        spool_every: int = 8,
     ):
         """`engine` needs the slot surface of `ContinuousEngine`
         (`prefill_slot` / `step_chunk` / `harvest` / `release` /
@@ -843,10 +904,15 @@ class ContinuousBatcher(MicroBatcher):
         boundary without waiting for a preemption cycle — the latency/
         utilization trade (reserved slots idle when no high traffic;
         default 0 = fully work-conserving, preemption alone reclaims
-        capacity)."""
+        capacity). `spool` (a `migrate.CheckpointSpool`) arms the crash
+        progress beacon: every `spool_every` chunks the worker journals
+        in-flight decode-state checkpoints to it at the chunk boundary,
+        so a hard kill loses at most that many chunks of bookkeeping."""
         self.preempt = bool(preempt)
         self.deadline_shed = bool(deadline_shed)
         self.reserve_slots = int(reserve_slots)
+        self.spool = spool
+        self.spool_every = max(1, int(spool_every))
         assert 0 <= self.reserve_slots < int(
             engine.max_batch if hasattr(engine, "max_batch") else 1 << 30
         ), "reserve_slots must leave at least one slot for other classes"
@@ -912,6 +978,38 @@ class ContinuousBatcher(MicroBatcher):
         #: least-progress (cheapest redo). None = burn-blind, exactly
         #: the pre-wiring behavior. ServingServer wires vitals.slo in.
         self.slo_burn = None
+        # ------------------------------- migration (serving/migrate.py)
+        #: build identity stamped into exported checkpoints; the server
+        #: sets the engine's real boot fingerprint after construction
+        self.checkpoint_fingerprint = "unfingerprinted"
+        #: exporting replica identity for `migrated_from` attribution
+        self.checkpoint_site: Optional[str] = None
+        #: pending drain?migrate=1 export ({"event", "out"}) the worker
+        #: serves at the next chunk boundary
+        self._migrate_request: Optional[dict] = None
+        #: most recent beacon bundle ({"ts", "chunk_index",
+        #: "checkpoints": {key: wire}}) — GET /admin/checkpoints reads it
+        self.last_beacon: Optional[dict] = None
+        #: per-slot decode position at the last boundary — drives the
+        #: decoded-token counter and the migration snapshot clip
+        self._slot_pos: dict = {}
+        self._last_img_pos = None
+        self._m_resumed_tokens = self.registry.counter(
+            f"{p}_resumed_tokens_total",
+            "image tokens restored verbatim from migrated decode-state "
+            "checkpoints (work NOT re-decoded after a drain/crash)",
+        )
+        self._m_decoded_tokens = self.registry.counter(
+            f"{p}_decoded_tokens_total",
+            "image tokens decoded by chunk dispatches (re-decoded work "
+            "after a failover counts again; the drain bench reads the "
+            "difference)",
+        )
+        self._m_migrated = self.registry.counter(
+            f"{p}_migrated_out_total",
+            "requests exported as decode-state checkpoints at a chunk "
+            "boundary by drain?migrate=1",
+        )
 
     def state_summary(self) -> dict:
         """Queue summary plus the slot → in-flight request table. The
@@ -951,11 +1049,24 @@ class ContinuousBatcher(MicroBatcher):
         inflight = self._inflight  # slot -> (request, row index)
         partial = self._partial  # request -> {"tokens": [rows], "remaining"}
         while True:
+            if self._migrate_request is not None:
+                # the previous iteration's chunk dispatch has returned,
+                # so this IS a chunk boundary — the only place decode
+                # state may leave the device (TL012 contract)
+                self._serve_migration(inflight, partial)
+                continue
             admitted: List = []  # (slot, spec) prefills owed this iteration
+            restored: List = []  # fully-checkpoint-restored requests
+            migrate_pending = False
             with self._cond:
                 while True:
                     head = self._viable_head(time.monotonic())
                     self._set_depth_gauges()
+                    if self._migrate_request is not None:
+                        # wake for an export requested while parked (or
+                        # between boundaries): serve it at the loop top
+                        migrate_pending = True
+                        break
                     if head is not None or inflight:
                         break
                     if self._closed:
@@ -963,6 +1074,8 @@ class ContinuousBatcher(MicroBatcher):
                     # idle: no queued work, no live slots — park until
                     # submit/shutdown notifies (no busy-poll)
                     self._cond.wait()
+                if migrate_pending:
+                    head = None
                 # all-or-nothing admission in weighted-fair scheduler
                 # order (no starvation: the stride scheduler bounds every
                 # class's wait, and a wide request blocks later narrow
@@ -1008,6 +1121,35 @@ class ContinuousBatcher(MicroBatcher):
                     ):
                         break
                     self._pop_head(head)
+                    if head.migrated and not head._migrate_counted:
+                        # first admission of a migrated resume: count the
+                        # resumption and the checkpoint-restored tokens
+                        # (work this replica does NOT re-decode): whole
+                        # done rows, plus mid-decode prefixes when the
+                        # engine resumes rows at their own position
+                        head._migrate_counted = True
+                        self._m_resume.labels("migrate").inc()
+                        saved = sum(
+                            len(t) for t in head.resume_tokens.values()
+                        )
+                        if getattr(self.engine, "supports_resume", False):
+                            saved += sum(
+                                int(getattr(s, "resume_pos", 0) or 0)
+                                for s in head.specs
+                            )
+                        self._m_resumed_tokens.inc(int(saved))
+                    if not pend:
+                        # every row restored verbatim from the
+                        # checkpoint: nothing to decode — complete after
+                        # the lock with one pixel-decode dispatch
+                        head.trace.end(head._queue_span)
+                        self.stage_seconds.labels("queue").observe(
+                            time.monotonic() - head.enqueued_at,
+                            exemplar=head.trace.trace_id or None,
+                        )
+                        restored.append(head)
+                        head = self._viable_head(time.monotonic())
+                        continue
                     wave_specs.extend(s for _, s in pend)
                     # rows harvested before a suspension resume as done
                     partial[head] = {
@@ -1020,6 +1162,15 @@ class ContinuousBatcher(MicroBatcher):
                     for i, spec in pend:
                         slot = self.allocator.alloc()
                         inflight[slot] = (head, i)
+                        # decoded-token accounting starts at the row's
+                        # RESUME position when the engine restores the
+                        # prefix (those tokens are restored, not decoded)
+                        self._slot_pos[slot] = (
+                            int(getattr(spec, "resume_pos", 0) or 0)
+                            if getattr(
+                                self.engine, "supports_resume", False
+                            ) else 0
+                        )
                         admitted.append((slot, spec))
                     head.admitted_seq = self._admit_seq
                     self._admit_seq += 1
@@ -1042,6 +1193,13 @@ class ContinuousBatcher(MicroBatcher):
                     head = self._viable_head(time.monotonic())
                 self._set_depth_gauges()
 
+            if migrate_pending:
+                continue  # export at the loop top, then resume admitting
+            if restored:
+                self._complete_restored(restored)
+            if not admitted and not inflight:
+                continue  # only fully-restored work: no chunk to dispatch
+
             # which engine dispatch is in flight, so a failure still
             # observes the stage's wall time into stage_seconds — /metrics
             # and the (abandoned) trace spans must agree on error paths too
@@ -1063,11 +1221,27 @@ class ContinuousBatcher(MicroBatcher):
                     hit_slots: set = set()
                     blocks_reused = suffix_tokens = 0
                     have_stats = False
+                    resumed_rows = 0
                     prefill_slots = getattr(self.engine, "prefill_slots", None)
                     if prefill_slots is not None:
                         pb = max(
                             1, int(getattr(self.engine, "prefill_batch", 1))
                         )
+                        # mid-decode resume rows (migration / preemption
+                        # on a resume-capable engine) dispatch through
+                        # the teacher-forced resume program; everything
+                        # else takes the ordinary prefill path
+                        if getattr(self.engine, "supports_resume", False):
+                            resume_wave = [
+                                (s, sp) for s, sp in admitted
+                                if getattr(sp, "resume_pos", 0)
+                            ]
+                            fresh_wave = [
+                                (s, sp) for s, sp in admitted
+                                if not getattr(sp, "resume_pos", 0)
+                            ]
+                        else:
+                            resume_wave, fresh_wave = [], admitted
                         # The wave was budgeted against ONE headroom
                         # snapshot but dispatches in prefill_batch splits;
                         # pin its prefix-cache hit entries across ALL
@@ -1078,13 +1252,13 @@ class ContinuousBatcher(MicroBatcher):
                             self.engine, "protect_admission_wave", None
                         )
                         wave_keys = (
-                            wave_guard(admitted)
-                            if wave_guard is not None
+                            wave_guard(fresh_wave)
+                            if wave_guard is not None and fresh_wave
                             else None
                         )
                         try:
-                            for i in range(0, len(admitted), pb):
-                                prefill_slots(admitted[i : i + pb])
+                            for i in range(0, len(fresh_wave), pb):
+                                prefill_slots(fresh_wave[i : i + pb])
                                 st = getattr(
                                     self.engine, "last_admission_stats", None
                                 )
@@ -1105,6 +1279,10 @@ class ContinuousBatcher(MicroBatcher):
                                 self.engine.unprotect_admission_wave(
                                     wave_keys
                                 )
+                        for i in range(0, len(resume_wave), pb):
+                            self.engine.resume_slots(resume_wave[i : i + pb])
+                            dispatches += 1
+                            resumed_rows += len(resume_wave[i : i + pb])
                     else:
                         for slot, spec in admitted:
                             self.engine.prefill_slot(slot, spec)
@@ -1129,6 +1307,8 @@ class ContinuousBatcher(MicroBatcher):
                                 suffix_tokens_computed=suffix_tokens,
                                 prefix_hit=req.prefix_hit,
                             )
+                        if resumed_rows:
+                            extra["resumed_rows"] = resumed_rows
                         req.trace.end(
                             req._stage_span,
                             wave_rows=len(admitted),
@@ -1196,8 +1376,18 @@ class ContinuousBatcher(MicroBatcher):
                     if req.first_token_at is None and img_pos[slot] > 0:
                         req.first_token_at = now
                         self._m_ttft.observe(now - req.enqueued_at)
+                    # decoded-token accounting: per-slot position deltas
+                    # over THIS chunk (re-decoded work after a failover
+                    # counts again — the drain bench reads the total)
+                    cur = int(img_pos[slot])
+                    if cur > self._slot_pos.get(slot, 0):
+                        self._m_decoded_tokens.inc(
+                            cur - self._slot_pos.get(slot, 0)
+                        )
+                        self._slot_pos[slot] = cur
                     if img_pos[slot] >= self.engine.image_seq_len:
                         finished.append(slot)
+                self._last_img_pos = img_pos
                 if finished:
                     # harvest/release are engine dispatches too — a failure
                     # here must fail fast like the chunk path, not kill the
@@ -1209,6 +1399,14 @@ class ContinuousBatcher(MicroBatcher):
                 # then reclaim a slot for a blocked higher-class head
                 self._reap(inflight, partial)
                 self._maybe_preempt(inflight, partial, img_pos)
+                if (
+                    self.spool is not None
+                    and self._chunks_dispatched % self.spool_every == 0
+                ):
+                    # crash progress beacon, cadence-guarded (TL012): the
+                    # snapshot transfer runs at most once per spool_every
+                    # chunk boundaries, never mid-chunk
+                    self._maybe_beacon(inflight)
             except Exception as exc:
                 if stage_name is not None:
                     self.stage_seconds.labels(stage_name).observe(
@@ -1426,11 +1624,19 @@ class ContinuousBatcher(MicroBatcher):
         snap_fn = getattr(self.engine, "snapshot_rows", self.engine.harvest)
         slots = list(slot_rows)
         toks = snap_fn(slots)
+        resumable = getattr(self.engine, "supports_resume", False)
         for slot, row_toks in zip(slots, toks):
             pos = int(img_pos[slot]) if img_pos is not None else len(row_toks)
-            victim.preempt_snapshots[slot_rows[slot]] = np.asarray(
-                row_toks[:pos]
-            )
+            prefix = np.asarray(row_toks[:pos])
+            victim.preempt_snapshots[slot_rows[slot]] = prefix
+            if resumable:
+                # the resume-capable engine re-admits this row at its
+                # preempted position instead of position 0 — preemption
+                # then costs one boundary wait + one re-prefill dispatch,
+                # not a whole re-decode (same tokens either way)
+                spec = victim.specs[slot_rows[slot]]
+                spec.resume_tokens = np.asarray(prefix, np.int32)
+                spec.resume_pos = int(pos)
         # the release dispatch may itself fail — let it propagate to the
         # worker's recovery path with the victim still inflight, so the
         # rebuilt-state suspension covers it like everyone else
@@ -1545,6 +1751,296 @@ class ContinuousBatcher(MicroBatcher):
         except Exception:
             pass
         self._set_slots_gauge()
+
+    # ------------------------------------------- migration (chunk boundary)
+
+    def migrate_out(self, timeout_s: float = 30.0):
+        """Export every queued + in-flight request's decode-state
+        checkpoint at the NEXT chunk boundary (`/admin/drain?migrate=1`).
+        Admin-thread entry: the worker does the device reads and fails
+        each exported request's future with `MigratedError` (the HTTP
+        layer maps it to the 409 the fleet router re-dispatches as a
+        resume). Returns the list of `RequestCheckpoint`s, or None when
+        the worker never reached a boundary inside `timeout_s` (wedged
+        engine — nothing was exported)."""
+        return self._request_export(destructive=True, timeout_s=timeout_s)
+
+    def peek_checkpoints(self, timeout_s: float = 30.0):
+        """Non-destructive flavor (`GET /admin/checkpoints` pull-based
+        drain): same chunk-boundary snapshot, but the requests keep
+        decoding here — the caller gets a copy of the state, not the
+        state itself."""
+        return self._request_export(destructive=False, timeout_s=timeout_s)
+
+    def _request_export(self, destructive: bool, timeout_s: float):
+        deadline = time.monotonic() + float(timeout_s)
+        ev = threading.Event()
+        pend = {"event": ev, "out": [], "destructive": bool(destructive)}
+        # exports serialize: a concurrent drain and checkpoint-peek must
+        # not clobber each other's pending request — the later caller
+        # waits out the earlier one's event (bounded by its own timeout)
+        while True:
+            with self._cond:
+                if self._migrate_request is None:
+                    self._migrate_request = pend
+                    self._cond.notify_all()
+                    break
+                other = self._migrate_request["event"]
+            if not other.wait(max(0.0, deadline - time.monotonic())):
+                return None
+        if not ev.wait(max(0.0, deadline - time.monotonic())):
+            # worker wedged mid-chunk: withdraw the request (if it is
+            # still ours — the worker swaps it out under the lock before
+            # serving, so a withdrawn export is NEVER half-served) and
+            # report failure. The event fires either way, so an exporter
+            # serialized behind this one wakes NOW instead of burning
+            # its own full deadline on a freed slot.
+            with self._cond:
+                if self._migrate_request is pend:
+                    self._migrate_request = None
+                    ev.set()
+                    return None
+            # the worker claimed it between the timeout and the lock:
+            # the export IS happening — wait briefly for the result
+            return pend["out"] if ev.wait(5.0) else None
+        return pend["out"]
+
+    def _serve_migration(self, inflight, partial) -> None:
+        """Worker thread, at a chunk boundary. Destructive: pop every
+        queued request, snapshot every in-flight row, fail all their
+        futures with `MigratedError` carrying the checkpoints, release
+        the slots. Non-destructive: build the same checkpoints and touch
+        nothing."""
+        from dalle_pytorch_tpu.serving.migrate import (
+            MigratedError,
+            encode_checkpoint,
+        )
+
+        with self._cond:
+            # CLAIM the request under the lock: a caller that timed out
+            # has withdrawn it (None — this wake is a no-op, never a
+            # destructive export nobody asked for), and once claimed the
+            # caller's withdraw can't race a half-served export
+            pend = self._migrate_request
+            self._migrate_request = None
+        if pend is None:
+            return
+        destructive = pend.get("destructive", True)
+        queued: List[GenRequest] = []
+        if destructive:
+            with self._cond:
+                now = time.monotonic()
+                while True:
+                    head = self._viable_head(now)
+                    if head is None:
+                        break
+                    # uncharged pop: a migrated request consumed no
+                    # capacity here (same rule as cancel/timeout pops)
+                    self._queue.pop(charge=False)
+                    queued.append(head)
+                self._set_depth_gauges()
+        else:
+            with self._cond:
+                queued = [
+                    r for r in self._queue.requests()
+                    if not r.cancelled and not r.expired(time.monotonic())
+                ]
+        live = _unique_requests(req for req, _ in inflight.values())
+        cps = self._collect_checkpoints(live + queued, inflight, "drain")
+        if not destructive:
+            pend["out"] = [cps[r] for r in live + queued]
+            pend["event"].set()
+            return
+        slots = list(inflight)
+        if slots:
+            try:
+                self.engine.release(slots)
+            except Exception:
+                # the donated-state rebuild left a clean engine; the
+                # host-side maps clear below either way
+                pass
+            for slot in slots:
+                inflight.pop(slot)
+                self.allocator.free(slot)
+        now = time.monotonic()
+        for req in live + queued:
+            partial.pop(req, None)
+            self._close_preempt_span(req, outcome="migrated")
+            if req in queued:
+                req.trace.end(req._queue_span, outcome="migrated")
+                self._observe_queue_stage(req, now)
+            self._m_migrated.inc()
+            cp = cps[req]
+            # encode ONCE here; the HTTP layer's 409 body and the admin
+            # bundle both reuse the blob instead of re-serializing the
+            # full token payload per consumer on the drain critical path
+            try:
+                cp.encoded = encode_checkpoint(
+                    cp, self.checkpoint_fingerprint
+                )
+            except Exception:
+                cp.encoded = None  # consumers fall back to encoding
+            req.future.set_exception(MigratedError(cp))
+        if self.log is not None and (live or queued):
+            self.log.event(
+                "migrate_out",
+                requests=len(live) + len(queued),
+                inflight=len(live), queued=len(queued),
+            )
+        self._set_slots_gauge()
+        pend["out"] = [cps[r] for r in live + queued]
+        pend["event"].set()
+
+    def _collect_checkpoints(self, reqs, inflight, reason: str) -> dict:
+        """Worker thread, chunk boundary only: one `RequestCheckpoint`
+        per request, from host bookkeeping plus ONE snapshot transfer
+        for all in-flight rows (the same `snapshot_rows` fixed-shape
+        read preemption uses)."""
+        from dalle_pytorch_tpu.serving.migrate import (
+            RequestCheckpoint,
+            RowCheckpoint,
+        )
+
+        img_pos = self._last_img_pos
+        wanted = set(id(r) for r in reqs)
+        slot_of = {
+            (id(r), idx): slot
+            for slot, (r, idx) in inflight.items()
+        }
+        live_slots = [
+            s for s, (r, _) in inflight.items() if id(r) in wanted
+        ]
+        snap: dict = {}
+        if live_slots:
+            snap_fn = getattr(
+                self.engine, "snapshot_rows", self.engine.harvest
+            )
+            snap = dict(zip(live_slots, snap_fn(live_slots)))
+        chunk_index = int(
+            getattr(self.engine, "chunk_index", self._chunks_dispatched)
+        )
+        out: dict = {}
+        for req in reqs:
+            info = self._partial.get(req)
+            rows = []
+            for i, spec in enumerate(req.specs):
+                done_toks = None
+                if info is not None and info["tokens"][i] is not None:
+                    done_toks = info["tokens"][i]
+                elif i in req.resume_tokens:
+                    done_toks = req.resume_tokens[i]
+                if done_toks is not None:
+                    toks, done = np.asarray(done_toks, np.int32), True
+                else:
+                    slot = slot_of.get((id(req), i))
+                    if slot is not None and slot in snap:
+                        pos = (
+                            max(0, int(img_pos[slot]))
+                            if img_pos is not None else 0
+                        )
+                        toks = np.asarray(snap[slot][:pos], np.int32)
+                    else:  # queued row: at most its last preempt prefix
+                        toks = np.asarray(
+                            req.preempt_snapshots.get(
+                                i, np.zeros(0, np.int32)
+                            ),
+                            np.int32,
+                        )
+                    done = False
+                rows.append(RowCheckpoint(
+                    row_index=i,
+                    prompt_ids=np.asarray(spec.text_ids, np.int32),
+                    tokens=toks,
+                    done=done,
+                    seed=int(spec.seed),
+                    temperature=float(spec.temperature),
+                    top_k=float(spec.top_k),
+                ))
+            out[req] = RequestCheckpoint(
+                rows=rows,
+                chunk_index=chunk_index,
+                priority=req.priority,
+                tenant=req.tenant,
+                trace_id=req.trace.trace_id or None,
+                site=self.checkpoint_site,
+                request_key=req.request_key or (req.trace.trace_id or None),
+                reason=reason,
+            )
+        return out
+
+    def _maybe_beacon(self, inflight) -> None:
+        """Crash progress beacon (cadence-guarded by the caller):
+        journal every in-flight request's checkpoint to the local spool
+        in one atomic rewrite, and keep the wire bundle in memory for
+        `GET /admin/checkpoints`. A spool write failure is logged, never
+        raised — a full disk must not take down decode."""
+        from dalle_pytorch_tpu.serving.migrate import (
+            encode_checkpoint,
+            to_wire,
+        )
+
+        live = _unique_requests(req for req, _ in inflight.values())
+        cps = self._collect_checkpoints(live, inflight, "beacon")
+        bundle: dict = {}
+        wires: dict = {}
+        for req, cp in cps.items():
+            key = cp.request_key or f"local-{id(req):x}"
+            if key in bundle:
+                # two CONTENT-identical concurrent requests share the
+                # router's fingerprint key; last-wins is safe (the
+                # resuming replica validates seeds against the request,
+                # so a crossed resume degrades to a counted clean
+                # restart) but the loser's crash-resume opportunity is
+                # gone — say so once per beacon
+                if self.log is not None:
+                    self.log.event(
+                        "beacon_key_collision", key=key,
+                    )
+            blob = encode_checkpoint(cp, self.checkpoint_fingerprint)
+            bundle[key] = blob
+            wires[key] = to_wire(blob)
+        self.last_beacon = {
+            "ts": time.time(),
+            "chunk_index": int(
+                getattr(self.engine, "chunk_index", self._chunks_dispatched)
+            ),
+            "checkpoints": wires,
+        }
+        try:
+            self.spool.write(bundle)
+        except Exception as exc:
+            if self.log is not None:
+                self.log.event("spool_write_failed", error=repr(exc))
+
+    def _complete_restored(self, reqs) -> None:
+        """Requests whose EVERY row was restored from a checkpoint:
+        resolve with one pixel-decode dispatch each — no slot, no chunk,
+        zero re-decoded tokens."""
+        for req in reqs:
+            toks = np.stack([
+                np.asarray(req.resume_tokens[i], np.int32)
+                for i in range(req.rows)
+            ])
+            try:
+                pixels = self.engine.decode_pixels(toks)
+            except Exception as exc:
+                self._last_error_at = time.monotonic()
+                self.last_error = exc
+                self._m_errors.inc()
+                self._mint_incident([req], exc)
+                req.future.set_exception(exc)
+                continue
+            now = time.monotonic()
+            self._m_images.inc(req.rows)
+            self._m_latency.observe(now - req.enqueued_at)
+            req.first_token_at = now
+            # restored tokens are this request's first (and only) token
+            # event here — observe TTFT like the decode path does, so
+            # the TTFT and latency histogram populations stay aligned
+            # across rolling drains
+            self._m_ttft.observe(now - req.enqueued_at)
+            req.future.set_result((toks, pixels))
+            self.last_error = None
 
     def _retire(self, finished, inflight, partial) -> None:  # tracelint: hotloop
         """Harvest finished slots, resolve fully-collected requests, free

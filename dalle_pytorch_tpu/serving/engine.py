@@ -32,12 +32,22 @@ class SampleSpec:
 
     `top_k` follows the CLI/reference convention: the FRACTION of the
     vocabulary to drop (0.9 keeps the top 10%).
+
+    `resume_tokens`/`resume_pos` carry a mid-decode resume prefix
+    (decode-state migration / preemption): when `resume_pos > 0` and the
+    engine supports resume, admission re-prefills the prefix in one
+    teacher-forced dispatch and decode continues from `resume_pos`
+    instead of position 0. Engines without resume support ignore the
+    fields — decode restarts at 0, which regenerates the identical
+    tokens ((seed, position)-keyed RNG), just paying the re-decode.
     """
 
     text_ids: np.ndarray  # [text_seq_len] int32
     seed: int = 0
     temperature: float = 1.0
     top_k: float = 0.9
+    resume_tokens: Optional[np.ndarray] = None  # [resume_pos] int32
+    resume_pos: int = 0
 
 
 @dataclass
@@ -200,6 +210,27 @@ class GenerationEngine:
         contract surface. The boot fingerprint hashes this list, so an
         engine growing a program invalidates stale warm-cache claims."""
         return tuple(f"generate:{b}" for b in self.batch_shapes)
+
+    def resume_fingerprint(self) -> str:
+        """Build identity a decode-state checkpoint must match to resume
+        here (serving/migrate.py): `utils/compile_cache.boot_fingerprint`
+        over jax version / backend / model config / program ladder, plus
+        the model's repr (directly-constructed engines carry cfg=None,
+        and two different toy models must not cross-resume). Computed
+        once — a checkpoint from any drifted build becomes a counted
+        clean-restart, never a corrupt resume."""
+        if getattr(self, "_resume_fingerprint", None) is None:
+            import jax
+
+            from dalle_pytorch_tpu.utils.compile_cache import boot_fingerprint
+
+            self._resume_fingerprint = boot_fingerprint(
+                backend=jax.default_backend(),
+                model_config=self.cfg,
+                programs=self.program_ladder(),
+                extra={"model": repr(self.model)},
+            )
+        return self._resume_fingerprint
 
     def state_dump(self) -> dict:
         """Host-side engine state for `/debug/state` and stall reports.
@@ -494,6 +525,7 @@ class ContinuousEngine(GenerationEngine):
         tokenizer=None,
         registry=None,
         cfg=None,
+        resume_enabled: bool = False,
     ):
         assert float(cond_scale) == 1.0, (
             "ContinuousEngine does not support classifier-free guidance yet "
@@ -514,6 +546,12 @@ class ContinuousEngine(GenerationEngine):
             registry=registry,
             cfg=cfg,
         )
+        # decode-state resume (serving/migrate.py): one extra compiled
+        # program (teacher-forced re-prefill of prompt + generated
+        # prefix) that admits a migrated/preempted row at its OWN
+        # position instead of 0. Opt-in: the ladder, warmup and boot
+        # fingerprint grow the `resume` program only when enabled.
+        self.resume_enabled = bool(resume_enabled)
         self.chunk_tokens = int(chunk_tokens)
         # admission never spans more slots than exist; 1 degrades to the
         # per-row admission of PR 2
@@ -644,6 +682,101 @@ class ContinuousEngine(GenerationEngine):
         """Admit one prompt into `slot` — a 1-row `prefill_slots` wave
         (padded to the fixed prefill shape; no extra compiled program)."""
         self.prefill_slots([(slot, spec)], _warmup=_warmup)
+
+    # ---------------------------------------------------- mid-decode resume
+
+    @property
+    def supports_resume(self) -> bool:
+        """True when `resume_slots` may be called (the batcher's gate:
+        without it, resume-prefixed specs fall back to a position-0
+        prefill — bit-identical, just re-decoded)."""
+        return self.resume_enabled
+
+    def _pack_resume_rows(self, rows):
+        """Resume-prefix arrays for one padded wave: [R, image_seq_len]
+        token buffer (zeros beyond each prefix) + [R] positions."""
+        img_tokens = np.zeros(
+            (len(rows), self.image_seq_len), np.int32
+        )
+        img_pos = np.zeros(len(rows), np.int32)
+        for r, (_slot, spec) in enumerate(rows):
+            k = min(
+                max(0, int(getattr(spec, "resume_pos", 0) or 0)),
+                self.image_seq_len - 1,
+            )
+            toks = getattr(spec, "resume_tokens", None)
+            if toks is None:
+                k = 0
+            else:
+                toks = np.asarray(toks, np.int32)
+                k = min(k, len(toks))
+                img_tokens[r, :k] = toks[:k]
+            img_pos[r] = k
+        return img_tokens, img_pos
+
+    def _resume_op(self, s, texts, img_tokens, img_pos, slots, seeds,
+                   temps, keep):
+        """One teacher-forced resume dispatch (subclass seam, like
+        `_prefill_op`)."""
+        from dalle_pytorch_tpu.models.dalle import resume_into_slots
+
+        return resume_into_slots(
+            self.model, self.variables, s, texts, img_tokens, img_pos,
+            slots, seeds, temps, keep,
+        )
+
+    def resume_slots(  # tracelint: hotloop
+        self,
+        assignments: Sequence[Tuple[int, SampleSpec]],
+        _warmup: bool = False,
+    ) -> None:
+        """Admit up to `prefill_batch` mid-decode rows — specs carrying
+        `resume_tokens`/`resume_pos` — in ONE teacher-forced re-prefill
+        dispatch: decode continues from each row's own position instead
+        of 0 (`models/dalle.py:resume_into_slots`). Short waves pad by
+        repeating the first pair, exactly like `prefill_slots`."""
+        assert self.supports_resume, (
+            "resume_slots on an engine built without resume_enabled — "
+            "the program is not in the warmup ladder and would "
+            "cold-compile mid-traffic"
+        )
+        n = len(assignments)
+        assert 1 <= n <= self.prefill_batch, (
+            f"{n} assignments exceed prefill_batch={self.prefill_batch}; "
+            "the batcher must split admission waves"
+        )
+        rows = list(assignments) + [assignments[0]] * (self.prefill_batch - n)
+        texts, slots, seeds, temps, keep = _pack_prefill_rows(
+            rows, self._keep_k
+        )
+        img_tokens, img_pos = self._pack_resume_rows(rows)
+        with self._lock:
+            t0 = time.perf_counter()
+            self.vitals.dispatch_begin("resume")
+            try:
+                self._replace_state(lambda s: self._resume_op(
+                    s, texts, img_tokens, img_pos, slots, seeds, temps,
+                    keep,
+                ), fault_tag="resume")
+            finally:
+                wall = time.perf_counter() - t0
+                self.vitals.dispatch_end("resume", wall)
+            if _warmup:
+                from dalle_pytorch_tpu.models.dalle import resume_into_slots
+
+                self._capture_cost(
+                    "resume",
+                    lambda v, s, t, it, ip, sl, se, tm, k: resume_into_slots(
+                        self.model, v, s, t, it, ip, sl, se, tm, k,
+                    ),
+                    self.variables, self._state, texts, img_tokens,
+                    img_pos, slots, seeds, temps, keep,
+                )
+            if not _warmup:
+                if self.cost_table is not None:
+                    self.cost_table.record_wall("resume", wall, synced=False)
+                self._m_prefills.inc(n)
+                self._m_prefill_dispatches.inc()
 
     def _pre_chunk(self) -> None:
         """Subclass hook before the chunk dispatch (the paged engine tops
@@ -834,8 +967,22 @@ class ContinuousEngine(GenerationEngine):
         )
         self._compile_miss.inc()
         self.prefill_slot(0, dummy, _warmup=True)
+        if self.resume_enabled:
+            # the resume program warms in slot 1 when there is one; a
+            # 1-slot engine recycles slot 0 (same idiom as the paged
+            # engine's hit-admit warmup)
+            res_slot = 1 if self.max_batch > 1 else 0
+            if res_slot == 0:
+                self.release([0])
+            self.resume_slots(
+                [(res_slot, SampleSpec(
+                    np.zeros(self.model.text_seq_len, np.int32), seed=0,
+                    resume_tokens=np.zeros(1, np.int32), resume_pos=1,
+                ))],
+                _warmup=True,
+            )
         self.step_chunk(_warmup=True)
-        self.release([0])
+        self.release([s for s in (0, 1) if s < self.max_batch])
         # cost capture AFTER each program's first dispatch (a pre-dispatch
         # lowering would poison the sampler closure cache with tracers)
         self._capture_release_cost()
@@ -878,7 +1025,10 @@ class ContinuousEngine(GenerationEngine):
         )
 
     def program_ladder(self) -> Tuple[str, ...]:
-        out = ["prefill", "chunk", "release"]
+        out = ["prefill"]
+        if self.resume_enabled:
+            out.append("resume")
+        out += ["chunk", "release"]
         if self._has_fused_pixel_decode():
             out.append("decode_pixels")
         return tuple(out)
@@ -957,6 +1107,7 @@ class PagedContinuousEngine(ContinuousEngine):
         page_size: int = 32,
         kv_pages: Optional[int] = None,
         prefix_entries: int = 64,
+        resume_enabled: bool = False,
     ):
         self.page_size = int(page_size)
         assert self.page_size >= 1
@@ -985,6 +1136,7 @@ class PagedContinuousEngine(ContinuousEngine):
             tokenizer=tokenizer,
             registry=registry,
             cfg=cfg,
+            resume_enabled=resume_enabled,
         )
         assert self.kv.can_ever_admit(1), (
             f"kv_pages={self.kv_pages} cannot hold a single row "
@@ -1062,11 +1214,21 @@ class PagedContinuousEngine(ContinuousEngine):
         return self.kv.admission_headroom()
 
     def admission_demand(self, specs: Sequence[SampleSpec]) -> int:
-        """Worst-case page demand of one request's rows."""
-        return sum(
-            self.kv.row_demand(np.asarray(s.text_ids, np.int32))
-            for s in specs
-        )
+        """Worst-case page demand of one request's rows. Resume rows
+        (mid-decode migration) are charged the FULL per-row worst case
+        even when their prompt is prefix-cached: `admit_resume`
+        allocates fresh pages — the resume dispatch rewrites every page
+        it maps with the row's own mid-decode K/V, which must never land
+        on content other rows share."""
+        total = 0
+        for s in specs:
+            if self.supports_resume and getattr(s, "resume_pos", 0):
+                total += self.kv.pages_per_row
+            else:
+                total += self.kv.row_demand(
+                    np.asarray(s.text_ids, np.int32)
+                )
+        return total
 
     def can_ever_admit(self, specs: Sequence[SampleSpec]) -> bool:
         """False when the request could not fit an EMPTY pool — submit
@@ -1318,6 +1480,83 @@ class PagedContinuousEngine(ContinuousEngine):
                 self.kv.cache.misses += len(misses)
             stats["dispatches"] += 1
 
+    def resume_slots(  # tracelint: hotloop
+        self,
+        assignments: Sequence[Tuple[int, SampleSpec]],
+        _warmup: bool = False,
+    ) -> None:
+        """Paged mid-decode admission: fresh pages cover each row's
+        prompt + generated prefix (`PagedKVManager.admit_resume` — no
+        prefix sharing, see `admission_demand`), then ONE teacher-forced
+        `resume_into_slots_paged` dispatch writes them; blocks beyond
+        the prefix stay on the garbage page until `ensure` maps them
+        ahead of decode as usual."""
+        assert self.supports_resume, (
+            "resume_slots on an engine built without resume_enabled — "
+            "the program is not in the warmup ladder and would "
+            "cold-compile mid-traffic"
+        )
+        n = len(assignments)
+        assert 1 <= n <= self.prefill_batch, (
+            f"{n} assignments exceed prefill_batch={self.prefill_batch}; "
+            "the batcher must split admission waves"
+        )
+        rows = list(assignments) + [assignments[0]] * (self.prefill_batch - n)
+        texts, slots, seeds, temps, keep = _pack_prefill_rows(
+            rows, self._keep_k
+        )
+        img_tokens, img_pos = self._pack_resume_rows(rows)
+        page_rows = np.zeros(
+            (self.prefill_batch, self.kv.pages_per_row), np.int32
+        )
+        mapped: set = set()
+        for r, (slot, _spec) in enumerate(rows):
+            if slot in mapped:  # padding repeats a real (slot, spec) pair
+                page_rows[r] = page_rows[0]
+                continue
+            mapped.add(slot)
+            self.kv.admit_resume(
+                slot, self._text_positions + int(img_pos[r])
+            )
+            page_rows[r] = self.kv.table[slot]
+        t0 = time.perf_counter()
+        self.vitals.dispatch_begin("resume")
+        try:
+            from dalle_pytorch_tpu.models.dalle import resume_into_slots_paged
+
+            with self._lock:
+                # on failure _replace_state rebuilds state AND (via
+                # _fresh_state) the kv manager, discarding the mappings
+                self._replace_state(lambda s: resume_into_slots_paged(
+                    self.model, self.variables, s, texts, img_tokens,
+                    img_pos, slots, seeds, temps, keep, page_rows,
+                    self.page_size,
+                ), fault_tag="resume")
+                if _warmup:
+                    self._capture_cost(
+                        "resume",
+                        lambda v, s, t, it, ip, sl, se, tm, k, pr: (
+                            resume_into_slots_paged(
+                                self.model, v, s, t, it, ip, sl, se, tm,
+                                k, pr, self.page_size,
+                            )
+                        ),
+                        self.variables, self._state, texts, img_tokens,
+                        img_pos, slots, seeds, temps, keep, page_rows,
+                    )
+        finally:
+            wall = time.perf_counter() - t0
+            self.vitals.dispatch_end("resume", wall)
+        for (slot, _spec), pos in zip(assignments, img_pos[:n]):
+            self._host_pos[slot] = int(pos)
+            self._host_active[slot] = True
+        if not _warmup:
+            if self.cost_table is not None:
+                self.cost_table.record_wall("resume", wall, synced=False)
+            self._m_prefills.inc(n)
+            self._m_prefill_dispatches.inc()
+        self._update_block_gauges()
+
     def _pre_chunk(self) -> None:
         # lazy decode-page allocation: the table must cover every live
         # row's writes for this chunk before the dispatch reads it
@@ -1375,8 +1614,21 @@ class PagedContinuousEngine(ContinuousEngine):
             if hit_slot == 0:
                 self.release([0])
             self.prefill_slots([(hit_slot, dummy)], _warmup=True)  # prefix hit
+        if self.resume_enabled:
+            # the resume program warms in the next free slot; small
+            # engines recycle slot 0 (released first)
+            res_slot = 2 if self.max_batch > 2 else 0
+            if res_slot == 0:
+                self.release([0])
+            self.resume_slots(
+                [(res_slot, SampleSpec(
+                    np.zeros(self.model.text_seq_len, np.int32), seed=0,
+                    resume_tokens=np.zeros(1, np.int32), resume_pos=1,
+                ))],
+                _warmup=True,
+            )
         self.step_chunk(_warmup=True)
-        self.release([s for s in (0, 1) if s < self.max_batch])
+        self.release([s for s in (0, 1, 2) if s < self.max_batch])
         # capture after the first release dispatch, like the other
         # programs (pre-dispatch lowering poisons the sampler cache)
         self._capture_release_cost()
@@ -1407,6 +1659,8 @@ class PagedContinuousEngine(ContinuousEngine):
         out = ["prefill"]
         if self.kv.cache.enabled:
             out.append("admit_hit")
+        if self.resume_enabled:
+            out.append("resume")
         out += ["chunk", "release"]
         if self._has_fused_pixel_decode():
             out.append("decode_pixels")
@@ -1432,6 +1686,7 @@ def engine_from_checkpoint(
     kv_pages: Optional[int] = None,
     prefix_entries: int = 64,
     mesh=None,
+    resume_enabled: Optional[bool] = None,
 ):
     """Build a serving engine from a single-file DALLE checkpoint.
 
@@ -1518,6 +1773,13 @@ def engine_from_checkpoint(
             if kv_layout == "paged"
             else {}
         )
+        if mesh is None:
+            # decode-state resume (mid-decode migration) defaults ON for
+            # serving boots; the sharded engine keeps it off (pinning the
+            # resume program's out_shardings is the follow-on)
+            paged_kw["resume_enabled"] = (
+                True if resume_enabled is None else bool(resume_enabled)
+            )
         if mesh is not None:
             from dalle_pytorch_tpu.serving.sharded import (
                 ShardedContinuousEngine,

@@ -73,12 +73,18 @@ class FaultInjector:
         return self
 
     def stall_nth(self, program: str, nth: int,
-                  seconds: float) -> "FaultInjector":
+                  seconds: float = 0.0, until=None) -> "FaultInjector":
+        """Stall the Nth dispatch of `program`. `seconds` sleeps a fixed
+        wall time; `until` (a `threading.Event`) holds the dispatch until
+        the TEST releases it — the deterministic flavor chaos tests use
+        so a wedge can never end early under CPU contention (`seconds`
+        then bounds the wait as a leak backstop, default 120s)."""
         assert nth >= 1 and seconds >= 0
         with self._lock:
             self._rules.setdefault(program, {})[int(nth)] = {
                 "kind": "stall",
                 "seconds": float(seconds),
+                "until": until,
             }
         return self
 
@@ -145,7 +151,10 @@ class FaultInjector:
         if rule is None:
             return
         if rule["kind"] == "stall":
-            time.sleep(rule["seconds"])
+            if rule.get("until") is not None:
+                rule["until"].wait(rule["seconds"] or 120.0)
+            else:
+                time.sleep(rule["seconds"])
             return
         if rule["kind"] == "crash":
             self._abort(program, n, rule["exit_code"])

@@ -483,6 +483,25 @@ class PagedKVManager:
                 token = (text_ids, full_pages, partial_page)
         return page_row, partial_dst, len(shared), token
 
+    def admit_resume(self, slot: int, n_positions: int) -> None:
+        """Map FRESH pages covering positions [0, n_positions) for a
+        mid-decode resume row (decode-state migration). Deliberately NO
+        prefix sharing: the resume dispatch rewrites every page it maps
+        with the row's OWN prompt+prefix K/V, and overwriting a page the
+        prefix cache (or another row) maps would corrupt their view —
+        the resume row pays full pages, which is exactly what
+        `admission_demand` charged it. Remaining blocks stay on the
+        garbage page until `ensure` maps them ahead of decode, covered
+        by the reservation like any other row's debt."""
+        assert not self._row_pages[slot], f"slot {slot} already mapped"
+        n_blocks = min(
+            -(-int(n_positions) // self.page_size), self.pages_per_row
+        )
+        for j in range(n_blocks):
+            page = self._alloc_evicting()
+            self._map(slot, j, page)
+        self._debt[slot] = self.pages_per_row - n_blocks
+
     def finish_register(self, token, sidecar) -> None:
         """Complete a registration begun in `admit_miss` once the prefill
         dispatch has produced the sidecar."""

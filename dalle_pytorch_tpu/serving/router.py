@@ -121,7 +121,26 @@ from dalle_pytorch_tpu.serving.qos import PRIORITY_CLASSES, priority_class
 #: attribute every attempt (satellite of the PR 9 site/pid/host identity)
 ROUTE_HEADER = "x-dalle-route"
 
+#: content-identity header the router stamps on every forwarded dispatch:
+#: the request fingerprint (quarantine key). Replicas key their
+#: crash-spool checkpoints on it, so the supervisor's spool hand-off
+#: joins back to the exact in-flight requests the crash interrupted —
+#: and log lines across the fleet share one content join key.
+REQUEST_KEY_HEADER = "x-dalle-request-key"
+
 _ROUTE_RE = re.compile(r"^([A-Za-z0-9_.\-]{1,64});(\d{1,4});([01])$")
+
+_REQUEST_KEY_RE = re.compile(r"^[A-Za-z0-9_.\-]{1,64}$")
+
+
+def parse_request_key(value) -> Optional[str]:
+    """Strict/total parse of an inbound `x-dalle-request-key` header;
+    None for anything malformed (the key lands in spool files and log
+    lines, and garbage must not)."""
+    if not value or not isinstance(value, str):
+        return None
+    value = value.strip()
+    return value if _REQUEST_KEY_RE.match(value) else None
 
 MAX_BODY_BYTES = 1 << 20
 
@@ -161,16 +180,89 @@ def parse_route_header(value) -> Optional[Dict]:
 
 def request_fingerprint(body: Dict) -> str:
     """Content identity of one /generate body for quarantine tracking.
-    Excludes `timeout_s` (client patience is not content) and is
+    Excludes `timeout_s` (client patience is not content) and `resume`
+    (a decode-state checkpoint is transport state — a migrated re-
+    dispatch is THE SAME request and must keep its key), and is
     computed BEFORE the router pins a seed, so a seedless client
     re-sending the same poison prompt maps to the same key even though
     each submission would have drawn a fresh seed."""
     import hashlib
 
-    essence = {k: v for k, v in body.items() if k != "timeout_s"}
+    essence = {
+        k: v for k, v in body.items() if k not in ("timeout_s", "resume")
+    }
     return hashlib.sha256(
         json.dumps(essence, sort_keys=True, default=str).encode()
     ).hexdigest()[:24]
+
+
+class CheckpointRegistry:
+    """Bounded store of decode-state checkpoints keyed by request
+    fingerprint — the crash-recovery half of migration. Filled by the
+    supervisor's spool hand-off (`POST /admin/spool`) and by migrating
+    drains; consumed (at most once) by the failover path, which attaches
+    the checkpoint to the re-dispatch so the resuming replica restores
+    completed rows instead of re-decoding the whole request. Waiters
+    (`wait_for`) park a transport-failed request briefly for the
+    restarted replica's spool to arrive."""
+
+    def __init__(self, capacity: int = 256):
+        from collections import OrderedDict
+
+        assert capacity >= 1
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._entries: "OrderedDict[str, Dict]" = OrderedDict()
+        self.ingested = 0
+        self.consumed = 0
+
+    def put(self, key: str, wire: str, source: Optional[str] = None) -> None:
+        with self._cond:
+            self._entries[key] = {
+                "wire": wire, "source": source, "at": time.time(),
+            }
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+            self.ingested += 1
+            self._cond.notify_all()
+
+    def take(self, key: str) -> Optional[Dict]:
+        """Consume the checkpoint for `key` (at most one resume per
+        beacon — a second failover starts clean rather than resuming a
+        snapshot the first resume already advanced past)."""
+        with self._cond:
+            entry = self._entries.pop(key, None)
+            if entry is not None:
+                self.consumed += 1
+            return entry
+
+    def wait_for(self, key: str, timeout_s: float) -> Optional[Dict]:
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        with self._cond:
+            while True:
+                entry = self._entries.pop(key, None)
+                if entry is not None:
+                    self.consumed += 1
+                    return entry
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._cond.wait(timeout=min(remaining, 0.25))
+
+    def discard(self, key: str) -> None:
+        with self._cond:
+            self._entries.pop(key, None)
+
+    def detail(self) -> Dict:
+        with self._lock:
+            return {
+                "keys": len(self._entries),
+                "capacity": self.capacity,
+                "ingested": self.ingested,
+                "consumed": self.consumed,
+            }
 
 
 class QuarantineTracker:
@@ -411,6 +503,14 @@ class Replica:
         #: request fingerprints currently dispatched here (key -> count)
         #: — the attribution set a crash incident implicates
         self.inflight_keys: Dict[str, int] = {}
+        #: bounded LRU of fingerprints recently dispatched here — the
+        #: "prefix cache plausibly holds this prompt" signal migration
+        #: re-dispatch uses to prefer a cache-warm replica
+        from collections import OrderedDict
+
+        self.seen_keys: "OrderedDict[str, float]" = OrderedDict()
+        #: requests this replica completed from a migrated resume
+        self.resumes = 0
         # ---- restart/crash attribution (supervised-restart visibility):
         #: completed down->up cycles (ejected, then a successful trial)
         self.restarts = 0
@@ -468,6 +568,7 @@ class Replica:
                 round(self.last_rejoin_s, 3)
                 if self.last_rejoin_s is not None else None
             ),
+            "resumes": self.resumes,
         }
 
 
@@ -499,6 +600,7 @@ class FleetRouter:
         retry_budget_ratio: float = 0.2,
         retry_budget_initial: float = 10.0,
         quarantine_after: int = 3,
+        migrate_wait_s: float = 0.0,
         time_fn=time.monotonic,
     ):
         assert replicas, "router needs at least one replica URL"
@@ -541,6 +643,14 @@ class FleetRouter:
             QuarantineTracker(after=int(quarantine_after), time_fn=time_fn)
             if int(quarantine_after) > 0 else None
         )
+        # decode-state migration (serving/migrate.py): spooled/drained
+        # checkpoints keyed by request fingerprint; a transport-failed
+        # request may park up to `migrate_wait_s` for the restarted
+        # replica's spool hand-off before falling back to a from-scratch
+        # re-dispatch (0 = never park: instant failover, crash resumes
+        # only when the spool already arrived)
+        self.checkpoints = CheckpointRegistry()
+        self.migrate_wait_s = float(migrate_wait_s)
         # identity for span UIDs and log lines — the PR 9 clamp, so the
         # router's parent_uid round-trips the header codec
         self.site = sanitize_site(site) if site else default_site()
@@ -625,6 +735,18 @@ class FleetRouter:
             "requests refused as poison: implicated in K consecutive "
             "replica crash incidents (terminal 422 with incident ids "
             "instead of endless failover)",
+        )
+        self._m_migrations = registry.counter_family(
+            "dalle_router_migrations_total",
+            "in-flight requests re-dispatched with a decode-state "
+            "checkpoint, by source (drain: a migrating drain's 409 "
+            "carried it; crash: the restarted replica's spool hand-off)",
+            label_name="reason",
+        )
+        self._m_spool_ingested = registry.counter(
+            "dalle_router_spool_checkpoints_total",
+            "checkpoints ingested from replica spool hand-offs "
+            "(POST /admin/spool)",
         )
         for rep in self.replicas:
             self._m_state.labels(rep.name).set(STATE_VALUES[rep.state()])
@@ -893,6 +1015,42 @@ class FleetRouter:
             ))
         return out
 
+    def _prefer_cache_warm(self, cands: List[Replica],
+                           key: str) -> List[Replica]:
+        """Stable re-rank of one attempt's candidates: replicas that
+        recently dispatched this fingerprint first — their prefix cache
+        plausibly still holds the prompt, so a migrated resume's
+        re-prefill is a near-zero-cost cache hit. Health/occupancy order
+        is preserved within each partition (this is a tiebreak, not an
+        override)."""
+        with self._lock:
+            warm = [r for r in cands if key in r.seen_keys]
+        if not warm:
+            return cands
+        warm_set = set(id(r) for r in warm)
+        return warm + [r for r in cands if id(r) not in warm_set]
+
+    def ingest_spool(self, replica: Optional[str],
+                     checkpoints: Dict[str, str]) -> int:
+        """POST /admin/spool: a restarted replica's crash-beacon journal,
+        handed over by its supervisor. Each entry lands in the checkpoint
+        registry keyed by request fingerprint; in-flight failovers (and
+        parked `migrate_wait_s` waiters) pick them up."""
+        n = 0
+        for key, wire in checkpoints.items():
+            key = parse_request_key(key)
+            if key is None or not isinstance(wire, str):
+                continue
+            self.checkpoints.put(key, wire, source=replica)
+            n += 1
+        if n:
+            self._m_spool_ingested.inc(n)
+            if self.log is not None:
+                self.log.event(
+                    "spool_ingested", replica=replica, checkpoints=n,
+                )
+        return n
+
     def _retry_after_s(self, klass: int) -> float:
         """Retry-After for an unroutable request: the soonest a replica
         could return (cooldown expiry or next probe), clamped to [1, 30]."""
@@ -938,6 +1096,13 @@ class FleetRouter:
             rep.inflight += 1
             if key is not None:
                 rep.inflight_keys[key] = rep.inflight_keys.get(key, 0) + 1
+                # affinity memory: this replica's prefix cache plausibly
+                # holds this prompt now (bounded LRU; migration
+                # re-dispatch prefers cache-warm replicas)
+                rep.seen_keys[key] = self._now()
+                rep.seen_keys.move_to_end(key)
+                while len(rep.seen_keys) > 512:
+                    rep.seen_keys.popitem(last=False)
             self._m_outstanding.labels(rep.name).set(rep.outstanding_rows)
         self._m_requests.labels(rep.name).inc()
 
@@ -987,16 +1152,26 @@ class FleetRouter:
 
     def _classify(self, res: Dict, klass: int) -> str:
         """One dispatch result -> `pass` (return to client), `failover`
-        (breaker error, try elsewhere) or `cooled` (replica-level
-        backpressure: obey Retry-After for this class, try elsewhere).
-        429 passes THROUGH: it is tenant-scoped (quota), and cooling the
-        replica for the whole class would let one over-quota tenant make
-        the class unroutable for everyone — the offending tenant must
-        see its own 429 + Retry-After instead (the PR 11 isolation
-        contract: a flooding tenant degrades only itself)."""
+        (breaker error, try elsewhere), `cooled` (replica-level
+        backpressure: obey Retry-After for this class, try elsewhere) or
+        `migrate` (the replica exported this request's decode state at a
+        chunk boundary — re-dispatch it WITH the checkpoint; a healthy,
+        deliberate hand-off, not a failure). 429 passes THROUGH: it is
+        tenant-scoped (quota), and cooling the replica for the whole
+        class would let one over-quota tenant make the class unroutable
+        for everyone — the offending tenant must see its own 429 +
+        Retry-After instead (the PR 11 isolation contract: a flooding
+        tenant degrades only itself)."""
         if res["kind"] == "error":
             return "failover"
         status = res["status"]
+        if status == 409:
+            # only a replica's migrating drain answers 409 on /generate;
+            # parse (and cache) the checkpoint off the body — an
+            # unparseable body degrades to pass (the client sees the 409)
+            ckpt = self._migrated_checkpoint(res)
+            if ckpt is not None:
+                return "migrate"
         if status == 503:
             return "cooled"
         if status >= 500 and status != 504:
@@ -1004,6 +1179,25 @@ class FleetRouter:
         # 2xx, 4xx (incl. the tenant-scoped 429), and 504 (the request
         # consumed its own deadline — retrying cannot meet it) pass
         return "pass"
+
+    @staticmethod
+    def _migrated_checkpoint(res: Dict) -> Optional[Dict]:
+        """Parse a 409 body's migration payload once, memoized on the
+        result dict; None unless it is a well-formed migrated response."""
+        if "migrated_payload" not in res:
+            payload = None
+            try:
+                obj = json.loads(res.get("body") or b"{}")
+                if (
+                    isinstance(obj, dict)
+                    and obj.get("migrated") is True
+                    and isinstance(obj.get("checkpoint"), str)
+                ):
+                    payload = obj
+            except Exception:
+                payload = None
+            res["migrated_payload"] = payload
+        return res["migrated_payload"]
 
     def _implicate_crash(self, rep: Replica, key: Optional[str],
                          error: str) -> None:
@@ -1070,6 +1264,11 @@ class FleetRouter:
             # open the circuit (a queue-full burst would otherwise eject
             # the exact replica that is correctly protecting itself)
             self._record_dispatch(rep, ok=True)
+        elif kind == "migrate":
+            # a migrating drain is a deliberate, healthy hand-off: no
+            # breaker evidence, no cooldown (the drain itself already
+            # removed the replica from rotation), no implication
+            self._record_dispatch(rep, ok=True)
         else:
             self._record_dispatch(rep, ok=res["status"] < 500)
             if res["status"] == 200:
@@ -1110,6 +1309,10 @@ class FleetRouter:
             headers = {ROUTE_HEADER: format_route_header(
                 rep.name, attempt, hedged
             )}
+            if key is not None:
+                # content join key: the replica keys its crash-spool
+                # checkpoints (and its log lines) on it
+                headers[REQUEST_KEY_HEADER] = key
             if trace:
                 headers[TRACE_HEADER] = format_trace_header(
                     trace.trace_id, self._span_uid(span)
@@ -1255,6 +1458,21 @@ class FleetRouter:
         attempt = 0
         last: Optional[Tuple[Dict, str]] = None
         hedged_any = False
+        # migration state: once a checkpoint is attached (drain 409 or
+        # crash-spool hit) every further dispatch of this request is a
+        # RESUME — the target replica restores completed rows verbatim
+        free_attempts = 0  # migrate re-dispatches don't draw retry budget
+        resume_reason: Optional[str] = None
+        migrated_from: Optional[str] = None
+        resumed_at_chunk: Optional[int] = None
+
+        def mig_fields() -> Dict:
+            if resume_reason is None:
+                return {}
+            out = {"migrated_from": migrated_from, "resume": resume_reason}
+            if resumed_at_chunk is not None:
+                out["resumed_at_chunk"] = resumed_at_chunk
+            return out
 
         def closed_out(outcome: str, status: int, replica=None, **fields):
             trace.finish(outcome=outcome)
@@ -1265,7 +1483,8 @@ class FleetRouter:
                     latency_ms=round((self._now() - t0) * 1e3, 2),
                     stages=trace.stage_seconds(),
                     replica=replica, attempt=attempt, hedged=hedged_any,
-                    priority=priority, rows=rows, **fields,
+                    priority=priority, rows=rows,
+                    **mig_fields(), **fields,
                 )
 
         while True:
@@ -1296,11 +1515,19 @@ class FleetRouter:
                 return 503, json.dumps({"error": err}).encode(), [
                     ("Retry-After", str(int(round(retry))))
                 ]
-            if attempt > 0 and not self.budget.withdraw():
+            if resume_reason is not None and qkey is not None:
+                # resume re-dispatch: prefer replicas that recently saw
+                # this fingerprint — their prefix cache plausibly holds
+                # the prompt, so the resume's re-prefill is a cache hit
+                cands = self._prefer_cache_warm(cands, qkey)
+            if attempt - free_attempts > 0 and not self.budget.withdraw():
                 # budget empty: surface the LAST failure instead of
-                # hammering recovering replicas with more attempts.
-                # (Checked BEFORE the trial claim below, so an early
-                # return can never leak a claimed half-open trial.)
+                # hammering recovering replicas with more attempts
+                # (migrate re-dispatches are exempt — a rolling drain is
+                # deliberate fleet maintenance, not failure retry, and
+                # must not be starved by an unrelated outage's drained
+                # budget). (Checked BEFORE the trial claim below, so an
+                # early return can never leak a claimed half-open trial.)
                 self._m_budget.set(self.budget.balance)
                 closed_out(
                     "budget_exhausted", 503,
@@ -1333,9 +1560,38 @@ class FleetRouter:
                 klass, timeout_attempt, key=qkey,
             )
             hedged_any = hedged_any or hedged
+            if kind == "migrate":
+                # the draining replica exported this request's decode
+                # state at a chunk boundary: re-dispatch THE SAME request
+                # (same key, same trace, same seed) with the checkpoint
+                # attached so the next replica resumes instead of
+                # restarting from scratch
+                payload409 = res["migrated_payload"]
+                body["resume"] = payload409["checkpoint"]
+                payload = json.dumps(body).encode("utf-8")
+                migrated_from = res["replica"].name
+                resume_reason = "drain"
+                rc = payload409.get("resumed_at_chunk")
+                resumed_at_chunk = int(rc) if rc is not None else None
+                self._m_migrations.labels("drain").inc()
+                if self.log is not None:
+                    self.log.event(
+                        "request_migrated", reason="drain",
+                        replica=res["replica"].name, key=qkey,
+                        resumed_at_chunk=resumed_at_chunk,
+                        checkpoint_bytes=len(payload409["checkpoint"]),
+                    )
+                free_attempts += 1
+                tried.add(res["replica"].name)
+                last = (res, kind)
+                attempt += 1
+                continue
             if kind == "pass":
                 status = res["status"]
                 outcome = "ok" if status == 200 else "replica_status"
+                if status == 200 and resume_reason is not None:
+                    with self._lock:
+                        res["replica"].resumes += 1
                 closed_out(
                     outcome, status, replica=res["replica"].name,
                 )
@@ -1368,6 +1624,35 @@ class FleetRouter:
                 else "backpressure" if kind == "cooled"
                 else "status"
             )
+            if (
+                reason == "transport" and qkey is not None
+                and resume_reason is None
+            ):
+                # crash path: a spooled checkpoint for this request (the
+                # supervisor hands the dead replica's journal over on
+                # restart) turns the from-scratch re-dispatch into a
+                # resume — optionally parking up to migrate_wait_s for
+                # the hand-off to arrive
+                entry = self.checkpoints.take(qkey)
+                if entry is None and self.migrate_wait_s > 0:
+                    entry = self.checkpoints.wait_for(
+                        qkey,
+                        min(self.migrate_wait_s,
+                            max(0.0, deadline - self._now())),
+                    )
+                if entry is not None:
+                    body["resume"] = entry["wire"]
+                    payload = json.dumps(body).encode("utf-8")
+                    migrated_from = entry.get("source")
+                    resume_reason = "crash"
+                    self._m_migrations.labels("crash").inc()
+                    if self.log is not None:
+                        self.log.event(
+                            "request_migrated", reason="crash",
+                            replica=res["replica"].name, key=qkey,
+                            source=entry.get("source"),
+                            checkpoint_bytes=len(entry["wire"]),
+                        )
             self._m_failovers.labels(reason).inc()
             tried.add(res["replica"].name)
             last = (res, kind)
@@ -1381,26 +1666,46 @@ class FleetRouter:
                 return rep
         return None
 
-    def _propagate_admin(self, rep: Replica, action: str) -> Optional[str]:
+    def _propagate_admin(self, rep: Replica, action: str,
+                         query: str = ""):
         """Best-effort POST of the replica's own /admin/<action> so
-        direct clients are refused during the drain window too."""
+        direct clients are refused during the drain window too. Returns
+        (error string | None, parsed response body | None) — the body is
+        a plain return value, never shared state, so concurrent admin
+        drains cannot read each other's bundles."""
         try:
             req = urllib.request.Request(
-                rep.url + f"/admin/{action}", data=b"", method="POST"
+                rep.url + f"/admin/{action}" + (f"?{query}" if query else ""),
+                data=b"", method="POST",
             )
             with urllib.request.urlopen(
-                req, timeout=self.probe_timeout_s
+                req, timeout=max(self.probe_timeout_s, 35.0 if query else 0)
             ) as resp:
-                resp.read()
-            return None
+                raw = resp.read()
+            try:
+                body = json.loads(raw or b"{}")
+            except Exception:
+                body = None
+            return None, body
         except Exception as exc:
-            return repr(exc)
+            return repr(exc), None
 
     def drain(self, name: str, wait_s: float = 0.0,
-              propagate: bool = False) -> Optional[Dict]:
+              propagate: bool = False,
+              migrate: bool = False) -> Optional[Dict]:
         """Stop new admissions to `name`, wait out its outstanding rows
         (up to `wait_s`), eject it from rotation as `drained`. Returns
-        the replica's state dict, or None for an unknown name."""
+        the replica's state dict, or None for an unknown name.
+
+        `migrate=True` (implies propagate) makes it a ZERO-LOST-WORK
+        drain: the replica exports every queued + in-flight request as a
+        decode-state checkpoint at its next chunk boundary — the blocked
+        dispatch threads get 409s and re-dispatch each request as a
+        resume on a healthy replica — so the drain completes in roughly
+        one chunk instead of one full decode, re-decoding only the
+        unfinished rows. The returned bundle is also ingested into the
+        checkpoint registry (belt and braces for direct-client
+        requests)."""
         rep = self._find(name)
         if rep is None:
             return None
@@ -1413,15 +1718,26 @@ class FleetRouter:
         if self.log is not None:
             self.log.event(
                 "replica_drain", replica=name, mode=rep.mode,
+                migrate=migrate,
                 outstanding_rows=rep.outstanding_rows,
             )
-        if propagate:
-            err = self._propagate_admin(rep, "drain")
+        if propagate or migrate:
+            err, body = self._propagate_admin(
+                rep, "drain", query="migrate=1" if migrate else ""
+            )
             if err and self.log is not None:
                 self.log.event(
                     "replica_drain_propagate_failed", replica=name,
                     error=err,
                 )
+            if migrate and not err:
+                bundle = (
+                    (body or {}).get("migrate") or {}
+                ).get("checkpoints") or {}
+                for key, wire in bundle.items():
+                    key = parse_request_key(key)
+                    if key is not None and isinstance(wire, str):
+                        self.checkpoints.put(key, wire, source=name)
         if wait_s > 0:
             # injectable clock like every other timing path, so a
             # stubbed-clock chaos test can expire the wait
@@ -1454,7 +1770,7 @@ class FleetRouter:
             rep.next_probe_at = now
             self._set_state_gauge(rep)
         if propagate:
-            err = self._propagate_admin(rep, "undrain")
+            err, _ = self._propagate_admin(rep, "undrain")
             if err and self.log is not None:
                 self.log.event(
                     "replica_undrain_propagate_failed", replica=name,
@@ -1511,6 +1827,18 @@ class FleetRouter:
                 self.quarantine.detail()
                 if self.quarantine is not None else {"after": 0}
             ),
+            "migration": {
+                "migrate_wait_s": self.migrate_wait_s,
+                "registry": self.checkpoints.detail(),
+                "migrations": {
+                    label: int(c.value)
+                    for label, c in self._m_migrations.items()
+                },
+                "resumes_by_replica": {
+                    rep.name: rep.resumes
+                    for rep in self.replicas if rep.resumes
+                },
+            },
         }
 
 
@@ -1567,6 +1895,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         router = self.server.owner.router
         path, _, query = self.path.partition("?")
+        if path == "/admin/spool":
+            # supervisor spool hand-off: {"replica": name?,
+            # "checkpoints": {key: wire}} — malformed entries are
+            # silently skipped (parse_request_key), the count returns
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                if not 0 < length <= MAX_BODY_BYTES:
+                    raise ValueError(f"bad Content-Length {length}")
+                obj = json.loads(self.rfile.read(length))
+                assert isinstance(obj, dict), "body must be a JSON object"
+                cps = obj.get("checkpoints")
+                assert isinstance(cps, dict), "checkpoints must be a dict"
+            except Exception as exc:
+                self._reply(400, {"error": f"bad request: {exc}"})
+                return
+            n = router.ingest_spool(obj.get("replica"), cps)
+            self._reply(200, {"ingested": n})
+            return
         if path in ("/admin/drain", "/admin/undrain"):
             params = parse_qs(query)
             name = params.get("replica", [None])[0]
@@ -1580,8 +1926,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 except (TypeError, ValueError):
                     self._reply(400, {"error": "wait_s must be a number"})
                     return
+                migrate = params.get("migrate", ["0"])[0] in ("1", "true")
                 detail = router.drain(
-                    name, wait_s=wait_s, propagate=propagate
+                    name, wait_s=wait_s, propagate=propagate,
+                    migrate=migrate,
                 )
             else:
                 detail = router.undrain(name, propagate=propagate)
@@ -1717,6 +2065,12 @@ def add_router_args(p: argparse.ArgumentParser,
                    "may be implicated in before it is quarantined "
                    "(terminal 422 with incident ids; a success clears "
                    "the streak; 0 disables the quarantine)")
+    p.add_argument("--migrate_wait_s", type=float, default=0.0,
+                   help="seconds a transport-failed request may park "
+                   "waiting for the crashed replica's checkpoint spool "
+                   "to arrive (supervisor hand-off) before failing over "
+                   "from scratch; 0 = never park (spooled resumes still "
+                   "apply when the hand-off already landed)")
 
 
 def router_from_args(args, registry=None, log=None) -> FleetRouter:
@@ -1754,6 +2108,7 @@ def router_from_args(args, registry=None, log=None) -> FleetRouter:
         retry_budget_ratio=args.retry_budget_ratio,
         retry_budget_initial=args.retry_budget_initial,
         quarantine_after=getattr(args, "quarantine_after", 3),
+        migrate_wait_s=getattr(args, "migrate_wait_s", 0.0),
     )
 
 

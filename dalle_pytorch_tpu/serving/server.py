@@ -47,8 +47,18 @@ Endpoints:
                    the drain status (inflight/queued rows, quiesced).
                    The fleet router's `/admin/drain?replica=&propagate=1`
                    calls this so direct clients are refused during a
-                   rolling restart too.
+                   rolling restart too. `?migrate=1` makes it a
+                   ZERO-LOST-WORK drain: every queued + in-flight
+                   request is exported as a decode-state checkpoint at
+                   the next chunk boundary (each waiting client gets a
+                   409 carrying its checkpoint — the router re-dispatches
+                   it as a resume; the full bundle rides this response).
   POST /admin/undrain -> resume intake.
+  GET  /admin/checkpoints -> non-destructive chunk-boundary snapshot of
+                   every in-flight request's decode state (pull-based
+                   drain: collect, then kill, then re-dispatch); serves
+                   the last crash-beacon bundle when the engine is
+                   wedged.
 
 Every /generate request gets a trace ID at ingress — ADOPTED from a valid
 `x-dalle-trace` header (fleet context propagation, obs/aggregate.py:
@@ -90,7 +100,22 @@ from dalle_pytorch_tpu.obs.aggregate import (
     parse_trace_header,
     sanitize_site,
 )
-from dalle_pytorch_tpu.serving.router import ROUTE_HEADER, parse_route_header
+from dalle_pytorch_tpu.serving.router import (
+    REQUEST_KEY_HEADER,
+    ROUTE_HEADER,
+    parse_request_key,
+    parse_route_header,
+)
+from dalle_pytorch_tpu.serving.migrate import (
+    CheckpointCorrupt,
+    CheckpointMismatch,
+    CheckpointSpool,
+    MigratedError,
+    decode_checkpoint,
+    encode_checkpoint,
+    from_wire,
+    to_wire,
+)
 from dalle_pytorch_tpu.obs.logging import StructuredLog
 from dalle_pytorch_tpu.obs.profiler import ProfilerBusy, ProfilerCapture
 from dalle_pytorch_tpu.obs.tracing import Tracer
@@ -249,6 +274,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(200, table.detail(per_shard=per_shard))
         elif path == "/debug/state":
             self._reply(200, owner.state_dump())
+        elif path == "/admin/checkpoints":
+            # pull-based drain: a chunk-boundary snapshot of every
+            # in-flight request's decode state WITHOUT disturbing it —
+            # an orchestrator can collect, then kill, then re-dispatch
+            self._reply(200, owner.checkpoints_snapshot())
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -322,7 +352,10 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if path == "/admin/drain":
             if self._drain_body():
-                self._reply(200, owner.drain_intake())
+                migrate = parse_qs(query).get("migrate", ["0"])[0] in (
+                    "1", "true",
+                )
+                self._reply(200, owner.drain_intake(migrate=migrate))
             return
         if path == "/admin/undrain":
             if self._drain_body():
@@ -382,6 +415,10 @@ class _Handler(BaseHTTPRequestHandler):
             assert isinstance(tenant, str) and len(tenant) <= 128, (
                 "tenant must be a string of at most 128 characters"
             )
+            resume_wire = body.get("resume")
+            assert resume_wire is None or isinstance(resume_wire, str), (
+                "resume must be a wire-encoded checkpoint string"
+            )
         except Exception as exc:
             self._reply(400, {"error": f"bad request: {exc}"})
             return
@@ -439,6 +476,26 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 for i in range(num_images)
             ]
+            # decode-state resume (serving/migrate.py): a checkpoint that
+            # fails fingerprint/integrity/consistency validation degrades
+            # to a clean position-0 restart — counted and logged, NEVER a
+            # client-visible error or a cross-build resume
+            resume_cp = resume_bytes = None
+            if resume_wire is not None:
+                resume_cp, resume_bytes = owner.validate_resume(
+                    resume_wire, specs
+                )
+                if resume_cp is not None:
+                    admission["migrated_from"] = resume_cp.site
+                    admission["resumed_at_chunk"] = int(
+                        resume_cp.chunk_index
+                    )
+                    admission["checkpoint_bytes"] = resume_bytes
+                else:
+                    admission["resume_rejected"] = True
+            request_key = parse_request_key(
+                self.headers.get(REQUEST_KEY_HEADER)
+            )
             admission.update(owner.admission_context())
             admission["priority"] = priority
             if tenant:
@@ -446,6 +503,8 @@ class _Handler(BaseHTTPRequestHandler):
             req = owner.batcher.submit(
                 specs, timeout_s=timeout_s, trace=trace,
                 priority=priority, tenant=tenant,
+                request_key=request_key,
+                resume=resume_cp, resume_bytes=resume_bytes,
             )
         except QueueFullError as exc:
             closed_out("rejected", 503, error=str(exc))
@@ -486,6 +545,29 @@ class _Handler(BaseHTTPRequestHandler):
             req.cancel()
             closed_out("timeout", 504)
             self._reply(504, {"error": str(exc)})
+            return
+        except MigratedError as exc:
+            # drain?migrate=1 exported this request's decode state at the
+            # chunk boundary: 409 carries the checkpoint so the fleet
+            # router re-dispatches THE SAME request as a resume on a
+            # healthy replica (a direct client may re-POST with
+            # {"resume": checkpoint} itself)
+            blob = exc.checkpoint.encoded or encode_checkpoint(
+                exc.checkpoint, owner.resume_fingerprint
+            )
+            closed_out(
+                "migrated", 409,
+                resumed_at_chunk=int(exc.checkpoint.chunk_index),
+                checkpoint_bytes=len(blob),
+            )
+            self._reply(409, {
+                "error": "request migrated out (replica draining); "
+                "re-dispatch with the attached resume checkpoint",
+                "migrated": True,
+                "checkpoint": to_wire(blob),
+                "resumed_at_chunk": int(exc.checkpoint.chunk_index),
+                "migrated_from": exc.checkpoint.site,
+            })
             return
         except Exception as exc:
             incidents = list(getattr(req, "incidents", ()) or ())
@@ -605,6 +687,8 @@ class ServingServer:
         deadline_shed: bool = True,
         reserve_slots: int = 0,
         quarantine_after: int = 2,
+        checkpoint_spool=None,
+        spool_every: int = 8,
     ):
         self.engine = engine
         self.registry = engine.registry
@@ -648,6 +732,31 @@ class ServingServer:
         )
         self.trace_dump_path = trace_dump_path
         self._trace_dumped = False
+        # build identity for decode-state checkpoints (serving/migrate.py):
+        # a checkpoint resumes here ONLY when the exporting replica's
+        # fingerprint matches; engines without the hook (test fakes) fall
+        # back to a shared sentinel so same-process fakes interoperate
+        fp_fn = getattr(engine, "resume_fingerprint", None)
+        try:
+            self.resume_fingerprint = (
+                fp_fn() if fp_fn is not None else "unfingerprinted"
+            )
+        except Exception:
+            self.resume_fingerprint = "unfingerprinted"
+        self._m_resume_rejects = self.registry.counter_family(
+            "dalle_serving_resume_rejects_total",
+            "resume checkpoints refused and degraded to a clean "
+            "position-0 restart, by reason (mismatch: different build "
+            "fingerprint; corrupt: failed integrity validation; "
+            "inconsistent: checkpoint disagrees with the request body)",
+            label_name="reason",
+        )
+        self.spool = (
+            checkpoint_spool
+            if checkpoint_spool is None
+            or isinstance(checkpoint_spool, CheckpointSpool)
+            else CheckpointSpool(checkpoint_spool)
+        )
         if isinstance(engine, ContinuousEngine):
             # token-boundary admission: max_delay_ms does not apply (there
             # is no flush deadline; admission happens at chunk boundaries)
@@ -661,7 +770,10 @@ class ServingServer:
                 preempt=preempt,
                 deadline_shed=deadline_shed,
                 reserve_slots=reserve_slots,
+                spool=self.spool,
+                spool_every=spool_every,
             )
+            self.batcher.checkpoint_fingerprint = self.resume_fingerprint
         else:
             self.batcher = MicroBatcher(
                 engine,
@@ -694,6 +806,10 @@ class ServingServer:
                 "host": sanitize_site(socket.gethostname() or "localhost"),
             }
         )
+        if hasattr(self.batcher, "checkpoint_site"):
+            # exported checkpoints carry this replica's identity — the
+            # `migrated_from` the resuming replica logs
+            self.batcher.checkpoint_site = self.identity["site"]
         try:
             self._httpd = _Server((host, port), self)
         except OSError:
@@ -769,15 +885,59 @@ class ServingServer:
             "quiesced": self.batcher.quiesced,
         }
 
-    def drain_intake(self) -> dict:
+    def drain_intake(self, migrate: bool = False) -> dict:
         """POST /admin/drain: reversibly stop admissions (503 to new
         /generate, 503 `"draining"` on /healthz) while in-flight rows run
         to completion. The process stays up — `shutdown()` remains the
-        terminal path."""
+        terminal path.
+
+        `?migrate=1` additionally exports every queued + in-flight
+        request as a decode-state checkpoint at the next chunk boundary:
+        each request's waiting client gets a 409 carrying its checkpoint
+        (the router re-dispatches it as a resume), and the full bundle
+        rides this response too for pull-based orchestration — the drain
+        finishes in one chunk instead of one full decode."""
         self._intake_paused = True
+        out = self.drain_status()
+        if migrate:
+            export = getattr(self.batcher, "migrate_out", None)
+            if export is None:
+                out["migrate"] = {
+                    "supported": False,
+                    "note": "micro engine holds no resumable decode "
+                    "state; drain waits out the in-flight batch",
+                }
+            else:
+                cps = export(timeout_s=30.0)
+                if cps is None:
+                    out["migrate"] = {
+                        "supported": True, "timeout": True,
+                        "note": "worker never reached a chunk boundary; "
+                        "nothing was exported",
+                    }
+                else:
+                    bundle = {}
+                    for cp in cps:
+                        key = cp.request_key or f"anon-{len(bundle)}"
+                        bundle[key] = to_wire(
+                            cp.encoded or encode_checkpoint(
+                                cp, self.resume_fingerprint
+                            )
+                        )
+                    out["migrate"] = {
+                        "supported": True,
+                        "migrated": len(cps),
+                        "fingerprint": self.resume_fingerprint,
+                        "checkpoints": bundle,
+                    }
+            out.update(self.drain_status())
         if self.log is not None:
-            self.log.event("drain_intake", **self.drain_status())
-        return self.drain_status()
+            self.log.event(
+                "drain_intake", migrate=migrate,
+                migrated=(out.get("migrate") or {}).get("migrated"),
+                **self.drain_status(),
+            )
+        return out
 
     def undrain_intake(self) -> dict:
         """POST /admin/undrain: resume admissions after a drain."""
@@ -785,6 +945,110 @@ class ServingServer:
         if self.log is not None:
             self.log.event("undrain_intake")
         return self.drain_status()
+
+    def checkpoints_snapshot(self) -> dict:
+        """GET /admin/checkpoints: non-destructive chunk-boundary export
+        of every in-flight request's decode state (requests keep
+        decoding here). Falls back to the last crash-beacon bundle when
+        the worker cannot reach a boundary (wedged engine) — stale
+        progress beats none for a pull-based drain."""
+        peek = getattr(self.batcher, "peek_checkpoints", None)
+        if peek is None:
+            return {
+                "checkpoints": {},
+                "note": "micro engine holds no resumable decode state",
+            }
+        cps = peek(timeout_s=10.0)
+        if cps is None:
+            beacon = getattr(self.batcher, "last_beacon", None) or {}
+            return {
+                "stale": True,
+                "note": "worker never reached a chunk boundary; "
+                "serving the last beacon bundle",
+                "checkpoints": beacon.get("checkpoints", {}),
+                "beacon_ts": beacon.get("ts"),
+                "fingerprint": self.resume_fingerprint,
+            }
+        bundle = {}
+        for cp in cps:
+            key = cp.request_key or f"anon-{len(bundle)}"
+            bundle[key] = to_wire(
+                encode_checkpoint(cp, self.resume_fingerprint)
+            )
+        return {
+            "checkpoints": bundle,
+            "count": len(bundle),
+            "fingerprint": self.resume_fingerprint,
+        }
+
+    def validate_resume(self, wire: str, specs):
+        """Decode + validate one wire checkpoint against this build and
+        THIS request. Returns (RequestCheckpoint, bytes) on acceptance,
+        (None, None) on any rejection — every reject is counted by
+        reason and logged, and the caller serves the request from a
+        clean position-0 start (never an error, never a corrupt
+        resume)."""
+        def reject(reason: str, detail: str):
+            self._m_resume_rejects.labels(reason).inc()
+            if self.log is not None:
+                self.log.event(
+                    "resume_rejected", reason=reason, detail=detail
+                )
+            return None, None
+
+        try:
+            blob = from_wire(wire)
+            cp = decode_checkpoint(blob, self.resume_fingerprint)
+        except CheckpointMismatch as exc:
+            return reject("mismatch", str(exc))
+        except CheckpointCorrupt as exc:
+            return reject("corrupt", str(exc))
+        if len(cp.rows) != len(specs):
+            return reject(
+                "inconsistent",
+                f"{len(cp.rows)} checkpoint rows != {len(specs)} "
+                "request rows",
+            )
+        image_seq_len = getattr(self.engine, "image_seq_len", None)
+        seen = set()
+        for row in cp.rows:
+            i = int(row.row_index)
+            if not 0 <= i < len(specs) or i in seen:
+                return reject("inconsistent", f"bad row index {i}")
+            seen.add(i)
+            spec = specs[i]
+            if not np.array_equal(
+                np.asarray(row.prompt_ids, np.int32),
+                np.asarray(spec.text_ids, np.int32),
+            ):
+                return reject(
+                    "inconsistent", f"row {i} prompt differs from request"
+                )
+            if int(row.seed) != int(spec.seed) or (
+                float(row.temperature) != float(spec.temperature)
+                or float(row.top_k) != float(spec.top_k)
+            ):
+                # different sampling identity would NOT regenerate the
+                # checkpointed prefix — resuming it would splice two
+                # decodes together
+                return reject(
+                    "inconsistent",
+                    f"row {i} sampling params differ from request",
+                )
+            if image_seq_len is not None:
+                n = len(row.tokens)
+                if row.done and n != int(image_seq_len):
+                    return reject(
+                        "inconsistent",
+                        f"done row {i} has {n} tokens, expected "
+                        f"{image_seq_len}",
+                    )
+                if not row.done and n >= int(image_seq_len):
+                    return reject(
+                        "inconsistent",
+                        f"partial row {i} claims {n} tokens",
+                    )
+        return cp, len(blob)
 
     def health(self):
         # snapshot once: the batcher worker can set/clear the error fields
@@ -870,6 +1134,8 @@ class ServingServer:
             # replica's traces actually reach the collector" is the first
             # question a cross-host stall investigation asks
             dump["trace_export"] = self.exporter.detail()
+        if self.spool is not None:
+            dump["checkpoint_spool"] = self.spool.detail()
         return dump
 
     def qos_detail(self) -> dict:

@@ -73,6 +73,8 @@ class ReplicaSupervisor:
         spawn_fn: Optional[Callable] = None,
         probe_fn: Optional[Callable[[], bool]] = None,
         max_restarts: Optional[int] = None,
+        spool_dir=None,
+        spool_notify_url: Optional[str] = None,
     ):
         assert argv, "supervisor needs a child command"
         assert backoff_base_s > 0 and backoff_max_s >= backoff_base_s
@@ -101,6 +103,24 @@ class ReplicaSupervisor:
         self._spawn_fn = spawn_fn
         self._probe_fn = probe_fn
         self.max_restarts = max_restarts
+        # decode-state migration (serving/migrate.py): the replica's
+        # crash-beacon spool directory and the router URL it is handed
+        # to once the restarted child is READY — a SIGKILLed replica's
+        # in-flight progress then resumes fleet-side instead of being
+        # re-decoded from scratch
+        self.spool_dir = spool_dir
+        self.spool_notify_url = (
+            spool_notify_url.rstrip("/") if spool_notify_url else None
+        )
+        self.spool_handoffs = 0
+        self.spool_handoff_errors = 0
+        #: the DEAD child's journal, captured between its exit and the
+        #: respawn (the only window where nobody writes the file): the
+        #: restarted child's own first beacon wholesale-replaces the
+        #: journal, so reading after it serves would lose the crash
+        #: checkpoints — and clearing after it serves would delete the
+        #: NEW child's live progress
+        self._pending_spool: dict = {}
 
         self._stop = threading.Event()
         self.child = None
@@ -236,6 +256,12 @@ class ReplicaSupervisor:
         the child's final exit code (or 0 when stopped)."""
         while not self._stop.is_set():
             self.state = "starting"
+            if self.restarts == 0 and self.spool_dir is not None:
+                # first boot: a leftover journal is a PREVIOUS process
+                # lifetime's state whose clients are long gone — clear
+                # it BEFORE the child can serve (not at the ready probe,
+                # which may lag the child's first own beacon)
+                self._clear_spool()
             spawned_at = self._now()
             self.child = self._spawn()
             self._event(
@@ -252,6 +278,14 @@ class ReplicaSupervisor:
                     time_to_ready_s=round(self.last_ready_s or 0.0, 3),
                     restarts=self.restarts,
                 )
+                if self.restarts > 0:
+                    # hand the crash-captured journal (read between the
+                    # dead child's exit and this respawn — see
+                    # _pending_spool) to the fleet router the moment the
+                    # RESTARTED child serves again: in-flight requests
+                    # the crash interrupted resume from the journaled
+                    # checkpoints instead of from scratch
+                    self._handoff_spool()
             hung_boot = False
             if not was_ready and not self._stop.is_set() \
                     and self.child.poll() is None:
@@ -290,6 +324,14 @@ class ReplicaSupervisor:
             if delay is None:
                 self.state = "stopped"
                 return code
+            if self.spool_dir is not None:
+                # capture the dead child's journal NOW — the only window
+                # where nobody writes the file — and clear it so the
+                # restarted child's beacons start fresh; the captured
+                # bundle is handed to the router once the restart is
+                # ready (new entries merge over older pending ones)
+                self._pending_spool.update(self._read_spool())
+                self._clear_spool()
             if (
                 self.max_restarts is not None
                 and self.restarts >= self.max_restarts
@@ -346,6 +388,94 @@ class ReplicaSupervisor:
         self._kill_child(term_timeout_s)
         self._event("supervisor_stop", exit_code=self.child.poll())
 
+    # ----------------------------------------------------- spool hand-off
+
+    def _read_spool(self):
+        """{key: wire} from the replica's crash-beacon journal; {} when
+        unarmed/empty. Never raises (a sick spool volume must not stop
+        supervision)."""
+        if self.spool_dir is None:
+            return {}
+        try:
+            from dalle_pytorch_tpu.serving.migrate import (
+                CheckpointSpool,
+                to_wire,
+            )
+
+            spool = CheckpointSpool(self.spool_dir)
+            return {k: to_wire(b) for k, b in spool.read().items()}
+        except Exception as exc:
+            self._event("spool_read_failed", error=repr(exc))
+            return {}
+
+    def _clear_spool(self) -> None:
+        if self.spool_dir is None:
+            return
+        try:
+            from dalle_pytorch_tpu.serving.migrate import CheckpointSpool
+
+            CheckpointSpool(self.spool_dir).clear()
+        except Exception:
+            pass
+
+    def _replica_identity(self) -> Optional[str]:
+        """The supervised replica's fleet identity for spool attribution:
+        `host-port` derived from the health URL — the same name the
+        router derives for a bare replica URL, so `migrated_from` on
+        crash-path resumes joins /debug/replicas instead of carrying a
+        /healthz URL."""
+        if not self.health_url:
+            return None
+        try:
+            from urllib.parse import urlsplit
+
+            parts = urlsplit(self.health_url)
+            return f"{parts.hostname}-{parts.port or 80}"
+        except Exception:
+            return None
+
+    def _post_spool(self, payload: dict) -> None:
+        """The one hand-off socket touch (stubbed in tests): POST the
+        spool bundle to the router's /admin/spool."""
+        req = urllib.request.Request(
+            self.spool_notify_url + "/admin/spool",
+            data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=self.probe_timeout_s):
+            pass
+
+    def _handoff_spool(self) -> None:
+        """Hand the crash-captured journal (`_pending_spool`, read
+        between the dead child's exit and the respawn) to the fleet
+        router. The capture survives an unreachable router — the next
+        ready cycle tries again; it is dropped only after a successful
+        POST (each entry resumes at most once)."""
+        bundle = dict(self._pending_spool)
+        if not bundle or self.spool_notify_url is None:
+            if bundle:
+                self._event(
+                    "spool_handoff_skipped", checkpoints=len(bundle),
+                    reason="no --spool_notify router URL",
+                )
+            return
+        try:
+            self._post_spool({
+                "replica": self._replica_identity(),
+                "checkpoints": bundle,
+            })
+        except Exception as exc:
+            self.spool_handoff_errors += 1
+            self._event(
+                "spool_handoff_failed", checkpoints=len(bundle),
+                error=repr(exc),
+            )
+            return
+        self.spool_handoffs += 1
+        self._event("spool_handoff", checkpoints=len(bundle))
+        self._pending_spool.clear()
+
     # ------------------------------------------------------------- views
 
     def detail(self) -> dict:
@@ -359,6 +489,8 @@ class ReplicaSupervisor:
             "last_exit_reason": self.last_exit_reason,
             "last_ready_s": self.last_ready_s,
             "last_backoff_s": self.last_backoff_s,
+            "spool_handoffs": self.spool_handoffs,
+            "spool_handoff_errors": self.spool_handoff_errors,
         }
 
 
@@ -371,9 +503,22 @@ def supervise_serve(args, argv: Optional[List[str]]) -> int:
     from dalle_pytorch_tpu.obs.logging import StructuredLog
 
     raw = list(sys.argv[1:] if argv is None else argv)
-    child_argv = [sys.executable, os.path.abspath(sys.argv[0])] + [
-        a for a in raw if a != "--supervise"
-    ]
+    # strip the supervisor-only flags: the child is a plain replica (it
+    # keeps --checkpoint_spool — the journal is ITS job; the hand-off
+    # is ours)
+    child: List[str] = []
+    skip = False
+    for a in raw:
+        if skip:
+            skip = False
+            continue
+        if a == "--supervise" or a.startswith("--spool_notify="):
+            continue
+        if a == "--spool_notify":
+            skip = True
+            continue
+        child.append(a)
+    child_argv = [sys.executable, os.path.abspath(sys.argv[0])] + child
     log = StructuredLog(
         component="dalle.supervisor",
         site=getattr(args, "trace_site", None),
@@ -382,6 +527,8 @@ def supervise_serve(args, argv: Optional[List[str]]) -> int:
         child_argv,
         health_url=f"http://{args.host}:{args.port}/healthz",
         log=log,
+        spool_dir=getattr(args, "checkpoint_spool", None),
+        spool_notify_url=getattr(args, "spool_notify", None),
     )
     return _run_with_signals(sup, "supervisor")
 
@@ -417,6 +564,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--crash_loop_window_s", type=float, default=60.0)
     p.add_argument("--hold_down_s", type=float, default=300.0)
     p.add_argument("--ready_timeout_s", type=float, default=900.0)
+    p.add_argument("--spool_dir", type=str, default=None,
+                   help="the replica's --checkpoint_spool directory; "
+                   "after a restart reaches ready, its journaled "
+                   "decode-state checkpoints are handed to the router")
+    p.add_argument("--spool_notify", type=str, default=None, metavar="URL",
+                   help="fleet router base URL to POST the spool to "
+                   "(/admin/spool) after a restart")
     p.add_argument("--site", type=str, default=None,
                    help="structured-log site identity")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -441,6 +595,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         crash_loop_window_s=args.crash_loop_window_s,
         hold_down_s=args.hold_down_s,
         ready_timeout_s=args.ready_timeout_s,
+        spool_dir=args.spool_dir,
+        spool_notify_url=args.spool_notify,
     )
     return _run_with_signals(sup, "supervisor")
 

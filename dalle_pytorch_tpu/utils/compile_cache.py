@@ -116,6 +116,72 @@ def _atomic_write(path: Path, data: bytes) -> None:
     os.replace(tmp, path)
 
 
+# --------------------------------------------------- artifact container
+#
+# The self-validating container format — MAGIC + canonical-JSON header
+# (format version, fingerprint, payload length, payload sha256) + one
+# newline + payload — is shared by the AOT compile-cache artifacts and
+# the decode-state checkpoints (serving/migrate.py). ONE pack/unpack
+# pair keeps the integrity-critical validation in lockstep: a fix to a
+# torn-read edge case reaches both consumers.
+
+
+def pack_artifact(magic: bytes, fingerprint: str, payload: bytes,
+                  format_version: int = FORMAT_VERSION,
+                  extra: Optional[Dict] = None) -> bytes:
+    """Payload -> self-validating blob (the caller picks MAGIC and
+    format version; `extra` adds caller-specific header fields)."""
+    header = {
+        "format": int(format_version),
+        "fingerprint": str(fingerprint),
+        "payload_bytes": len(payload),
+        "payload_sha256": hashlib.sha256(payload).hexdigest(),
+        **(extra or {}),
+    }
+    return bytes(magic) + _canonical(header).encode() + b"\n" + bytes(payload)
+
+
+def unpack_artifact(raw: bytes, magic: bytes, fingerprint: str,
+                    format_version: int = FORMAT_VERSION):
+    """Blob -> (status, reason, payload): "hit" (valid, payload usable),
+    "miss" (a DIFFERENT build's artifact — format or fingerprint drift;
+    expected after any upgrade), or "reject" (integrity failure: bad
+    magic, corrupt header, truncated payload, checksum mismatch —
+    investigate the volume/transport). Never raises."""
+    if not raw.startswith(magic):
+        return "reject", "bad magic", None
+    rest = raw[len(magic):]
+    try:
+        nl = rest.index(b"\n")
+        header = json.loads(rest[:nl])
+    except Exception as exc:
+        return "reject", f"corrupt header: {exc!r}", None
+    payload = rest[nl + 1:]
+    try:
+        if int(header.get("format", -1)) != int(format_version):
+            return (
+                "miss",
+                f"format {header.get('format')} != {format_version}",
+                None,
+            )
+        if header.get("fingerprint") != str(fingerprint):
+            return (
+                "miss",
+                "fingerprint mismatch "
+                f"({header.get('fingerprint')!r} != {str(fingerprint)!r})",
+                None,
+            )
+        if len(payload) != int(header.get("payload_bytes", -1)):
+            return "reject", "truncated payload", None
+        if hashlib.sha256(payload).hexdigest() != header.get(
+            "payload_sha256"
+        ):
+            return "reject", "checksum mismatch", None
+    except Exception as exc:
+        return "reject", f"corrupt header: {exc!r}", None
+    return "hit", None, payload
+
+
 class CompileCache:
     """One directory holding both compile-persistence layers plus the
     boot accounting. Lifecycle:
@@ -276,33 +342,11 @@ class CompileCache:
             return {"status": "miss", "reason": "missing artifact"}
         except Exception as exc:
             return {"status": "reject", "reason": f"unreadable: {exc!r}"}
-        try:
-            if not raw.startswith(MAGIC):
-                return {"status": "reject", "reason": "bad magic"}
-            rest = raw[len(MAGIC):]
-            nl = rest.index(b"\n")
-            header = json.loads(rest[:nl])
-            payload = rest[nl + 1:]
-            if int(header.get("format", -1)) != FORMAT_VERSION:
-                return {
-                    "status": "miss",
-                    "reason": f"format {header.get('format')} != "
-                    f"{FORMAT_VERSION}",
-                }
-            if header.get("fingerprint") != self.fingerprint:
-                return {
-                    "status": "miss",
-                    "reason": "fingerprint mismatch "
-                    f"({header.get('fingerprint')!r} != "
-                    f"{self.fingerprint!r})",
-                }
-            if len(payload) != int(header.get("payload_bytes", -1)):
-                return {"status": "reject", "reason": "truncated payload"}
-            digest = hashlib.sha256(payload).hexdigest()
-            if digest != header.get("payload_sha256"):
-                return {"status": "reject", "reason": "checksum mismatch"}
-        except Exception as exc:
-            return {"status": "reject", "reason": f"corrupt header: {exc!r}"}
+        status, reason, payload = unpack_artifact(
+            raw, MAGIC, self.fingerprint
+        )
+        if status != "hit":
+            return {"status": status, "reason": reason}
         return {"status": "hit", "reason": None, "bytes": len(payload)}
 
     def plan_boot(self) -> Dict:
@@ -394,17 +438,15 @@ class CompileCache:
         backend that can't serialize must not break warmup."""
         try:
             payload = self._serialize(compiled)
-            header = {
-                "format": FORMAT_VERSION,
-                "fingerprint": self.fingerprint,
-                "program": str(program),
-                "payload_bytes": len(payload),
-                "payload_sha256": hashlib.sha256(payload).hexdigest(),
-                "written_at": time.time(),
-            }
             _atomic_write(
                 self.artifact_path(program),
-                MAGIC + _canonical(header).encode() + b"\n" + payload,
+                pack_artifact(
+                    MAGIC, self.fingerprint, payload,
+                    extra={
+                        "program": str(program),
+                        "written_at": time.time(),
+                    },
+                ),
             )
         except Exception as exc:
             self._errors[str(program)] = repr(exc)

@@ -156,6 +156,29 @@ def parse_args(argv=None):
                    "corrupt cache degrades to a normal cold boot, never "
                    "a failed one (counted in "
                    "dalle_boot_cache_{hits,misses,rejects}_total)")
+    p.add_argument("--no_resume", action="store_true",
+                   help="drop the mid-decode resume program from the "
+                   "continuous engine's warmup ladder: migrated/preempted "
+                   "rows then restart decode at position 0 (bit-identical "
+                   "output, more re-decoded work) instead of resuming at "
+                   "their checkpointed position via one teacher-forced "
+                   "re-prefill dispatch")
+    p.add_argument("--checkpoint_spool", type=str, default=None,
+                   metavar="DIR",
+                   help="arm the crash progress beacon: every "
+                   "--spool_every chunks the continuous batcher journals "
+                   "in-flight decode-state checkpoints to DIR (one "
+                   "atomic bounded file); after a crash the supervisor "
+                   "hands the journal to the fleet router so interrupted "
+                   "requests resume instead of re-decoding from scratch")
+    p.add_argument("--spool_every", type=int, default=8,
+                   help="chunk boundaries between beacon writes (a hard "
+                   "kill loses at most this many chunks of journaled "
+                   "progress)")
+    p.add_argument("--spool_notify", type=str, default=None, metavar="URL",
+                   help="with --supervise: fleet router base URL the "
+                   "supervisor POSTs the spool to (/admin/spool) once "
+                   "the restarted replica is ready")
     p.add_argument("--supervise", action="store_true",
                    help="run this replica under the crash-fast "
                    "supervisor: the server becomes a subprocess that is "
@@ -224,6 +247,20 @@ def parse_args(argv=None):
                     "supervisor probes http://host:port/healthz for "
                     "readiness; port 0 would pick a fresh one per "
                     "restart)")
+    if args.spool_notify is not None and not args.supervise:
+        p.error("--spool_notify is the supervisor's hand-off hook; it "
+                "needs --supervise")
+    if args.spool_notify is not None and args.checkpoint_spool is None:
+        p.error("--spool_notify needs --checkpoint_spool (nothing to "
+                "hand over otherwise)")
+    if args.checkpoint_spool is not None and (
+        args.router or args.engine != "continuous"
+    ):
+        p.error("--checkpoint_spool needs --engine continuous (the "
+                "router and the micro engine hold no resumable decode "
+                "state)")
+    if args.spool_every < 1:
+        p.error("--spool_every must be >= 1")
     if args.router:
         if not args.replicas:
             p.error("--router needs --replicas URL[,URL...]")
@@ -361,6 +398,7 @@ def main(argv=None):
             kv_pages=args.kv_pages,
             prefix_entries=args.prefix_entries,
             mesh=args.mesh,
+            resume_enabled=not args.no_resume,
         )
     if cache is not None:
         # identity of this compiled-ladder universe: any drift (jax
@@ -478,6 +516,8 @@ def main(argv=None):
         deadline_shed=not args.no_shed,
         reserve_slots=args.reserve_slots,
         quarantine_after=args.replica_quarantine_after,
+        checkpoint_spool=args.checkpoint_spool,
+        spool_every=args.spool_every,
     )
 
     import threading

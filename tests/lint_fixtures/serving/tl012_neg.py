@@ -1,0 +1,28 @@
+"""TL012 negatives: boundary-guarded snapshots, and snapshots outside
+serving loops — none of these may fire.
+"""
+
+
+def encode_checkpoint(cp, fp):  # stand-in for serving.migrate's codec
+    return b""
+
+
+class GuardedWorker:
+    def run(self):
+        while True:
+            self.engine.step_chunk()
+            if self._migrate_request is not None:
+                # boundary guard: explicit migration handshake
+                toks = self.engine.snapshot_rows(list(self.inflight))
+                self.out = encode_checkpoint(toks, self.fingerprint)
+            if self.spool is not None and self.chunk_index % 8 == 0:
+                # cadence guard: %-expression
+                self.beacon = self.engine.snapshot_rows(range(8))
+            if self.beacon_due():
+                # boundary guard by name
+                self.beacon = encode_checkpoint(self.beacon, self.fp)
+
+    def export_once(self):
+        # not a loop: a one-shot admin export is the designed call shape
+        toks = self.engine.snapshot_rows(list(self.inflight))
+        return encode_checkpoint(toks, self.fingerprint)

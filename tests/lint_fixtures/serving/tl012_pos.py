@@ -1,0 +1,27 @@
+"""TL012 positives: unguarded decode-state snapshots in a serving loop.
+
+Each flagged call reads or serializes decode state on the host on EVERY
+iteration of the worker loop — a per-iteration device sync, the exact
+stall class the chunk-boundary guard exists to prevent.
+"""
+
+
+def encode_checkpoint(cp, fp):  # stand-in for serving.migrate's codec
+    return b""
+
+
+class EagerWorker:
+    def run(self):
+        while True:
+            self.engine.step_chunk()
+            # finding: snapshot every iteration, no boundary guard
+            toks = self.engine.snapshot_rows(list(self.inflight))
+            # finding: serialization every iteration too
+            blob = encode_checkpoint(toks, self.fingerprint)
+            self.buf.append(blob)
+
+    def drain_loop(self):
+        while self.alive:
+            if self.verbose:  # a guard, but not a BOUNDARY guard
+                # finding: `verbose` names no boundary condition
+                self.spool_rows = self.engine.snapshot_rows(range(8))

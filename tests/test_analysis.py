@@ -51,6 +51,7 @@ class TestRuleCorpus:
             ("tl009_pos.py", "TL009", 3),
             ("serving/tl010_pos.py", "TL010", 3),
             ("serving/tl011_pos.py", "TL011", 3),
+            ("serving/tl012_pos.py", "TL012", 3),
         ],
     )
     def test_positive_fixture_caught(self, fixture, code, expected):
@@ -79,6 +80,7 @@ class TestRuleCorpus:
             "tl009_neg.py",
             "serving/tl010_neg.py",
             "serving/tl011_neg.py",
+            "serving/tl012_neg.py",
         ],
     )
     def test_negative_fixture_clean(self, fixture):
@@ -161,6 +163,63 @@ class TestRuleCorpus:
             "        self._p = jax.jit(lambda x: x)\n"
         )
         assert codes(lint_paths([g])) == ["TL011"]
+
+    def test_tl012_scoped_to_serving(self, tmp_path):
+        """The same unguarded snapshot loop outside serving/ is out of
+        scope — only the serving worker runs a chunk loop."""
+        src = (
+            "def f(engine, buf):\n"
+            "    while True:\n"
+            "        buf.append(engine.snapshot_rows(range(4)))\n"
+        )
+        outside = tmp_path / "elsewhere.py"
+        outside.write_text(src)
+        assert lint_paths([outside]).clean
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        inside = serving / "loops.py"
+        inside.write_text(src)
+        assert codes(lint_paths([inside])) == ["TL012"]
+
+    def test_tl012_nested_while_counts_once(self, tmp_path):
+        """An unguarded snapshot in a nested while is ONE finding (the
+        outer loop's scan descends; the inner loop gets no second
+        visit), and an outer boundary guard covers the inner loop."""
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        f = serving / "nested.py"
+        f.write_text(
+            "def f(self):\n"
+            "    while True:\n"
+            "        while self.more:\n"
+            "            bad = self.engine.snapshot_rows(range(4))\n"
+        )
+        assert codes(lint_paths([f])) == ["TL012"]
+        g = serving / "nested_guarded.py"
+        g.write_text(
+            "def f(self):\n"
+            "    while True:\n"
+            "        if self.beacon_due():\n"
+            "            while self.more:\n"
+            "                ok = self.engine.snapshot_rows(range(4))\n"
+        )
+        assert lint_paths([g]).clean
+
+    def test_tl012_else_of_guard_not_covered(self, tmp_path):
+        """The else branch of a boundary guard is NOT at the boundary:
+        a snapshot there still fires."""
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        f = serving / "worker.py"
+        f.write_text(
+            "def f(self):\n"
+            "    while True:\n"
+            "        if self.chunk_boundary():\n"
+            "            ok = self.engine.snapshot_rows(range(4))\n"
+            "        else:\n"
+            "            bad = self.engine.snapshot_rows(range(4))\n"
+        )
+        assert codes(lint_paths([f])) == ["TL012"]
 
     def test_tl010_backoff_in_loop_body_counts(self, tmp_path):
         """The backoff/budget call may live anywhere in the loop, not

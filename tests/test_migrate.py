@@ -1,0 +1,922 @@
+"""Decode-state migration: checkpoint codec, mid-decode resume, drain
+with migration, crash-spool recovery.
+
+The load-bearing contracts:
+
+  * A MIGRATED REQUEST IS THE SAME REQUEST — tokens bit-identical to an
+    unmigrated run, pinned for the slotted and paged engines, including
+    resumes that land mid-flight next to live traffic. The resume path
+    restores completed rows verbatim and continues partial rows from
+    their checkpointed position via one teacher-forced re-prefill
+    (`models/dalle.py:decode_resume`), so it re-decodes strictly fewer
+    tokens than a from-scratch failover.
+  * A BAD CHECKPOINT IS A CLEAN RESTART, NEVER AN ERROR — fingerprint
+    mismatch (different build), corrupt/truncated payload, or a
+    checkpoint inconsistent with its request all degrade to a counted
+    position-0 restart; the client sees a normal 200.
+  * DRAIN?MIGRATE=1 IS A ZERO-LOST-WORK DRAIN — the replica exports
+    every queued + in-flight request at the next chunk boundary (409 +
+    checkpoint per request), and the fleet router re-dispatches each as
+    a resume with full attribution.
+  * THE CRASH SPOOL SURVIVES A SIGKILL — the beacon journal is atomic
+    and bounded; the supervisor hands it to the router, whose failover
+    path resumes from it.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+from dalle_pytorch_tpu.models.dalle import DALLE
+from dalle_pytorch_tpu.obs.tracing import Tracer
+from dalle_pytorch_tpu.serving.batcher import ContinuousBatcher
+from dalle_pytorch_tpu.serving.engine import (
+    ContinuousEngine,
+    PagedContinuousEngine,
+    SampleSpec,
+)
+from dalle_pytorch_tpu.serving.faults import FaultInjector
+from dalle_pytorch_tpu.serving.migrate import (
+    CheckpointCorrupt,
+    CheckpointMismatch,
+    CheckpointSpool,
+    MigratedError,
+    RequestCheckpoint,
+    RowCheckpoint,
+    decode_checkpoint,
+    encode_checkpoint,
+    from_wire,
+    to_wire,
+)
+from dalle_pytorch_tpu.serving.router import (
+    CheckpointRegistry,
+    FleetRouter,
+    RouterServer,
+    parse_request_key,
+)
+from dalle_pytorch_tpu.serving.server import ServingServer
+from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+TEXT_SEQ = 8
+FMAP = 4
+IMG_SEQ = FMAP * FMAP
+
+
+@pytest.fixture(scope="module")
+def toy():
+    model = DALLE(
+        dim=32, depth=2, heads=2, dim_head=8,
+        num_image_tokens=32, image_fmap_size=FMAP,
+        num_text_tokens=64, text_seq_len=TEXT_SEQ,
+        shift_tokens=True, rotary_emb=True,
+    )
+    text = jnp.zeros((1, TEXT_SEQ), jnp.int32)
+    toks = jnp.zeros((1, IMG_SEQ), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(42), text, toks)
+    return model, params
+
+
+def _engine(toy, paged=False, resume=True, max_batch=2, **kw):
+    model, params = toy
+    cls = PagedContinuousEngine if paged else ContinuousEngine
+    if paged:
+        kw.setdefault("page_size", 4)
+    eng = cls(
+        model=model, variables=params, max_batch=max_batch,
+        chunk_tokens=2, prefill_batch=max_batch,
+        registry=MetricsRegistry(), resume_enabled=resume, **kw,
+    )
+    eng.tokenizer = ByteTokenizer()
+    return eng
+
+
+def _cp(rows=None, **kw):
+    if rows is None:
+        rows = [RowCheckpoint(
+            row_index=0,
+            prompt_ids=np.arange(TEXT_SEQ, dtype=np.int32),
+            tokens=np.asarray([3, 1, 4], np.int32),
+            done=False, seed=7, temperature=0.9, top_k=0.8,
+        )]
+    kw.setdefault("chunk_index", 5)
+    kw.setdefault("priority", "normal")
+    kw.setdefault("site", "replica-a")
+    kw.setdefault("request_key", "abc123")
+    return RequestCheckpoint(rows=rows, **kw)
+
+
+# ------------------------------------------------------------------ codec
+
+
+class TestCodec:
+    def test_round_trip(self):
+        cp = _cp(rows=[
+            RowCheckpoint(0, np.arange(TEXT_SEQ, dtype=np.int32),
+                          np.arange(IMG_SEQ, dtype=np.int32), True, 11,
+                          0.7, 0.95),
+            RowCheckpoint(1, np.arange(TEXT_SEQ, dtype=np.int32),
+                          np.asarray([5, 9], np.int32), False, 12),
+        ], tenant="t1", trace_id="deadbeefdeadbeef")
+        blob = encode_checkpoint(cp, "fp-1")
+        back = decode_checkpoint(blob, "fp-1")
+        assert len(back.rows) == 2
+        assert back.rows[0].done and back.rows[0].pos == IMG_SEQ
+        assert back.rows[1].pos == 2 and not back.rows[1].done
+        np.testing.assert_array_equal(
+            back.rows[0].tokens, np.arange(IMG_SEQ)
+        )
+        np.testing.assert_array_equal(
+            back.rows[1].prompt_ids, np.arange(TEXT_SEQ)
+        )
+        assert (back.rows[1].seed, back.rows[0].temperature) == (12, 0.7)
+        assert back.chunk_index == 5 and back.site == "replica-a"
+        assert back.tenant == "t1" and back.request_key == "abc123"
+        assert back.done_tokens() == IMG_SEQ  # partial rows don't count
+        # wire transport round-trips the exact bytes
+        assert from_wire(to_wire(blob)) == blob
+
+    def test_fingerprint_mismatch_raises_mismatch(self):
+        blob = encode_checkpoint(_cp(), "fp-build-1")
+        with pytest.raises(CheckpointMismatch):
+            decode_checkpoint(blob, "fp-build-2")
+
+    def test_truncated_and_garbled_raise_corrupt(self):
+        blob = encode_checkpoint(_cp(), "fp")
+        with pytest.raises(CheckpointCorrupt):
+            decode_checkpoint(blob[:-3], "fp")  # truncated payload
+        garbled = bytearray(blob)
+        garbled[-5] ^= 0xFF
+        with pytest.raises(CheckpointCorrupt):
+            decode_checkpoint(bytes(garbled), "fp")  # checksum
+        with pytest.raises(CheckpointCorrupt):
+            decode_checkpoint(b"NOTMAGIC" + blob, "fp")
+        with pytest.raises(CheckpointCorrupt):
+            from_wire("!!! not base64 !!!")
+
+    def test_format_drift_is_mismatch_not_corrupt(self):
+        import dalle_pytorch_tpu.serving.migrate as mig
+
+        blob = encode_checkpoint(_cp(), "fp")
+        # rewrite the header with a bumped format version, keeping the
+        # checksum valid — an OLD reader of a NEW checkpoint must see a
+        # clean mismatch (counted cold restart), not a parse error
+        rest = blob[len(mig.CKPT_MAGIC):]
+        nl = rest.index(b"\n")
+        header = json.loads(rest[:nl])
+        header["format"] = mig.CKPT_FORMAT + 1
+        blob2 = (
+            mig.CKPT_MAGIC
+            + json.dumps(header, sort_keys=True,
+                         separators=(",", ":")).encode()
+            + b"\n" + rest[nl + 1:]
+        )
+        with pytest.raises(CheckpointMismatch):
+            decode_checkpoint(blob2, "fp")
+
+
+# ------------------------------------------------------------------ spool
+
+
+class TestSpool:
+    def test_write_read_clear(self, tmp_path):
+        spool = CheckpointSpool(tmp_path)
+        blob = encode_checkpoint(_cp(), "fp")
+        spool.write({"k1": blob, "k2": blob})
+        assert spool.read() == {"k1": blob, "k2": blob}
+        # latest-state-only: a new write REPLACES the journal
+        spool.write({"k3": blob})
+        assert set(spool.read()) == {"k3"}
+        spool.clear()
+        assert spool.read() == {}
+
+    def test_corrupt_entry_skipped_via_fault_seam(self, tmp_path):
+        spool = CheckpointSpool(tmp_path)
+        blob = encode_checkpoint(_cp(), "fp")
+        spool.write({"k1": blob})
+        spool.faults = FaultInjector().corrupt_cache("spool", mode="truncate")
+        out = spool.read()  # truncated tail line is skipped, not fatal
+        assert out == {} or all(v == blob for v in out.values())
+        assert spool.faults.fired
+
+    def test_byte_cap_drops_largest_first(self, tmp_path):
+        small = encode_checkpoint(_cp(), "fp")
+        big = encode_checkpoint(_cp(rows=[
+            RowCheckpoint(0, np.arange(TEXT_SEQ, dtype=np.int32),
+                          np.zeros(IMG_SEQ, np.int32), True, 1)
+            for _ in range(64)
+        ]), "fp")
+        cap = int(len(to_wire(small)) * 3)
+        spool = CheckpointSpool(tmp_path, max_bytes=cap + 256)
+        spool.write({"small": small, "big": big})
+        kept = spool.read()
+        assert "small" in kept and "big" not in kept
+        assert spool.dropped_entries == 1
+
+
+# -------------------------------------------------- batcher-level export
+
+
+def _submit(batcher, specs, **kw):
+    return batcher.submit(specs, timeout_s=60, **kw)
+
+
+def _specs(n=1, seed=100, text=None):
+    if text is None:
+        text = np.arange(TEXT_SEQ, dtype=np.int32) % 5 + 1
+    return [
+        SampleSpec(text_ids=text, seed=seed + i) for i in range(n)
+    ]
+
+
+def _hold_mid_decode(eng, nth=3, seconds=2.0):
+    """Deterministically park the worker INSIDE chunk dispatch `nth`
+    (a few chunks of real progress first), so the test can request an
+    export that is guaranteed to find the request mid-decode at the
+    next boundary."""
+    eng.faults = FaultInjector().stall_nth("chunk", nth, seconds=seconds)
+
+
+def _wait_fired(eng, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while not eng.faults.fired and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert eng.faults.fired, "stall rule never fired"
+
+
+class TestMigrateOut:
+    def test_drain_exports_inflight_and_queued(self, toy):
+        eng = _engine(toy, max_batch=2)
+        batcher = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            # two in-flight rows + one queued request (no free slots);
+            # the stall pins the request mid-decode while we drain
+            _hold_mid_decode(eng)
+            r1 = _submit(batcher, _specs(2, seed=200))
+            _wait_fired(eng)
+            assert batcher.inflight_rows == 2
+            r2 = _submit(batcher, _specs(1, seed=300))
+            cps = batcher.migrate_out(timeout_s=30)
+            assert cps is not None and len(cps) == 2
+            for req in (r1, r2):
+                with pytest.raises(MigratedError) as e:
+                    req.future.result(timeout=10)
+                cp = e.value.checkpoint
+                assert all(not row.done for row in cp.rows)
+            # the in-flight request's rows carry real decode progress
+            cp1 = next(
+                e for e in cps if len(e.rows) == 2
+            )
+            assert any(row.pos > 0 for row in cp1.rows)
+            # slots freed; the batcher serves new work afterwards
+            assert batcher.inflight_rows == 0
+            r3 = _submit(batcher, _specs(1, seed=400))
+            toks, _ = r3.future.result(timeout=60)
+            assert toks.shape == (1, IMG_SEQ)
+        finally:
+            batcher.shutdown(drain=False)
+
+    def test_idle_migrate_returns_empty(self, toy):
+        eng = _engine(toy, max_batch=2)
+        batcher = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            assert batcher.migrate_out(timeout_s=10) == []
+        finally:
+            batcher.shutdown(drain=False)
+
+    def test_peek_checkpoints_nondestructive(self, toy):
+        eng = _engine(toy, max_batch=2)
+        batcher = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            _hold_mid_decode(eng)
+            req = _submit(batcher, _specs(1, seed=500))
+            _wait_fired(eng)
+            cps = batcher.peek_checkpoints(timeout_s=30)
+            assert cps is not None and len(cps) == 1
+            # the request keeps decoding here and completes normally
+            toks, _ = req.future.result(timeout=60)
+            assert toks.shape == (1, IMG_SEQ)
+        finally:
+            batcher.shutdown(drain=False)
+
+
+# ------------------------------------------- resume bit-identity (engines)
+
+
+def _reference(toy, paged, specs):
+    eng = _engine(toy, paged=paged, resume=False, max_batch=len(specs))
+    batcher = ContinuousBatcher(eng, registry=eng.registry)
+    try:
+        req = _submit(batcher, specs)
+        toks, _ = req.future.result(timeout=120)
+        return np.asarray(toks)
+    finally:
+        batcher.shutdown(drain=False)
+
+
+def _clone_specs(specs):
+    return [
+        SampleSpec(text_ids=s.text_ids, seed=s.seed,
+                   temperature=s.temperature, top_k=s.top_k)
+        for s in specs
+    ]
+
+
+class TestResumeBitIdentity:
+    @pytest.mark.parametrize("paged", [False, True])
+    def test_migrated_resume_bit_identical(self, toy, paged):
+        """Export mid-decode from one batcher, resume on a FRESH engine
+        via submit(resume=...) — final tokens equal the unmigrated run,
+        and the resumed engine re-decodes strictly fewer tokens."""
+        specs = [
+            SampleSpec(np.arange(TEXT_SEQ, dtype=np.int32) % 5 + 1,
+                       seed=41, temperature=0.8),
+            SampleSpec((np.arange(TEXT_SEQ, dtype=np.int32) * 3) % 7 + 1,
+                       seed=42),
+        ]
+        ref = _reference(toy, paged, specs)
+
+        eng_a = _engine(toy, paged=paged, max_batch=2)
+        ba = ContinuousBatcher(eng_a, registry=eng_a.registry)
+        try:
+            _hold_mid_decode(eng_a)
+            req = _submit(ba, _clone_specs(specs))
+            _wait_fired(eng_a)
+            cps = ba.migrate_out(timeout_s=30)
+            assert cps and len(cps) == 1
+            with pytest.raises(MigratedError):
+                req.future.result(timeout=10)
+            cp = cps[0]
+            assert any(0 < r.pos < IMG_SEQ for r in cp.rows), (
+                "drain did not catch the request mid-decode"
+            )
+        finally:
+            ba.shutdown(drain=False)
+
+        # wire round-trip through the codec, like the router would
+        fp = eng_a.resume_fingerprint()
+        cp2 = decode_checkpoint(
+            from_wire(to_wire(encode_checkpoint(cp, fp))), fp
+        )
+
+        eng_b = _engine(toy, paged=paged, max_batch=2)
+        assert eng_b.resume_fingerprint() == fp
+        bb = ContinuousBatcher(eng_b, registry=eng_b.registry)
+        try:
+            req2 = bb.submit(
+                _clone_specs(specs), timeout_s=120, resume=cp2,
+                resume_bytes=128,
+            )
+            toks, _ = req2.future.result(timeout=120)
+            np.testing.assert_array_equal(np.asarray(toks), ref)
+            decoded = int(
+                eng_b.registry.get(
+                    "dalle_serving_decoded_tokens_total"
+                ).value
+            )
+            restored = sum(r.pos for r in cp2.rows)
+            assert decoded <= 2 * IMG_SEQ - restored, (
+                f"resume re-decoded {decoded} tokens; expected at most "
+                f"{2 * IMG_SEQ - restored} (restored {restored})"
+            )
+            resumed = int(
+                eng_b.registry.get(
+                    "dalle_serving_resumed_tokens_total"
+                ).value
+            )
+            assert resumed == restored
+        finally:
+            bb.shutdown(drain=False)
+
+    def test_resume_mid_flight_next_to_live_traffic(self, toy):
+        """A resume admitted while another request is decoding: both
+        complete bit-identically (the composition-invariance contract
+        extends to the resume program)."""
+        specs_a = [SampleSpec(
+            np.arange(TEXT_SEQ, dtype=np.int32) % 6 + 1, seed=61,
+        )]
+        specs_b = [SampleSpec(
+            (np.arange(TEXT_SEQ, dtype=np.int32) * 2) % 6 + 1, seed=62,
+        )]
+        ref_a = _reference(toy, False, specs_a)
+        ref_b = _reference(toy, False, specs_b)
+
+        # build a checkpoint for A at position 4 from the reference
+        cp = RequestCheckpoint(rows=[RowCheckpoint(
+            0, specs_a[0].text_ids, np.asarray(ref_a[0][:4], np.int32),
+            False, 61,
+        )], chunk_index=2, site="elsewhere")
+
+        eng = _engine(toy, max_batch=2)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            live = _submit(b, _clone_specs(specs_b))
+            deadline = time.monotonic() + 30
+            while b.inflight_rows < 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            resumed = b.submit(
+                _clone_specs(specs_a), timeout_s=120, resume=cp,
+            )
+            toks_a, _ = resumed.future.result(timeout=120)
+            toks_b, _ = live.future.result(timeout=120)
+            np.testing.assert_array_equal(np.asarray(toks_a), ref_a)
+            np.testing.assert_array_equal(np.asarray(toks_b), ref_b)
+        finally:
+            b.shutdown(drain=False)
+
+    def test_fully_done_checkpoint_completes_without_decode(self, toy):
+        ref = _reference(toy, False, _specs(1, seed=77))
+        cp = RequestCheckpoint(rows=[RowCheckpoint(
+            0, _specs(1, seed=77)[0].text_ids,
+            np.asarray(ref[0], np.int32), True, 77,
+        )], site="elsewhere")
+        eng = _engine(toy, max_batch=2)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            req = b.submit(_specs(1, seed=77), timeout_s=60, resume=cp)
+            toks, _ = req.future.result(timeout=60)
+            np.testing.assert_array_equal(np.asarray(toks), ref)
+            assert int(eng.registry.get(
+                "dalle_serving_decoded_tokens_total"
+            ).value) == 0
+        finally:
+            b.shutdown(drain=False)
+
+    def test_preemption_uses_resume_path_when_supported(self, toy):
+        """On a resume-capable engine a preempted low request re-enters
+        at its preempted position — resumed tokens counted, output
+        bit-identical to the undisturbed run."""
+        ref = _reference(toy, False, _specs(2, seed=88))
+        eng = _engine(toy, max_batch=2)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            # hold the low request mid-decode so the high arrival
+            # deterministically finds it occupying both slots
+            _hold_mid_decode(eng, nth=2, seconds=1.0)
+            low = b.submit(
+                _specs(2, seed=88), timeout_s=120, priority="low",
+            )
+            _wait_fired(eng)
+            high = b.submit(
+                _specs(1, seed=99), timeout_s=120, priority="high",
+            )
+            toks_h, _ = high.future.result(timeout=120)
+            toks_l, _ = low.future.result(timeout=120)
+            np.testing.assert_array_equal(np.asarray(toks_l), ref)
+            assert low.preemptions >= 1
+        finally:
+            b.shutdown(drain=False)
+
+
+# -------------------------------------------------------- HTTP + routers
+
+
+def _http(method, port, path, body=None, timeout=60, headers=None):
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+def _server(toy, paged=False, **kw):
+    eng = _engine(toy, paged=paged, max_batch=2)
+    return eng, ServingServer(
+        eng, port=0, request_timeout_s=60,
+        tracer=Tracer(max_traces=32), **kw,
+    ).start()
+
+
+class TestHTTPMigration:
+    def test_drain_migrate_409_and_resume_on_second_replica(self, toy):
+        eng_a, srv_a = _server(toy)
+        eng_b, srv_b = _server(toy)
+        try:
+            body = {"prompt": "red circle", "seed": 321, "num_images": 2,
+                    "timeout_s": 60}
+            status, ref = _http("POST", srv_b.port, "/generate", body)
+            assert status == 200
+
+            out = {}
+
+            def client():
+                try:
+                    out["resp"] = _http(
+                        "POST", srv_a.port, "/generate", body,
+                    )
+                except urllib.error.HTTPError as exc:
+                    out["code"] = exc.code
+                    out["body"] = json.loads(exc.read() or b"{}")
+
+            _hold_mid_decode(eng_a)
+            t = threading.Thread(target=client)
+            t.start()
+            _wait_fired(eng_a)
+            status, drain = _http(
+                "POST", srv_a.port, "/admin/drain?migrate=1", body={},
+            )
+            assert status == 200
+            assert drain["migrate"]["supported"] is True
+            assert drain["migrate"]["migrated"] == 1
+            assert drain["quiesced"] is True
+            t.join(timeout=30)
+            # the in-flight client got the 409 + checkpoint
+            assert out.get("code") == 409
+            assert out["body"]["migrated"] is True
+            wire = out["body"]["checkpoint"]
+
+            # resume on replica B: bit-identical to B's own reference
+            status, payload = _http(
+                "POST", srv_b.port, "/generate",
+                {**body, "resume": wire},
+            )
+            assert status == 200
+            assert payload["tokens"] == ref["tokens"]
+        finally:
+            srv_a.shutdown()
+            srv_b.shutdown()
+
+    @pytest.mark.parametrize(
+        "mangle, reason",
+        [
+            (lambda w: to_wire(b"NOTMAGIC" + from_wire(w)), "corrupt"),
+            (lambda w: w, "mismatch"),  # re-encoded under a fake fp below
+            (lambda w: w, "inconsistent"),  # body mutated below
+        ],
+    )
+    def test_bad_resume_degrades_to_clean_restart(self, toy, mangle,
+                                                  reason):
+        eng, srv = _server(toy)
+        try:
+            body = {"prompt": "blue square", "seed": 555, "timeout_s": 60}
+            status, ref = _http("POST", srv.port, "/generate", body)
+            assert status == 200
+
+            # a plausible checkpoint for this request
+            text_ids = eng.tokenize("blue square")
+            cp = RequestCheckpoint(rows=[RowCheckpoint(
+                0, text_ids, np.asarray(ref["tokens"][0][:3], np.int32),
+                False, 555,
+            )], site="x")
+            if reason == "mismatch":
+                wire = to_wire(encode_checkpoint(cp, "some-other-build"))
+                req_body = {**body, "resume": wire}
+            elif reason == "inconsistent":
+                wire = to_wire(
+                    encode_checkpoint(cp, srv.resume_fingerprint)
+                )
+                # same checkpoint, different seed -> must NOT resume
+                req_body = {**body, "seed": 556, "resume": wire}
+            else:
+                wire = mangle(to_wire(
+                    encode_checkpoint(cp, srv.resume_fingerprint)
+                ))
+                req_body = {**body, "resume": wire}
+            status, payload = _http(
+                "POST", srv.port, "/generate", req_body,
+            )
+            assert status == 200  # never a client-visible error
+            if reason != "inconsistent":  # same seed: same tokens
+                assert payload["tokens"] == ref["tokens"]
+            fam = srv.registry.get("dalle_serving_resume_rejects_total")
+            counts = {label: int(c.value) for label, c in fam.items()}
+            assert counts.get(reason) == 1, counts
+        finally:
+            srv.shutdown()
+
+    def test_admin_checkpoints_pull(self, toy):
+        eng, srv = _server(toy)
+        try:
+            body = {"prompt": "pull", "seed": 777, "timeout_s": 60}
+            _hold_mid_decode(eng)
+            t = threading.Thread(
+                target=lambda: _http("POST", srv.port, "/generate", body),
+            )
+            t.start()
+            _wait_fired(eng)
+            status, out = _http("GET", srv.port, "/admin/checkpoints")
+            assert status == 200
+            assert out["count"] == 1
+            (wire,) = out["checkpoints"].values()
+            cp = decode_checkpoint(
+                from_wire(wire), srv.resume_fingerprint
+            )
+            assert len(cp.rows) == 1
+            t.join(timeout=60)
+        finally:
+            srv.shutdown()
+
+
+class TestRouterMigration:
+    def _fleet(self, toy, **router_kw):
+        engs, servers = [], []
+        for _ in range(2):
+            e, s = _server(toy)
+            engs.append(e)
+            servers.append(s)
+        router = FleetRouter(
+            [f"r{i}=http://127.0.0.1:{s.port}"
+             for i, s in enumerate(servers)],
+            registry=MetricsRegistry(), **router_kw,
+        )
+        front = RouterServer(router, port=0, probes=False).start()
+        return engs, servers, router, front
+
+    def test_drain_migrate_redispatches_bit_identical(self, toy):
+        engs, servers, router, front = self._fleet(toy)
+        try:
+            port = front.port
+            body = {"prompt": "drain me", "seed": 901, "num_images": 2,
+                    "timeout_s": 60}
+            status, ref = _http("POST", port, "/generate", body)
+            assert status == 200
+
+            results = []
+
+            def client():
+                results.append(_http("POST", port, "/generate", body))
+
+            # park the request mid-decode on whichever replica gets it
+            # (stalls armed AFTER the reference pass), then drain the
+            # holder with migrate — the router must re-dispatch the 409
+            # as a resume and answer 200
+            for e in engs:
+                _hold_mid_decode(e, seconds=4.0)
+            t = threading.Thread(target=client)
+            t.start()
+            deadline = time.monotonic() + 30
+            while not any(e.faults.fired for e in engs) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            holder = 0 if engs[0].faults.fired else 1
+            # disarm the other replica's stall so the resume runs clean
+            engs[1 - holder].faults = None
+            detail = router.drain(f"r{holder}", wait_s=30.0, migrate=True)
+            assert detail["mode"] == "drained"
+            t.join(timeout=60)
+            assert results and results[0][0] == 200
+            assert results[0][1]["tokens"] == ref["tokens"]
+            migs = {
+                label: int(c.value)
+                for label, c in router.registry.get(
+                    "dalle_router_migrations_total"
+                ).items()
+            }
+            assert migs.get("drain", 0) >= 1
+            # the resuming replica restored tokens instead of re-decoding
+            other = 1 - holder
+            resumed = int(engs[other].registry.get(
+                "dalle_serving_resumed_tokens_total"
+            ).value)
+            assert resumed > 0
+            # attribution: /debug/replicas carries the migration block
+            assert router.detail()["migration"]["migrations"].get(
+                "drain", 0
+            ) >= 1
+        finally:
+            front.shutdown()
+            for s in servers:
+                s.shutdown()
+
+    def test_spool_ingest_feeds_crash_failover(self, toy):
+        """Transport-failed request + spooled checkpoint => the
+        re-dispatch resumes (reason=crash) and completes bit-identically."""
+        engs, servers, router, front = self._fleet(
+            toy, migrate_wait_s=5.0,
+        )
+        try:
+            port = front.port
+            body = {"prompt": "crash me", "seed": 911, "num_images": 2,
+                    "timeout_s": 60}
+            status, ref = _http("POST", port, "/generate", body)
+            assert status == 200
+
+            from dalle_pytorch_tpu.serving.router import (
+                request_fingerprint,
+            )
+
+            qkey = request_fingerprint(dict(body))
+            # build the checkpoint the dead replica would have spooled
+            text_ids = engs[0].tokenize("crash me")
+            cp = RequestCheckpoint(rows=[
+                RowCheckpoint(
+                    i, text_ids,
+                    np.asarray(ref["tokens"][i][:6], np.int32),
+                    False, 911 + i,
+                )
+                for i in range(2)
+            ], chunk_index=3, site="r0", request_key=qkey)
+            wire = to_wire(encode_checkpoint(
+                cp, servers[0].resume_fingerprint,
+            ))
+
+            # the next dispatch goes to the replica with FEWER total
+            # requests (the least-outstanding tie-break) — kill exactly
+            # that one, so the request meets ECONNREFUSED first
+            victim = min(
+                range(2), key=lambda i: router.replicas[i].requests
+            )
+            live = 1 - victim
+            servers[victim].shutdown(drain=False)
+            # the supervisor hand-off already landed (crash recovery is
+            # registry-consult-first; the parked-wait flavor is pinned
+            # by TestRouterMigration.test_checkpoint_registry_*)
+            status, out = _http("POST", port, "/admin/spool", {
+                "replica": f"r{victim}",
+                "checkpoints": {qkey: wire, "bad/key": wire},
+            })
+            assert status == 200 and out["ingested"] == 1  # bad key skipped
+
+            status, payload = _http(
+                "POST", port, "/generate", body, timeout=90,
+            )
+            assert status == 200
+            assert payload["tokens"] == ref["tokens"]
+            migs = {
+                label: int(c.value)
+                for label, c in router.registry.get(
+                    "dalle_router_migrations_total"
+                ).items()
+            }
+            assert migs.get("crash", 0) >= 1
+            # the resuming replica restored the spooled prefixes
+            assert int(engs[live].registry.get(
+                "dalle_serving_resumed_tokens_total"
+            ).value) == 12
+        finally:
+            front.shutdown()
+            for i, s in enumerate(servers):
+                s.shutdown()
+
+    def test_request_key_header_round_trip(self):
+        assert parse_request_key("abc-DEF_123.x") == "abc-DEF_123.x"
+        assert parse_request_key(" padded ") == "padded"
+        assert parse_request_key("bad/slash") is None
+        assert parse_request_key("") is None
+        assert parse_request_key(None) is None
+        assert parse_request_key("x" * 65) is None
+
+    def test_checkpoint_registry_bounds_and_waiters(self):
+        reg = CheckpointRegistry(capacity=2)
+        reg.put("a", "wa")
+        reg.put("b", "wb")
+        reg.put("c", "wc")  # evicts oldest
+        assert reg.take("a") is None
+        assert reg.take("b")["wire"] == "wb"
+        assert reg.take("b") is None  # consumed at most once
+
+        got = {}
+
+        def waiter():
+            got["e"] = reg.wait_for("k", timeout_s=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        reg.put("k", "wk", source="r9")
+        t.join(timeout=5)
+        assert got["e"]["wire"] == "wk" and got["e"]["source"] == "r9"
+        assert reg.wait_for("nope", timeout_s=0.05) is None
+
+
+# ------------------------------------------------------- supervisor spool
+
+
+class TestSupervisorHandoff:
+    def test_restart_hands_spool_to_router_and_clears(self, tmp_path):
+        from dalle_pytorch_tpu.serving.supervisor import ReplicaSupervisor
+
+        spool = CheckpointSpool(tmp_path)
+        blob = encode_checkpoint(_cp(), "fp")
+
+        posted = []
+
+        class Proc:
+            def __init__(self):
+                self.returncode = None
+                self._polls = 0
+
+            def poll(self):
+                return self.returncode
+
+            def wait(self, timeout=None):
+                if self.returncode is None:
+                    raise __import__("subprocess").TimeoutExpired("x", 0.1)
+                return self.returncode
+
+            def terminate(self):
+                self.returncode = 0
+
+            def kill(self):
+                self.returncode = -9
+
+        procs = []
+
+        def crash_after_journal(p):
+            # the "child" journals its in-flight checkpoints (the beacon
+            # would) AFTER the supervisor's first-boot stale-spool clear,
+            # then dies abnormally
+            time.sleep(0.2)
+            spool.write({"key1": blob})
+            p.returncode = 70
+
+        def spawn():
+            p = Proc()
+            procs.append(p)
+            if len(procs) == 1:
+                threading.Thread(
+                    target=crash_after_journal, args=(p,), daemon=True,
+                ).start()
+            return p
+
+        sup = ReplicaSupervisor(
+            ["fake"], spawn_fn=spawn, probe_fn=lambda: True,
+            backoff_base_s=0.05, backoff_max_s=0.1,
+            spool_dir=tmp_path, spool_notify_url="http://router:1",
+            max_restarts=1,
+        )
+        sup._post_spool = lambda payload: posted.append(payload)
+
+        t = threading.Thread(target=sup.run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 15
+        while not posted and time.monotonic() < deadline:
+            time.sleep(0.02)
+        sup.stop()
+        t.join(timeout=10)
+        assert posted, "restart never handed the spool over"
+        assert posted[0]["checkpoints"] == {"key1": to_wire(blob)}
+        assert sup.spool_handoffs == 1
+        assert spool.read() == {}  # cleared after a successful hand-off
+
+    def test_first_boot_clears_stale_spool(self, tmp_path):
+        from dalle_pytorch_tpu.serving.supervisor import ReplicaSupervisor
+
+        spool = CheckpointSpool(tmp_path)
+        spool.write({"stale": encode_checkpoint(_cp(), "fp")})
+
+        class Proc:
+            returncode = None
+
+            def poll(self):
+                return self.returncode
+
+            def wait(self, timeout=None):
+                if self.returncode is None:
+                    raise __import__("subprocess").TimeoutExpired("x", 0.1)
+                return self.returncode
+
+            def terminate(self):
+                self.returncode = 0
+
+            def kill(self):
+                self.returncode = -9
+
+        posted = []
+        sup = ReplicaSupervisor(
+            ["fake"], spawn_fn=Proc, probe_fn=lambda: True,
+            spool_dir=tmp_path, spool_notify_url="http://router:1",
+        )
+        sup._post_spool = lambda payload: posted.append(payload)
+        t = threading.Thread(target=sup.run, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 10
+        while spool.read() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        sup.stop()
+        t.join(timeout=10)
+        assert spool.read() == {}  # stale journal cleared, not handed over
+        assert not posted
+
+
+# ----------------------------------------------------------- spool beacon
+
+
+class TestBeacon:
+    def test_beacon_journals_at_cadence(self, toy, tmp_path):
+        eng = _engine(toy, max_batch=2)
+        spool = CheckpointSpool(tmp_path)
+        batcher = ContinuousBatcher(
+            eng, registry=eng.registry, spool=spool, spool_every=2,
+        )
+        batcher.checkpoint_fingerprint = "beacon-fp"
+        try:
+            req = _submit(batcher, _specs(1, seed=888))
+            toks, _ = req.future.result(timeout=60)
+            assert spool.writes >= 1
+            assert batcher.last_beacon is not None
+            # mid-flight beacons carried the in-flight request; decode
+            # progressed between beacons, so SOME write held a prefix
+            assert batcher.last_beacon["chunk_index"] > 0
+        finally:
+            batcher.shutdown(drain=False)

@@ -364,6 +364,7 @@ class TestWarmupLadderExport:
         assert not hasattr(eng, "program_ladder")
         stub = object.__new__(ContinuousEngine)
         stub.vae = None  # tokens-only engine never compiles decode_pixels
+        stub.resume_enabled = False
         assert ContinuousEngine.program_ladder(stub) == (
             "prefill", "chunk", "release",
         )
@@ -373,6 +374,13 @@ class TestWarmupLadderExport:
         )
         assert ContinuousEngine.program_ladder(stub) == (
             "prefill", "chunk", "release", "decode_pixels",
+        )
+        # decode-state resume grows the ladder (and with it the boot
+        # fingerprint): a resume-enabled build must never claim another
+        # build's warm cache
+        stub.resume_enabled = True
+        assert ContinuousEngine.program_ladder(stub) == (
+            "prefill", "resume", "chunk", "release", "decode_pixels",
         )
 
 

@@ -926,8 +926,17 @@ class TestChaosRealReplicas:
         load: every request still completes, re-dispatched requests
         return bit-identical tokens, and once the wedged replica is
         hard-killed (socket gone, ECONNREFUSED) the fleet keeps
-        serving."""
-        servers, router, front = self._fleet(toy, attempt_timeout_s=2.0)
+        serving.
+
+        Deflaked (the PR 12 contention flake): the wedge is an
+        EVENT-HELD stall (released in the teardown) instead of a 6s
+        sleep, so a contention-stretched run can never see the wedged
+        replica come back mid-assertion; and the attempt timeout is 4s
+        (toy decode is ~100x faster), so a slow healthy replica under
+        CPU contention is not misread as wedged — the budgets no longer
+        ride on wall-clock races."""
+        servers, router, front = self._fleet(toy, attempt_timeout_s=4.0)
+        unwedge = threading.Event()
         try:
             port = front.port
             seeds = [101, 102, 103, 104]
@@ -943,11 +952,12 @@ class TestChaosRealReplicas:
                 assert status == 200
                 refs[body["seed"]] = payload["tokens"]
 
-            # wedge replica 0: its next chunk dispatch stalls well past
-            # the router's attempt timeout, freezing every row it holds
-            # MID-DECODE; requests routed there must fail over
+            # wedge replica 0: its next chunk dispatch holds until the
+            # test releases it — longer than any attempt timeout by
+            # construction — freezing every row it holds MID-DECODE;
+            # requests routed there must fail over
             servers[0].engine.faults = FaultInjector().stall_nth(
-                "chunk", 1, seconds=6.0
+                "chunk", 1, until=unwedge
             )
 
             results = {}
@@ -983,7 +993,9 @@ class TestChaosRealReplicas:
             ) >= 1, "no request ever timed out off the wedged replica"
 
             # escalate: hard socket kill of the wedged replica
-            # (ECONNREFUSED from now on) — the fleet must keep serving
+            # (ECONNREFUSED from now on) — the fleet must keep serving.
+            # Release the wedge first so the worker thread can exit.
+            unwedge.set()
             servers[0].shutdown(drain=False)
             for seed in (201, 202):
                 status, payload = _post_generate(
@@ -992,6 +1004,7 @@ class TestChaosRealReplicas:
                 )
                 assert status == 200
         finally:
+            unwedge.set()
             front.shutdown()
             for s in servers[1:]:
                 s.shutdown()
