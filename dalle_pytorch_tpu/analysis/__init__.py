@@ -3,7 +3,12 @@
 Catches the hazard classes the serving/training stack's performance story
 depends on keeping out — recompilation (TL001), hidden host syncs (TL002),
 donated-buffer reuse (TL003), PRNG key reuse (TL004), dtype drift (TL005),
-debugger artifacts (TL006), and scan-body host-constant captures (TL007)
+debugger artifacts (TL006), scan-body host-constant captures (TL007),
+mesh-axis typos (TL008), span leaks (TL009), serving retry/warmup/
+snapshot discipline (TL010-TL012), and the thread-model concurrency
+rules over the serving fleet (TL013 unguarded shared state, TL014
+iterate-while-mutated, TL015 lock-order inversion, TL016
+blocking-under-lock; `analysis/threadctx.py` is the index underneath)
 — before they ship. Run it with
 
     python -m dalle_pytorch_tpu.analysis        # or: dalle-tpu-lint

@@ -16,6 +16,11 @@ Suppression  `# tracelint: disable=TL001[,TL002] -- <reason>` on the
 Hot loop     `# tracelint: hotloop` on (or directly above) a `def` marks a
              host-side function as latency-critical: TL002 then treats any
              device->host sync inside it as a finding needing justification.
+Threads      `# tracelint: threads` on (or directly above) a `class` marks
+             it as concurrently shared (its public methods are entered
+             from many threads at once — the ThreadingHTTPServer handler
+             fan-in); the thread-model rules (TL013+) then treat each
+             public method as its own concurrent root.
 """
 
 from __future__ import annotations
@@ -36,6 +41,7 @@ _SUPPRESS_RE = re.compile(
     r"(?:\s*--\s*(?P<reason>\S.*))?"
 )
 _HOTLOOP_RE = re.compile(r"#\s*tracelint:\s*hotloop\b")
+_THREADS_RE = re.compile(r"#\s*tracelint:\s*threads\b")
 
 
 @dataclass(frozen=True)
@@ -118,6 +124,11 @@ class FileContext:
         self.tree: ast.Module = ast.parse(source, filename=str(path))
         self.suppressions: List[Suppression] = []
         self.hotloop_lines: set = set()  # lines carrying a hotloop marker
+        #: lines carrying `# tracelint: threads` — marks a CLASS whose
+        #: public methods are called from many threads at once (HTTP
+        #: handler fan-in) so the thread-model rules treat each public
+        #: method as its own concurrent root (analysis/threadctx.py)
+        self.thread_marker_lines: set = set()
         self._scan_comments()
 
     def _scan_comments(self) -> None:
@@ -145,6 +156,8 @@ class FileContext:
                 )
             if _HOTLOOP_RE.search(tok.string):
                 self.hotloop_lines.add(i)
+            if _THREADS_RE.search(tok.string):
+                self.thread_marker_lines.add(i)
 
     # ------------------------------------------------------------- helpers
 
@@ -224,8 +237,17 @@ class Rule:
     #: is never justified in shipped code; the old regex scan it replaced
     #: had no opt-out either, and neither does this)
     suppressible: bool = True
+    #: True for rules whose unit of analysis is the whole lint run, not
+    #: one file (TL015's lock-acquisition graph spans modules): the
+    #: driver calls `check_package(contexts, package)` once instead of
+    #: `check(ctx, package)` per file. Findings still anchor to a
+    #: (path, line) so suppressions and baselines work unchanged.
+    package_scope: bool = False
 
     def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def check_package(self, contexts, package) -> Iterator[Finding]:
         raise NotImplementedError
 
 
@@ -235,6 +257,13 @@ class LintResult:
     suppressed: List[Tuple[Finding, Suppression]] = field(default_factory=list)
     baselined: List[Finding] = field(default_factory=list)
     files_checked: int = 0
+    #: per-rule wall time (seconds) actually spent executing rule checks
+    #: this run — cache hits contribute nothing, so a slow rule is
+    #: visible in `--format json` instead of hiding in the total
+    rule_times: dict = field(default_factory=dict)
+    #: incremental-cache counters for this run (None outside --watch /
+    #: cached runs): files, reparsed, ast_hits, finding_hits
+    cache: Optional[dict] = None
 
     @property
     def clean(self) -> bool:

@@ -7,6 +7,9 @@ Usage (CLI is also installed as `dalle-tpu-lint`):
     python -m dalle_pytorch_tpu.analysis --format json
     python -m dalle_pytorch_tpu.analysis --format github   # CI annotations
     python -m dalle_pytorch_tpu.analysis --select TL003,TL006
+    python -m dalle_pytorch_tpu.analysis --rules TL013,TL014  # alias
+    python -m dalle_pytorch_tpu.analysis --exclude-rules TL016
+    python -m dalle_pytorch_tpu.analysis --watch              # incremental
     python -m dalle_pytorch_tpu.analysis --write-baseline     # grandfather
 
 Exit codes are a severity bitmask: 0 clean, bit 0 (1) new error-tier
@@ -73,16 +76,38 @@ def _display_path(path: Path) -> str:
         return path.as_posix()
 
 
+def _apply_suppressions(
+    ctx: FileContext,
+    findings: List[Finding],
+    unsuppressible: Set[str],
+    result: LintResult,
+) -> None:
+    for f in findings:
+        sup = None if f.rule in unsuppressible else ctx.suppressed(f)
+        if sup is not None:
+            result.suppressed.append((f, sup))
+        else:
+            result.findings.append(f)
+
+
 def lint_paths(
     paths: Sequence[Path],
     select: Optional[Set[str]] = None,
     baseline_fingerprints: Optional[Set[str]] = None,
+    cache=None,
 ) -> LintResult:
     """Run the rule pack over `paths` (files or directories).
 
     `select` restricts to a set of rule codes (TL000 framework findings
     are only emitted when unrestricted or explicitly selected).
+    `cache` (an `analysis.watch.LintCache`) makes the run incremental:
+    unchanged files (by content fingerprint) skip re-parsing, and skip
+    rule execution too when the cross-file facts they depend on are
+    unchanged. Per-rule wall time for the work actually executed lands
+    in `LintResult.rule_times`.
     """
+    import time as _time
+
     rules = [
         r for r in ALL_RULES if select is None or r.code in select
     ]
@@ -92,14 +117,19 @@ def lint_paths(
     }
     files = iter_python_files([Path(p) for p in paths])
 
+    if cache is not None:
+        cache.begin_run()
     contexts: List[FileContext] = []
     result = LintResult()
     for path, stable in files:
         try:
-            source = path.read_text(encoding="utf-8")
-            contexts.append(
-                FileContext(path, _display_path(path), source, stable)
-            )
+            ctx = None
+            if cache is not None:
+                ctx = cache.context_for(path, _display_path(path), stable)
+            if ctx is None:
+                source = path.read_text(encoding="utf-8")
+                ctx = FileContext(path, _display_path(path), source, stable)
+            contexts.append(ctx)
         except (SyntaxError, UnicodeDecodeError, OSError) as exc:
             result.findings.append(
                 Finding(
@@ -113,25 +143,56 @@ def lint_paths(
     result.files_checked = len(contexts)
 
     registry = DonationRegistry.build([c.tree for c in contexts])
+    file_rules = [r for r in rules if not r.package_scope]
+    package_rules = [r for r in rules if r.package_scope]
+    emit_tl000 = select is None or "TL000" in select
+    rule_times: dict = {}
+    # the finding cache is valid only while the cross-file facts a
+    # per-file rule can read are unchanged (TL003's donation registry);
+    # the select set is part of the key so --rules runs don't alias
+    xkey = None
+    if cache is not None:
+        xkey = cache.cross_file_key(registry, select)
 
-    raw: List[Finding] = []
     for ctx in contexts:
-        for rule in rules:
-            raw.extend(rule.check(ctx, registry))
-        if select is None or "TL000" in select:
-            raw.extend(ctx.malformed_suppressions())
+        cached = cache.findings_for(ctx, xkey) if cache is not None else None
+        if cached is not None:
+            kept, suppressed = cached
+            result.findings.extend(kept)
+            result.suppressed.extend(suppressed)
+            continue
+        mine: List[Finding] = []
+        for rule in file_rules:
+            t0 = _time.perf_counter()
+            mine.extend(rule.check(ctx, registry))
+            rule_times[rule.code] = (
+                rule_times.get(rule.code, 0.0) + _time.perf_counter() - t0
+            )
+        if emit_tl000:
+            mine.extend(ctx.malformed_suppressions())
+        local = LintResult()
+        _apply_suppressions(ctx, mine, unsuppressible, local)
+        if cache is not None:
+            cache.store_findings(ctx, xkey, local.findings, local.suppressed)
+        result.findings.extend(local.findings)
+        result.suppressed.extend(local.suppressed)
 
-        # apply suppressions for this file's findings
-        mine = [f for f in raw if f.path == ctx.display_path]
-        raw = [f for f in raw if f.path != ctx.display_path]
-        for f in mine:
-            sup = None if f.rule in unsuppressible else ctx.suppressed(f)
-            if sup is not None:
-                result.suppressed.append((f, sup))
-            else:
+    # package-scope rules (TL015's cross-module lock graph) see every
+    # context at once; their findings are never cached — any file edit
+    # can change the graph — but they reuse the cached per-file indices
+    ctx_by_path = {c.display_path: c for c in contexts}
+    for rule in package_rules:
+        t0 = _time.perf_counter()
+        raw = list(rule.check_package(contexts, registry))
+        rule_times[rule.code] = (
+            rule_times.get(rule.code, 0.0) + _time.perf_counter() - t0
+        )
+        for f in raw:
+            ctx = ctx_by_path.get(f.path)
+            if ctx is None:
                 result.findings.append(f)
-
-    result.findings.extend(raw)  # findings for unparsed paths, if any
+            else:
+                _apply_suppressions(ctx, [f], unsuppressible, result)
 
     if baseline_fingerprints:
         new, old = split_baselined(result.findings, baseline_fingerprints)
@@ -139,6 +200,9 @@ def lint_paths(
         result.baselined = old
 
     result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.rule_times = rule_times
+    if cache is not None:
+        result.cache = cache.stats_dict()
     return result
 
 
@@ -197,18 +261,38 @@ def _render_github(result: LintResult) -> str:
 
 
 def _render_json(result: LintResult) -> str:
-    return json.dumps(
-        {
-            "findings": [f.as_json() for f in result.findings],
-            "suppressed": [
-                {**f.as_json(), "reason": sup.reason}
-                for f, sup in result.suppressed
-            ],
-            "baselined": [f.as_json() for f in result.baselined],
-            "files_checked": result.files_checked,
+    payload = {
+        "findings": [f.as_json() for f in result.findings],
+        "suppressed": [
+            {**f.as_json(), "reason": sup.reason}
+            for f, sup in result.suppressed
+        ],
+        "baselined": [f.as_json() for f in result.baselined],
+        "files_checked": result.files_checked,
+        # per-rule wall time for work actually executed this run, so a
+        # slow rule is visible instead of hiding in the total (cache
+        # hits in --watch contribute nothing by design)
+        "rule_times_ms": {
+            code: round(t * 1000.0, 3)
+            for code, t in sorted(result.rule_times.items())
         },
-        indent=2,
-    )
+    }
+    if result.cache is not None:
+        payload["cache"] = result.cache
+    return json.dumps(payload, indent=2)
+
+
+RENDERERS = {
+    "text": _render_text,
+    "json": _render_json,
+    "github": _render_github,
+}
+
+
+def exit_code(result: LintResult) -> int:
+    """Severity bitmask (module docstring): errors set bit 0, warning-
+    tier findings set bit 2 — bit 1 stays reserved for usage errors."""
+    return (1 if result.errors else 0) | (4 if result.warnings else 0)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -229,8 +313,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "findings as inline annotations",
     )
     parser.add_argument(
-        "--select", default=None, metavar="TLxxx[,TLxxx...]",
-        help="run only these rule codes",
+        "--select", "--rules", dest="select", default=None,
+        metavar="TLxxx[,TLxxx...]",
+        help="run only these rule codes (--rules is an alias)",
+    )
+    parser.add_argument(
+        "--exclude-rules", default=None, metavar="TLxxx[,TLxxx...]",
+        help="run everything except these rule codes (CI granularity "
+        "while a new rule beds in)",
+    )
+    parser.add_argument(
+        "--watch", action="store_true",
+        help="incremental watch mode: poll for file changes and re-lint "
+        "on every edit, re-parsing only changed files; --format json "
+        "emits one JSON document per event",
+    )
+    parser.add_argument(
+        "--watch-poll", type=float, default=0.5, metavar="SECONDS",
+        help="mtime poll interval for --watch (default 0.5s)",
     )
     parser.add_argument(
         "--baseline", type=Path, default=None,
@@ -255,13 +355,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     paths = args.paths or [PACKAGE_DIR]
+    known = {r.code for r in ALL_RULES} | {"TL000"}
     select = None
     if args.select:
         select = {c.strip() for c in args.select.split(",") if c.strip()}
-        unknown = select - {r.code for r in ALL_RULES} - {"TL000"}
+        unknown = select - known
         if unknown:
             print(f"unknown rule code(s): {sorted(unknown)}", file=sys.stderr)
             return 2
+    if args.exclude_rules:
+        excluded = {
+            c.strip() for c in args.exclude_rules.split(",") if c.strip()
+        }
+        unknown = excluded - known
+        if unknown:
+            print(f"unknown rule code(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+        select = (select if select is not None else known) - excluded
 
     baseline_path = args.baseline
     if baseline_path is None and not args.paths:
@@ -270,6 +380,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     fingerprints: Set[str] = set()
     if baseline_path is not None and not args.no_baseline and not args.write_baseline:
         fingerprints = load_baseline(baseline_path)
+
+    if args.watch:
+        if args.write_baseline:
+            print(
+                "tracelint: --watch and --write-baseline don't compose",
+                file=sys.stderr,
+            )
+            return 2
+        from dalle_pytorch_tpu.analysis.watch import watch_paths
+
+        try:
+            return watch_paths(
+                paths,
+                select=select,
+                baseline_fingerprints=fingerprints,
+                fmt=args.format,
+                poll_s=args.watch_poll,
+            )
+        except FileNotFoundError as exc:
+            print(f"tracelint: {exc}", file=sys.stderr)
+            return 2
+        except KeyboardInterrupt:
+            return 0
 
     try:
         result = lint_paths(
@@ -297,15 +430,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         )
         return 0
 
-    renderer = {
-        "text": _render_text,
-        "json": _render_json,
-        "github": _render_github,
-    }[args.format]
+    renderer = RENDERERS[args.format]
     print(renderer(result))
-    # severity bitmask (module docstring): errors set bit 0, warning-tier
-    # findings set bit 2 — bit 1 stays reserved for usage errors (2)
-    return (1 if result.errors else 0) | (4 if result.warnings else 0)
+    return exit_code(result)
 
 
 if __name__ == "__main__":

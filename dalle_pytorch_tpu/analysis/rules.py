@@ -79,6 +79,30 @@ TL012  mid-chunk decode-state snapshot: a host snapshot/serialization
        `%`-cadence expression. `serving/` only; calls inside helper
        methods (not loops) stay silent — false-negative bias like the
        rest of the pack.
+TL013  unguarded shared state: a `self.*` attribute compound-written
+       (augassign / container mutation / check-then-act rebind) on one
+       thread root and accessed on another with no common lock between
+       the two sides — the bug class every review-hardening round since
+       PR 7 has caught by hand. Thread roots, lock binding and the
+       compound-write currency come from the threadctx.py index; plain
+       write-only flag rebinds (GIL-atomic) stay exempt.
+TL014  iterate-while-mutated: iterating a shared list/deque/dict
+       attribute (for / comprehension / list()-style snapshot call)
+       while another thread root mutates it and no common lock covers
+       the two sides — the exact PR 7 sampler-vs-/healthz and PR 9
+       collector-read RuntimeError shape. The fix is the shipped
+       snapshot-under-lock idiom: `with self._lock: snap = list(...)`.
+TL015  lock-order inversion: two attribute-bound locks acquired in
+       opposite nesting orders anywhere in the package (package-scope
+       rule — the acquisition graph spans modules). Direct `with`
+       nesting and one hop through a same-class method call are seen;
+       each cycle is reported once, at its earliest edge site.
+TL016  blocking call under a lock in `serving/` or `obs/`:
+       `time.sleep`, thread `.join()`, event `.wait()` (a condition's
+       own `wait` releases the lock and is exempt), socket/HTTP reads,
+       or an engine dispatch inside a `with <lock>:` body — the
+       head-of-line-blocking shape the batcher's dispatch-lock timing
+       deliberately avoids (it releases the lock around dispatch).
 TL009  a `Trace.begin(...)` span whose matching `end()` is unreachable
        on the exception path: begin and end in the SAME function, every
        `end` in straight-line code — an exception between them leaks the
@@ -1273,6 +1297,363 @@ class ChunkBoundarySnapshotRule(Rule):
             yield from scan(stmt, False)
 
 
+# ----------------------------------------------------- thread-model rules
+
+
+def _thread_index(ctx: FileContext):
+    """One thread-model index per file, shared by TL013/TL014/TL016
+    (memoized on the FileContext like `_jax_index`)."""
+    from dalle_pytorch_tpu.analysis.threadctx import ThreadIndex
+
+    idx = getattr(ctx, "_thread_index", None)
+    if idx is None:
+        idx = ThreadIndex(ctx.tree, frozenset(ctx.thread_marker_lines))
+        ctx._thread_index = idx
+    return idx
+
+
+def _root_names(roots) -> str:
+    return ", ".join(sorted(roots))
+
+
+class SharedStateRule(Rule):
+    code = "TL013"
+    name = "unguarded-shared-state"
+    description = (
+        "a self.* attribute compound-written on one thread root and "
+        "accessed on another with no common lock between the two sides "
+        "(augassign counters, container mutations, check-then-act "
+        "rebinds; plain write-only flag rebinds are exempt)"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        index = _thread_index(ctx)
+        seen: Set[Tuple[str, int]] = set()  # (attr, line): inheritance dedupe
+        for model in index.classes:
+            if not model.threaded:
+                continue
+            for attr, accs in sorted(model.by_attr().items()):
+                finding = self._check_attr(ctx, model, attr, accs)
+                if finding is None:
+                    continue
+                key = (attr, finding.line)
+                if key not in seen:
+                    seen.add(key)
+                    yield finding
+
+    def _check_attr(self, ctx, model, attr, accs) -> Optional[Finding]:
+        from dalle_pytorch_tpu.analysis.threadctx import cross_root
+
+        for c in sorted(
+            (a for a in accs if a.compound),
+            key=lambda a: getattr(a.node, "lineno", 0),
+        ):
+            for o in accs:
+                if o.kind == "iterate":
+                    continue  # the iterate-side conflict is TL014's
+                if o is c and len(c.roots) < 2:
+                    continue
+                if not cross_root(c, o):
+                    continue
+                if c.locks & o.locks:
+                    continue
+                where = (
+                    "it races itself across roots "
+                    f"{_root_names(c.roots)}"
+                    if o is c
+                    else (
+                        f"root(s) {_root_names(o.roots)} "
+                        f"{'write' if o.kind != 'read' else 'read'} it "
+                        f"near line {getattr(o.node, 'lineno', '?')}"
+                        + (
+                            " holding a different lock"
+                            if o.locks
+                            else " with no lock"
+                        )
+                    )
+                )
+                return ctx.finding(
+                    self.code, c.node,
+                    f"`self.{attr}` is written here on root(s) "
+                    f"{_root_names(c.roots)}"
+                    + (" under a lock" if c.locks else " with no lock")
+                    + f", but {where} — guard both sides with one lock "
+                    f"(e.g. `with self.{model.suggest_lock()}:`)",
+                )
+        return None
+
+
+class IterateWhileMutatedRule(Rule):
+    code = "TL014"
+    name = "iterate-while-mutated"
+    description = (
+        "iterating a shared list/deque/dict attribute while another "
+        "thread root mutates it, with no common lock between the two "
+        "sides — the sampler-vs-/healthz RuntimeError shape; snapshot "
+        "under the lock instead"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        from dalle_pytorch_tpu.analysis.threadctx import cross_root
+
+        index = _thread_index(ctx)
+        seen: Set[Tuple[str, int]] = set()
+        for model in index.classes:
+            if not model.threaded:
+                continue
+            for attr, accs in sorted(model.by_attr().items()):
+                mutes = [a for a in accs if a.kind == "mutate"]
+                if not mutes:
+                    continue
+                # the lock(s) every mutation site holds — the guard the
+                # iteration must share (empty when mutations are split
+                # across different locks or unguarded)
+                guard = frozenset.intersection(*(m.locks for m in mutes))
+                for it in (a for a in accs if a.kind == "iterate"):
+                    conflict = next(
+                        (
+                            m for m in mutes
+                            if cross_root(it, m) and not (it.locks & m.locks)
+                        ),
+                        None,
+                    )
+                    if conflict is None:
+                        continue
+                    key = (attr, getattr(it.node, "lineno", 0))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    if guard:
+                        fix = (
+                            f"snapshot under the guard instead: `with "
+                            f"self.{sorted(guard)[0]}: snap = "
+                            f"list(self.{attr})` and iterate the snapshot"
+                        )
+                    else:
+                        fix = (
+                            "its mutations are unguarded too — pick one "
+                            "lock for both sides, then iterate a "
+                            "snapshot taken under it"
+                        )
+                    yield ctx.finding(
+                        self.code, it.node,
+                        f"`self.{attr}` is iterated here on root(s) "
+                        f"{_root_names(it.roots)} while root(s) "
+                        f"{_root_names(conflict.roots)} mutate it (line "
+                        f"{getattr(conflict.node, 'lineno', '?')}) with "
+                        f"no common lock — a mid-iteration mutation "
+                        f"raises RuntimeError or yields torn state; {fix}",
+                    )
+
+
+class LockOrderRule(Rule):
+    code = "TL015"
+    name = "lock-order-inversion"
+    description = (
+        "two locks acquired in opposite nesting orders anywhere in the "
+        "package — each thread can hold one and wait forever on the "
+        "other; package-scope acquisition graph, cycles reported once"
+    )
+    package_scope = True
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        return iter(())  # package-scope: the driver calls check_package
+
+    def check_package(self, contexts, package) -> Iterator[Finding]:
+        # edge (A, B): lock B acquired while A is held; site list kept in
+        # source order for deterministic reporting
+        edges: Dict[Tuple[str, str], List[Tuple]] = {}
+        for ctx in contexts:
+            index = _thread_index(ctx)
+            dedupe: Set[Tuple[str, str, int]] = set()  # inheritance dupes
+            for held, acquired, via, node in index.lock_edges():
+                key = (held, acquired, getattr(node, "lineno", 0))
+                if key in dedupe:
+                    continue
+                dedupe.add(key)
+                edges.setdefault((held, acquired), []).append(
+                    (ctx, node, via)
+                )
+
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen, stack = set(), [src]
+            while stack:
+                n = stack.pop()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                stack.extend(graph.get(n, ()))
+            return False
+
+        # every edge that sits on a cycle, grouped so each cycle (SCC)
+        # is reported once at its earliest site
+        cyclic: Dict[FrozenSet[str], List[Tuple]] = {}
+        for (a, b), sites in edges.items():
+            if not reaches(b, a):
+                continue
+            scc = frozenset(
+                n for n in graph
+                if reaches(a, n) and reaches(n, a)
+            )
+            for ctx, node, via in sites:
+                cyclic.setdefault(scc, []).append((ctx, node, via, a, b))
+        for scc, sites in sorted(
+            cyclic.items(), key=lambda kv: sorted(kv[0])
+        ):
+            sites.sort(
+                key=lambda s: (s[0].display_path, getattr(s[1], "lineno", 0))
+            )
+            ctx, node, via, a, b = sites[0]
+            others = [
+                f"{s[0].display_path}:{getattr(s[1], 'lineno', '?')} "
+                f"({s[3]} -> {s[4]})"
+                for s in sites[1:]
+            ]
+            yield ctx.finding(
+                self.code, node,
+                f"lock-order inversion: `{b}` is acquired here ({via}) "
+                f"while `{a}` is held, but elsewhere the same locks nest "
+                f"in the opposite order ({'; '.join(others) or 'cycle'}) "
+                "— two threads can each hold one lock and wait forever "
+                "on the other; pick ONE global order and re-nest",
+            )
+
+
+#: call-name terminals that read/write a socket (blocking I/O)
+_SOCKET_CALLS = {
+    "urlopen", "getresponse", "recv", "recv_into", "sendall", "sendto",
+    "accept", "connect", "create_connection",
+}
+#: engine method-name fragments that dispatch device work or sync it
+_ENGINE_DISPATCH_FRAGMENTS = (
+    "generate", "prefill", "chunk", "release", "harvest", "decode",
+    "resume", "dispatch", "warmup", "snapshot",
+)
+
+
+class BlockingUnderLockRule(Rule):
+    code = "TL016"
+    name = "blocking-under-lock"
+    description = (
+        "blocking call (time.sleep, thread join, event wait, socket "
+        "I/O, engine dispatch) inside a `with <lock>:` body in serving/ "
+        "or obs/ — every other thread contending that lock stalls for "
+        "the call's full duration (head-of-line blocking)"
+    )
+
+    #: the serving stack's locks sit on its hot paths; training scripts
+    #: hold no latency-critical locks and stay out of scope
+    SCOPED_DIRS = ("serving", "obs")
+
+    def _in_scope(self, ctx: FileContext) -> bool:
+        return any(d in ctx.path.parts for d in self.SCOPED_DIRS)
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        index = _thread_index(ctx)
+        seen: Set[int] = set()  # line dedupe across inherited models
+        for model in index.classes:
+            if not model.locks:
+                continue
+            for mname, func in model.methods.items():
+                if mname == "__init__":
+                    # construction happens-before thread start: nothing
+                    # can contend a lock held during __init__ (the same
+                    # exemption threadctx applies to access collection)
+                    continue
+                for finding in self._check_method(ctx, model, func):
+                    if finding.line not in seen:
+                        seen.add(finding.line)
+                        yield finding
+
+    def _blocking(self, node: ast.Call, model, held) -> Optional[str]:
+        from dalle_pytorch_tpu.analysis.threadctx import _self_attr
+
+        dotted = dotted_name(node.func) or ""
+        fname = terminal_name(node.func)
+        if dotted in ("time.sleep", "sleep"):
+            return "`time.sleep` parks the thread with the lock held"
+        if fname in _SOCKET_CALLS:
+            return f"socket I/O (`{fname}`) blocks for a network round trip"
+        recv = (
+            node.func.value if isinstance(node.func, ast.Attribute) else None
+        )
+        recv_name = terminal_name(recv) if recv is not None else None
+        recv_attr = _self_attr(recv)
+        if fname == "join":
+            # str.join is everywhere: only receivers that look like a
+            # thread/process handle count (false-negative bias)
+            name = recv_attr or recv_name or ""
+            if any(h in name.lower() for h in ("thread", "worker", "proc")):
+                return f"`{name}.join()` waits out another thread"
+            return None
+        if fname in ("wait", "wait_for"):
+            # a condition's own wait RELEASES the lock while parked —
+            # that is the designed idiom, not head-of-line blocking
+            if recv_attr is not None and model.locks.get(recv_attr) in held:
+                return None
+            return (
+                f"`.{fname}()` parks the thread while the lock stays "
+                "held (only the held condition's own wait releases it)"
+            )
+        if recv_attr is not None and "engine" in recv_attr.lower() and any(
+            f in (fname or "").lower() for f in _ENGINE_DISPATCH_FRAGMENTS
+        ):
+            return (
+                f"engine dispatch `self.{recv_attr}.{fname}(...)` runs "
+                "device work under the lock — the batcher releases its "
+                "lock around dispatch for exactly this reason"
+            )
+        if recv_name is not None and "engine" in recv_name.lower() and any(
+            f in (fname or "").lower() for f in _ENGINE_DISPATCH_FRAGMENTS
+        ):
+            return (
+                f"engine dispatch `{recv_name}.{fname}(...)` runs device "
+                "work under the lock"
+            )
+        return None
+
+    def _check_method(self, ctx, model, func) -> Iterator[Finding]:
+        from dalle_pytorch_tpu.analysis.threadctx import _ALL_FUNCS, _self_attr
+
+        def scan(node, held) -> Iterator[Finding]:
+            if isinstance(node, _ALL_FUNCS):
+                return
+            if isinstance(node, ast.With):
+                new = set()
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr in model.locks:
+                        new.add(model.locks[attr])
+                held2 = held | frozenset(new)
+                for stmt in node.body:
+                    yield from scan(stmt, held2)
+                return
+            if isinstance(node, ast.Call) and held:
+                why = self._blocking(node, model, held)
+                if why is not None:
+                    lock = sorted(held)[0]
+                    yield ctx.finding(
+                        self.code, node,
+                        f"blocking call while holding `self.{lock}`: "
+                        f"{why} — move it outside the `with` block or "
+                        "justify the hold with a suppression",
+                    )
+            for child in ast.iter_child_nodes(node):
+                yield from scan(child, held)
+
+        body = func.body if isinstance(func.body, list) else []
+        for stmt in body:
+            yield from scan(stmt, frozenset())
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -1286,4 +1667,8 @@ ALL_RULES: Tuple[Rule, ...] = (
     RetryHygieneRule(),
     WarmupCoverageRule(),
     ChunkBoundarySnapshotRule(),
+    SharedStateRule(),
+    IterateWhileMutatedRule(),
+    LockOrderRule(),
+    BlockingUnderLockRule(),
 )

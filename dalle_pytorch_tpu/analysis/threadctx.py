@@ -1,0 +1,646 @@
+"""Thread-model index for tracelint: which code runs on which thread,
+which attribute-bound locks exist, and which shared ``self.*`` attributes
+each thread root touches under which locks.
+
+Sibling of `jaxctx.py` (traced-context index) for the concurrency rules
+TL013-TL016. Everything is a HEURISTIC over the AST, per file, with the
+same false-negative bias as the rest of the pack: an unrecognized
+construct means *silent*, never *flagged*.
+
+Vocabulary
+----------
+Thread root   an entry point that executes on its own thread:
+              * a method passed as ``threading.Thread(target=self.X)``
+                anywhere in the class (the batcher/vitals/aggregate/
+                supervisor ``start()`` idiom) -> root ``thread:X``
+              * a ``do_GET``/``do_POST``/... handler method (each HTTP
+                request runs on its own ThreadingHTTPServer thread)
+                -> root ``handler:do_X``
+              * the implicit ``caller`` root: once a class owns any
+                worker/handler root, its public methods are presumed
+                entered from OTHER threads (the API surface the HTTP
+                layer and tests call) — one collective root
+              * ``# tracelint: threads`` on (or directly above) a class
+                promotes EVERY public method to its own concurrent root
+                ``caller:X`` (the handler fan-in shape: N request threads
+                entering N different methods of one shared object)
+              A method reachable from a root through ``self.m()`` calls
+              (transitively, within the class) executes on that root's
+              thread; a method reachable from several roots executes on
+              all of them.
+
+Lock          an attribute bound to ``threading.Lock()`` / ``RLock()`` /
+              ``Condition()`` in any method (``__init__`` in practice).
+              ``Condition(self._lock)`` ALIASES the wrapped lock — the
+              router's ``_drained = Condition(self._lock)`` acquires the
+              same mutex as ``with self._lock``. Only ``with self.X:``
+              acquisitions are tracked; bare ``.acquire()`` calls and
+              locks passed across objects are not (known limit).
+
+Access        one read/write/mutate/iterate of a ``self.*`` attribute,
+              recorded with the set of locks held at that point and the
+              roots that can execute it. ``__init__`` (and helpers
+              reachable only from it) is never recorded: construction
+              happens-before thread start. Threading primitives
+              (locks, events, queues, thread handles) are never shared
+              state themselves.
+
+Compound write (the TL013 currency): an AugAssign (``self.n += 1``), a
+              container mutation (``self.q.append``, ``self.d[k] = v``),
+              or a plain rebind in a method that ALSO reads the same
+              attribute (check-then-act: the PR 14 export-claim shape).
+              A plain write-only rebind (``self._running = False``) is
+              the GIL-atomic flag idiom and stays exempt — flagging it
+              would bury the real races in noise.
+
+Known limits (document in analysis/README.md, keep in mind when reading
+findings): locks held through local aliases (``lock = self._lock``),
+cross-object state (``self.server.engine...``), dynamically-created
+locks, cross-process state, and ``.acquire()``/``.release()`` pairs are
+all invisible; inheritance resolves within one file only.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from dalle_pytorch_tpu.analysis.jaxctx import terminal_name
+
+FunctionNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+_ALL_FUNCS = FunctionNode + (ast.Lambda,)
+
+#: constructors that bind a mutual-exclusion lock to an attribute
+_LOCK_CTORS = {"Lock", "RLock"}
+_COND_CTOR = "Condition"
+#: constructors whose product is itself thread-safe (or a thread handle):
+#: attributes bound to these are never treated as shared mutable state
+_PRIMITIVE_CTORS = _LOCK_CTORS | {
+    _COND_CTOR, "Event", "Semaphore", "BoundedSemaphore", "Barrier",
+    "Thread", "Timer", "local",
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+}
+#: http.server handler entry points — each runs on its own request thread
+_HANDLER_METHODS = {
+    "do_GET", "do_POST", "do_PUT", "do_DELETE", "do_HEAD", "do_PATCH",
+}
+#: method names that mutate their receiver container in place
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "add", "discard", "remove", "pop", "popleft", "popitem",
+    "clear", "update", "setdefault", "move_to_end", "rotate",
+    "sort", "reverse",
+}
+#: `self.X.<m>()` reads that walk the whole container (snapshot targets)
+_ITER_METHODS = {"items", "values", "keys"}
+#: call wrappers that iterate their (single) argument
+_ITER_WRAPPERS = {
+    "list", "tuple", "sorted", "set", "dict", "frozenset",
+    "sum", "min", "max", "any", "all",
+}
+#: wrappers transparent to the iteration target in a `for`/comprehension
+_ITER_UNWRAP = {"enumerate", "reversed", "sorted", "list", "tuple", "iter"}
+
+
+def _self_attr(node: Optional[ast.AST]) -> Optional[str]:
+    """`self.X` -> "X" (one level only; `self.a.b` resolves to None)."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclass
+class Access:
+    attr: str
+    kind: str  # "read" | "write" | "mutate" | "iterate"
+    compound: bool  # read-modify-write / container mutation (see module doc)
+    locks: FrozenSet[str]  # canonical lock attrs held at this point
+    roots: FrozenSet[str]  # root labels that can execute this statement
+    method: str
+    node: ast.AST
+
+
+def cross_root(a: Access, b: Access) -> bool:
+    """Can `a` and `b` execute on two different threads? True when their
+    root sets span more than one label — including a==b for a statement
+    reachable from several roots (it races itself)."""
+    return len(a.roots | b.roots) >= 2
+
+
+@dataclass
+class ClassModel:
+    name: str
+    node: ast.ClassDef
+    #: effective method table (same-file base classes merged, overrides win)
+    methods: Dict[str, ast.AST] = field(default_factory=dict)
+    #: lock attr -> canonical lock attr (Condition(self._lock) -> "_lock")
+    locks: Dict[str, str] = field(default_factory=dict)
+    #: attrs bound to any threading primitive (never shared state)
+    primitives: Set[str] = field(default_factory=set)
+    thread_targets: Set[str] = field(default_factory=set)
+    handler_methods: Set[str] = field(default_factory=set)
+    shared_marked: bool = False  # `# tracelint: threads`
+    roots_of: Dict[str, FrozenSet[str]] = field(default_factory=dict)
+    accesses: List[Access] = field(default_factory=list)
+    #: callee -> [(caller, locks held at that `self.callee()` call site)]
+    #: — feeds the inherited-lock pass (the `_viable_head` "caller holds
+    #: the lock" helper convention)
+    call_sites: Dict[str, List[Tuple[str, FrozenSet[str]]]] = field(
+        default_factory=dict
+    )
+
+    @property
+    def threaded(self) -> bool:
+        """Does any concurrency exist to analyze? A class with no worker
+        thread, no handler methods and no threads marker has one caller
+        and the shared-state rules stay silent on it."""
+        return bool(
+            self.thread_targets or self.handler_methods or self.shared_marked
+        )
+
+    def by_attr(self) -> Dict[str, List[Access]]:
+        out: Dict[str, List[Access]] = {}
+        for a in self.accesses:
+            out.setdefault(a.attr, []).append(a)
+        return out
+
+    def suggest_lock(self) -> str:
+        """A lock name for fix-suggestion messages."""
+        for canon in self.locks.values():
+            return canon
+        return "_lock"
+
+
+class ThreadIndex:
+    """Per-file thread-model index, built once per FileContext (memoized
+    by the rules through `ctx._thread_index`)."""
+
+    def __init__(self, tree: ast.Module, marker_lines: frozenset = frozenset()):
+        self.tree = tree
+        self._marker_lines = set(marker_lines)
+        self._class_defs: Dict[str, ast.ClassDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                # last def wins on collision, like jaxctx's def table
+                self._class_defs[node.name] = node
+        self.classes: List[ClassModel] = [
+            self._build(node)
+            for node in ast.walk(tree)
+            if isinstance(node, ast.ClassDef)
+        ]
+
+    # ------------------------------------------------------------ building
+
+    def _base_chain(self, cdef: ast.ClassDef) -> List[ast.ClassDef]:
+        """[most-base .. cdef] resolved by name within this file; cycles
+        and foreign bases are simply not expanded."""
+        chain: List[ast.ClassDef] = []
+        seen: Set[str] = set()
+
+        def rec(node: ast.ClassDef) -> None:
+            if node.name in seen:
+                return
+            seen.add(node.name)
+            for base in node.bases:
+                name = terminal_name(base)
+                if name and name in self._class_defs:
+                    rec(self._class_defs[name])
+            chain.append(node)
+
+        rec(cdef)
+        return chain
+
+    def _build(self, cdef: ast.ClassDef) -> ClassModel:
+        model = ClassModel(cdef.name, cdef)
+        for node in self._base_chain(cdef):
+            for stmt in node.body:
+                if isinstance(stmt, FunctionNode):
+                    model.methods[stmt.name] = stmt
+        model.shared_marked = self._is_marked(cdef)
+        self._find_locks(model)
+        self._find_roots(model)
+        self._attribute_roots(model)
+        self._collect_accesses(model)
+        self._inherit_locks(model)
+        return model
+
+    def _inherit_locks(self, model: ClassModel) -> None:
+        """The `_viable_head` convention: a PRIVATE helper called only
+        with a lock held runs under that lock even though it never
+        acquires it. inherited(m) = the intersection over every internal
+        call site of (locks held at the site | inherited(caller)), to a
+        fixpoint; entry points (public methods, thread targets, handler
+        methods — anything an external caller enters lock-free) inherit
+        nothing."""
+        entry = model.thread_targets | model.handler_methods | {
+            m for m in model.methods if not m.startswith("_")
+        }
+        inherited: Dict[str, FrozenSet[str]] = {}
+        changed = True
+        while changed:
+            changed = False
+            for callee, sites in model.call_sites.items():
+                if callee in entry:
+                    continue
+                new = frozenset.intersection(*(
+                    held | inherited.get(caller, frozenset())
+                    for caller, held in sites
+                ))
+                if new != inherited.get(callee, frozenset()):
+                    inherited[callee] = new
+                    changed = True
+        for access in model.accesses:
+            extra = inherited.get(access.method)
+            if extra:
+                access.locks = access.locks | extra
+
+    def _is_marked(self, cdef: ast.ClassDef) -> bool:
+        candidates = {cdef.lineno, cdef.lineno - 1}
+        for dec in cdef.decorator_list:
+            candidates.add(dec.lineno - 1)
+        return bool(candidates & self._marker_lines)
+
+    def _find_locks(self, model: ClassModel) -> None:
+        """Two passes so `Condition(self._lock)` can alias a lock bound
+        later in the same `__init__` (binding order is irrelevant)."""
+        assigns: List[Tuple[str, ast.Call]] = []
+        for func in model.methods.values():
+            for node in ast.walk(func):
+                # plain and annotated bindings both count: an invisible
+                # `self._lock: threading.Lock = threading.Lock()` would
+                # make every correctly guarded access look unguarded
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                    value = node.value
+                elif isinstance(node, ast.AnnAssign):
+                    targets = [node.target]
+                    value = node.value
+                else:
+                    continue
+                if not isinstance(value, ast.Call):
+                    continue
+                ctor = terminal_name(value.func)
+                if ctor not in _PRIMITIVE_CTORS:
+                    continue
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        assigns.append((attr, value))
+                        model.primitives.add(attr)
+        for attr, call in assigns:
+            ctor = terminal_name(call.func)
+            if ctor in _LOCK_CTORS:
+                model.locks[attr] = attr
+        for attr, call in assigns:
+            ctor = terminal_name(call.func)
+            if ctor == _COND_CTOR:
+                wrapped = _self_attr(call.args[0]) if call.args else None
+                if wrapped is not None and wrapped in model.locks:
+                    model.locks[attr] = model.locks[wrapped]
+                else:
+                    model.locks[attr] = attr
+
+    def _find_roots(self, model: ClassModel) -> None:
+        for func in model.methods.values():
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if terminal_name(node.func) != "Thread":
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    attr = _self_attr(kw.value)
+                    if attr is not None and attr in model.methods:
+                        model.thread_targets.add(attr)
+        for name in model.methods:
+            if name in _HANDLER_METHODS:
+                model.handler_methods.add(name)
+
+    def _call_edges(self, model: ClassModel) -> Dict[str, Set[str]]:
+        edges: Dict[str, Set[str]] = {}
+        for name, func in model.methods.items():
+            out: Set[str] = set()
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call):
+                    callee = _self_attr(node.func)
+                    if callee is not None and callee in model.methods:
+                        out.add(callee)
+            edges[name] = out
+        return edges
+
+    def _attribute_roots(self, model: ClassModel) -> None:
+        if not model.threaded:
+            return
+        edges = self._call_edges(model)
+
+        def reach(entries: Set[str]) -> Set[str]:
+            seen: Set[str] = set()
+            stack = [e for e in entries if e in model.methods]
+            while stack:
+                m = stack.pop()
+                if m in seen:
+                    continue
+                seen.add(m)
+                stack.extend(edges.get(m, ()))
+            return seen
+
+        root_entries: Dict[str, Set[str]] = {}
+        for t in sorted(model.thread_targets):
+            root_entries[f"thread:{t}"] = {t}
+        for h in sorted(model.handler_methods):
+            root_entries[f"handler:{h}"] = {h}
+        taken = model.thread_targets | model.handler_methods
+        publics = {
+            m for m in model.methods
+            if not m.startswith("_") and m not in taken
+        }
+        if model.shared_marked:
+            # handler fan-in: every public method is its own concurrent root
+            for m in sorted(publics):
+                root_entries[f"caller:{m}"] = {m}
+        elif publics:
+            # worker/handler class: external callers form ONE collective
+            # root (we can't tell how many threads call the API, but they
+            # are not the worker's thread — that conflict is real)
+            root_entries["caller"] = publics
+
+        memo: Dict[str, FrozenSet[str]] = {}
+        for label, entries in root_entries.items():
+            for m in reach(entries):
+                memo[m] = frozenset(memo.get(m, frozenset()) | {label})
+        model.roots_of = memo
+
+    # ------------------------------------------------------ access walking
+
+    def _collect_accesses(self, model: ClassModel) -> None:
+        for name, func in model.methods.items():
+            if name == "__init__":
+                continue  # construction happens-before thread start
+            roots = model.roots_of.get(name)
+            if not roots:
+                continue  # unreachable from any root: unattributable
+            self._walk_method(model, name, func, roots)
+
+    def _walk_method(
+        self, model: ClassModel, mname: str, func: ast.AST,
+        roots: FrozenSet[str],
+    ) -> None:
+        accesses: List[Access] = []
+        consumed: Set[int] = set()  # attribute nodes already classified
+
+        def add(attr: Optional[str], kind: str, compound: bool,
+                locks: FrozenSet[str], node: ast.AST) -> None:
+            if attr is None:
+                return
+            if attr in model.primitives or attr in model.locks:
+                return
+            if attr in model.methods:
+                return  # bound methods are code, not shared state
+            accesses.append(
+                Access(attr, kind, compound, locks, roots, mname, node)
+            )
+
+        def with_locks(stmt: ast.With) -> FrozenSet[str]:
+            out: Set[str] = set()
+            for item in stmt.items:
+                attr = _self_attr(item.context_expr)
+                if attr is not None and attr in model.locks:
+                    out.add(model.locks[attr])
+            return frozenset(out)
+
+        def iter_target(expr: ast.AST) -> Optional[ast.Attribute]:
+            """The `self.X` attribute an iteration expression walks, if
+            recognizable: `self.X`, `self.X.items()`, or a transparent
+            wrapper (`enumerate`, `reversed`, ...) around either."""
+            if _self_attr(expr) is not None:
+                return expr  # type: ignore[return-value]
+            if isinstance(expr, ast.Call):
+                if (
+                    isinstance(expr.func, ast.Attribute)
+                    and expr.func.attr in _ITER_METHODS
+                    and not expr.args
+                    and _self_attr(expr.func.value) is not None
+                ):
+                    return expr.func.value  # type: ignore[return-value]
+                if (
+                    terminal_name(expr.func) in _ITER_UNWRAP
+                    and len(expr.args) >= 1
+                ):
+                    return iter_target(expr.args[0])
+            return None
+
+        def classify_iter(expr: ast.AST, held: FrozenSet[str]) -> None:
+            target = iter_target(expr)
+            if target is not None and id(target) not in consumed:
+                add(_self_attr(target), "iterate", False, held, expr)
+                consumed.add(id(target))
+
+        def store_target(t: ast.AST, held: FrozenSet[str]) -> None:
+            attr = _self_attr(t)
+            if attr is not None:
+                add(attr, "write", False, held, t)
+                consumed.add(id(t))
+                return
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr is not None:
+                    add(attr, "mutate", True, held, t)
+                    consumed.add(id(t.value))
+                scan(t.slice, held)
+                return
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for el in t.elts:
+                    store_target(el, held)
+                return
+            if isinstance(t, ast.Starred):
+                store_target(t.value, held)
+                return
+            scan(t, held)
+
+        def scan(node: ast.AST, held: FrozenSet[str]) -> None:
+            if isinstance(node, _ALL_FUNCS):
+                return  # nested defs: execution thread unknowable — silent
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    if _self_attr(item.context_expr) not in model.locks:
+                        scan(item.context_expr, held)
+                held2 = held | with_locks(node)
+                for stmt in node.body:
+                    scan(stmt, held2)
+                return
+            if isinstance(node, ast.Assign):
+                scan(node.value, held)
+                for t in node.targets:
+                    store_target(t, held)
+                return
+            if isinstance(node, ast.AugAssign):
+                scan(node.value, held)
+                attr = _self_attr(node.target)
+                if attr is not None:
+                    add(attr, "write", True, held, node.target)
+                    consumed.add(id(node.target))
+                elif isinstance(node.target, ast.Subscript):
+                    sub = _self_attr(node.target.value)
+                    if sub is not None:
+                        add(sub, "mutate", True, held, node.target)
+                        consumed.add(id(node.target.value))
+                    scan(node.target.slice, held)
+                else:
+                    scan(node.target, held)
+                return
+            if isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr is not None:
+                            add(attr, "mutate", True, held, t)
+                            consumed.add(id(t.value))
+                        scan(t.slice, held)
+                    else:
+                        attr = _self_attr(t)
+                        if attr is not None:
+                            add(attr, "write", True, held, t)
+                            consumed.add(id(t))
+                return
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                classify_iter(node.iter, held)
+                scan(node.iter, held)
+                store_target(node.target, held)
+                for stmt in node.body + node.orelse:
+                    scan(stmt, held)
+                return
+            if isinstance(node, ast.comprehension):
+                classify_iter(node.iter, held)
+                scan(node.iter, held)
+                for cond in node.ifs:
+                    scan(cond, held)
+                return
+            if isinstance(node, ast.Call):
+                callee = _self_attr(node.func)
+                if callee is not None and callee in model.methods:
+                    model.call_sites.setdefault(callee, []).append(
+                        (mname, held)
+                    )
+                recv = (
+                    node.func.value
+                    if isinstance(node.func, ast.Attribute)
+                    else None
+                )
+                recv_attr = _self_attr(recv)
+                if recv_attr is not None and node.func.attr in _MUTATORS:
+                    add(recv_attr, "mutate", True, held, node)
+                    consumed.add(id(recv))
+                elif (
+                    terminal_name(node.func) in _ITER_WRAPPERS
+                    and len(node.args) == 1
+                ):
+                    classify_iter(node.args[0], held)
+                for child in ast.iter_child_nodes(node):
+                    scan(child, held)
+                return
+            if isinstance(node, ast.Attribute):
+                attr = _self_attr(node)
+                if attr is not None and id(node) not in consumed:
+                    if isinstance(node.ctx, ast.Load):
+                        add(attr, "read", False, held, node)
+                    else:
+                        add(attr, "write", False, held, node)
+                    return
+            for child in ast.iter_child_nodes(node):
+                scan(child, held)
+
+        body = func.body if isinstance(func.body, list) else []
+        for stmt in body:
+            scan(stmt, frozenset())
+
+        # check-then-act promotion: a plain rebind in a method that also
+        # READS the same attribute is a read-modify-write (the PR 14
+        # export-claim shape) — promote those writes to compound
+        read_attrs = {
+            a.attr for a in accesses if a.kind in ("read", "iterate", "mutate")
+        }
+        for a in accesses:
+            if a.kind == "write" and not a.compound and a.attr in read_attrs:
+                a.compound = True
+        model.accesses.extend(accesses)
+
+    # ------------------------------------------------------- lock ordering
+
+    def lock_edges(self) -> Iterator[Tuple[str, str, str, ast.AST]]:
+        """(held_key, acquired_key, via, site) acquisition-order edges.
+        Keys are "<ClassName>.<canonical attr>". Direct nesting
+        (`with self.A: with self.B:`) and ONE hop through a same-class
+        method call made while holding A (`with self.A: self.m()` where
+        `m` acquires B) are covered; self-edges are skipped (Condition's
+        default RLock makes reentry legal, and the one-hop heuristic
+        cannot see a release between)."""
+        for model in self.classes:
+            if not model.locks:
+                continue
+            acquires = self._method_acquires(model)
+            for mname, func in model.methods.items():
+                yield from self._edges_in(model, mname, func, acquires)
+
+    def _method_acquires(self, model: ClassModel) -> Dict[str, Set[str]]:
+        """method -> canonical lock attrs it acquires anywhere inside."""
+        out: Dict[str, Set[str]] = {}
+        for name, func in model.methods.items():
+            found: Set[str] = set()
+            for node in ast.walk(func):
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr)
+                        if attr is not None and attr in model.locks:
+                            found.add(model.locks[attr])
+            out[name] = found
+        return out
+
+    def _edges_in(
+        self, model: ClassModel, mname: str, func: ast.AST,
+        acquires: Dict[str, Set[str]],
+    ) -> Iterator[Tuple[str, str, str, ast.AST]]:
+        key = lambda attr: f"{model.name}.{attr}"  # noqa: E731
+
+        def scan(node: ast.AST, held: FrozenSet[str]) -> Iterator[
+            Tuple[str, str, str, ast.AST]
+        ]:
+            if isinstance(node, _ALL_FUNCS):
+                return
+            if isinstance(node, ast.With):
+                new = set()
+                for item in node.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr is not None and attr in model.locks:
+                        new.add(model.locks[attr])
+                for h in held:
+                    for n in new:
+                        if n != h:
+                            yield key(h), key(n), f"`with self.{n}:`", node
+                held2 = held | frozenset(new)
+                for stmt in node.body:
+                    yield from scan(stmt, held2)
+                return
+            if isinstance(node, ast.Call) and held:
+                callee = _self_attr(node.func)
+                if callee is not None and callee in model.methods:
+                    for n in acquires.get(callee, ()):
+                        for h in held:
+                            if n != h:
+                                yield (
+                                    key(h), key(n),
+                                    f"call to `self.{callee}()` which "
+                                    f"acquires `self.{n}`",
+                                    node,
+                                )
+            for child in ast.iter_child_nodes(node):
+                yield from scan(child, held)
+
+        body = func.body if isinstance(func.body, list) else []
+        for stmt in body:
+            yield from scan(stmt, frozenset())

@@ -1,0 +1,177 @@
+"""Incremental lint: content-fingerprinted AST/finding cache + `--watch`.
+
+The editor-integration story (ROADMAP "LSP-style watch mode"): a re-lint
+after a one-file edit should cost one file's parse + rule work, not the
+package's. Two cache layers, both keyed on a sha1 of the file's CONTENT
+(mtime only decides when to poll, never what to trust):
+
+* AST layer: an unchanged file reuses its parsed `FileContext` —
+  including the memoized per-file indices (`_jax_index`,
+  `_thread_index`) the rules hang off it — so only edited files are
+  re-parsed. This is the layer the acceptance criterion pins.
+* Finding layer: a file's per-file rule findings are reused when the
+  file AND the cross-file facts per-file rules consume (the donation
+  registry, plus the select set) are unchanged. Package-scope rules
+  (TL015's lock graph) re-run every time by design — any edit anywhere
+  can change the graph — but they reuse the cached per-file indices, so
+  the re-run is cheap.
+
+`watch_paths` drives the loop: poll mtimes, re-lint through one
+persistent `LintCache` on any change, render each run with the normal
+`--format` renderer (one JSON document per event under `--format json`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from dalle_pytorch_tpu.analysis.core import FileContext
+
+
+class LintCache:
+    """Content-fingerprint cache for incremental lint runs. One instance
+    persists across `lint_paths` calls; counters reset per run so tests
+    (and the `--format json` `cache` block) can pin exactly how much
+    work a re-lint did."""
+
+    def __init__(self):
+        self._ast: Dict[str, Tuple[str, FileContext]] = {}
+        self._findings: Dict[str, Tuple[str, str, list, list]] = {}
+        # per-run counters (begin_run resets)
+        self.files = 0
+        self.reparsed = 0
+        self.ast_hits = 0
+        self.finding_hits = 0
+
+    def begin_run(self) -> None:
+        self.files = 0
+        self.reparsed = 0
+        self.ast_hits = 0
+        self.finding_hits = 0
+
+    # ------------------------------------------------------------ AST layer
+
+    def context_for(
+        self, path: Path, display: str, stable: str
+    ) -> FileContext:
+        """The parsed context for `path`, reusing the cached parse when
+        the content fingerprint matches. Raises like FileContext on
+        unreadable/unparseable files (the driver maps that to TL000)."""
+        self.files += 1
+        key = str(path.resolve())
+        source = path.read_text(encoding="utf-8")
+        digest = hashlib.sha1(source.encode()).hexdigest()
+        hit = self._ast.get(key)
+        if hit is not None and hit[0] == digest:
+            self.ast_hits += 1
+            return hit[1]
+        self.reparsed += 1
+        self._findings.pop(key, None)  # stale by definition
+        ctx = FileContext(path, display, source, stable)
+        ctx._content_digest = digest
+        self._ast[key] = (digest, ctx)
+        return ctx
+
+    # -------------------------------------------------------- finding layer
+
+    @staticmethod
+    def cross_file_key(registry, select: Optional[Set[str]]) -> str:
+        """Digest of every cross-file fact a per-file rule can read: the
+        donation registry (TL003) and the rule selection. A change
+        anywhere in these invalidates every file's cached findings; an
+        edit that leaves them unchanged (the common case) keeps the
+        other files' findings warm."""
+        h = hashlib.sha1()
+        for name in sorted(registry.donors):
+            h.update(f"d:{name}:{sorted(registry.donors[name])};".encode())
+        for name in sorted(registry.builders):
+            h.update(f"b:{name}:{sorted(registry.builders[name])};".encode())
+        h.update(f"s:{sorted(select) if select is not None else '*'}".encode())
+        return h.hexdigest()
+
+    def findings_for(self, ctx: FileContext, xkey: str):
+        key = str(ctx.path.resolve())
+        digest = getattr(ctx, "_content_digest", None)
+        hit = self._findings.get(key)
+        if hit is not None and digest is not None and hit[0] == digest \
+                and hit[1] == xkey:
+            self.finding_hits += 1
+            return list(hit[2]), list(hit[3])
+        return None
+
+    def store_findings(self, ctx, xkey, findings, suppressed) -> None:
+        digest = getattr(ctx, "_content_digest", None)
+        if digest is None:
+            return
+        key = str(ctx.path.resolve())
+        self._findings[key] = (digest, xkey, list(findings), list(suppressed))
+
+    def stats_dict(self) -> dict:
+        return {
+            "files": self.files,
+            "reparsed": self.reparsed,
+            "ast_hits": self.ast_hits,
+            "finding_hits": self.finding_hits,
+        }
+
+
+def _snapshot(paths: Sequence[Path]) -> Dict[str, Tuple[float, int]]:
+    """path -> (mtime, size) over the current expansion of `paths` —
+    re-expanded every poll so created/deleted files register as changes."""
+    from dalle_pytorch_tpu.analysis.lint import iter_python_files
+
+    snap: Dict[str, Tuple[float, int]] = {}
+    for path, _stable in iter_python_files([Path(p) for p in paths]):
+        try:
+            st = path.stat()
+        except OSError:
+            continue
+        snap[str(path.resolve())] = (st.st_mtime, st.st_size)
+    return snap
+
+
+def watch_paths(
+    paths: Sequence[Path],
+    select: Optional[Set[str]] = None,
+    baseline_fingerprints: Optional[Set[str]] = None,
+    fmt: str = "text",
+    poll_s: float = 0.5,
+    max_events: Optional[int] = None,
+    stream=None,
+    sleep_fn: Callable[[float], None] = time.sleep,
+) -> int:
+    """Lint once, then re-lint on every observed mtime change until
+    interrupted (or `max_events` lint runs, for tests/embedders). The
+    return value is the LAST run's severity bitmask, so a bounded watch
+    is scriptable. `sleep_fn` is the poll-wait seam — tests inject a
+    function that edits files instead of sleeping."""
+    from dalle_pytorch_tpu.analysis.lint import RENDERERS, exit_code, lint_paths
+
+    stream = stream if stream is not None else sys.stdout
+    render = RENDERERS[fmt]
+    cache = LintCache()
+    rc = 0
+    events = 0
+    snap = _snapshot(paths)
+    while True:
+        result = lint_paths(
+            paths,
+            select=select,
+            baseline_fingerprints=baseline_fingerprints,
+            cache=cache,
+        )
+        rc = exit_code(result)
+        print(render(result), file=stream, flush=True)
+        events += 1
+        if max_events is not None and events >= max_events:
+            return rc
+        while True:
+            sleep_fn(poll_s)
+            fresh = _snapshot(paths)
+            if fresh != snap:
+                snap = fresh
+                break

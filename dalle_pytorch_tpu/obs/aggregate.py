@@ -463,18 +463,26 @@ class TraceExporter:
     # ------------------------------------------------------------- detail
 
     def detail(self) -> Dict:
+        with self._lock:
+            # counters are mutated under the lock from both the shipper
+            # thread and export() callers — snapshot them coherently
+            # (self.buffered would re-acquire the non-reentrant lock, so
+            # read the buffer length directly here)
+            counters = {
+                "buffered": len(self._buf),
+                "traces_sent": self.traces_sent,
+                "spans_serialized": self.spans_serialized,
+                "dropped": self.dropped,
+                "retries": self.retries,
+                "consecutive_failures": self.consecutive_failures,
+            }
         return {
             "url": self.url,
             "site": self.site,
             "pid": self.pid,
             "host": self.host,
-            "buffered": self.buffered,
             "max_buffer": self.max_buffer,
-            "traces_sent": self.traces_sent,
-            "spans_serialized": self.spans_serialized,
-            "dropped": self.dropped,
-            "retries": self.retries,
-            "consecutive_failures": self.consecutive_failures,
+            **counters,
             "current_backoff_s": self.current_backoff_s,
             "last_error": self.last_error,
         }

@@ -98,6 +98,7 @@ class _Bundle:
         return min((s["t0"] for s in self.spans.values()), default=None)
 
 
+# tracelint: threads
 class TraceCollector:
     """Embeddable span-joining store + analytics (no sockets here; the
     HTTP face is `CollectorServer`). All methods are thread-safe: ingest
@@ -537,19 +538,24 @@ class TraceCollector:
             self._sweep_locked(time.monotonic())
             sealed = sum(1 for b in self._bundles.values() if b.sealed)
             total = len(self._bundles)
+            # counters are bumped under the lock by concurrent ingest
+            # handler threads — snapshot them coherently here too
+            counters = {
+                "records_ingested": self.records_ingested,
+                "spans_ingested": self.spans_ingested,
+                "duplicate_spans": self.duplicate_spans,
+                "late_spans": self.late_spans,
+                "bad_records": self.bad_records,
+                "bad_spans": self.bad_spans,
+                "traces_evicted": self.traces_evicted,
+            }
         return {
             "traces": total,
             "sealed": sealed,
             "settling": total - sealed,
             "grace_s": self.grace_s,
             "max_traces": self.max_traces,
-            "records_ingested": self.records_ingested,
-            "spans_ingested": self.spans_ingested,
-            "duplicate_spans": self.duplicate_spans,
-            "late_spans": self.late_spans,
-            "bad_records": self.bad_records,
-            "bad_spans": self.bad_spans,
-            "traces_evicted": self.traces_evicted,
+            **counters,
         }
 
 

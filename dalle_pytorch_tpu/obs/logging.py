@@ -40,6 +40,7 @@ import time
 from typing import Dict, Optional
 
 
+# tracelint: threads
 class StructuredLog:
     """Thread-safe JSONL writer. Failures to write never raise into the
     serving path (a closed pipe must not fail a request)."""

@@ -270,6 +270,7 @@ class Trace:
         return self.root.duration_s
 
 
+# tracelint: threads
 class Tracer:
     """Mints traces, owns the finished-trace ring buffer, exports Perfetto.
 

@@ -202,6 +202,7 @@ class _ProgramRow:
         self.synced = False
 
 
+# tracelint: threads
 class ProgramCostTable:
     """Compile-time cost registry + live MFU/bandwidth accounting.
 
@@ -466,6 +467,7 @@ class _NullVitals:
 NULL_VITALS = _NullVitals()
 
 
+# tracelint: threads
 class StallWatchdog:
     """Stall detectors evaluated on the vitals tick (host state only).
 
@@ -655,6 +657,7 @@ class SLOTarget:
         }
 
 
+# tracelint: threads
 class SLOTracker:
     """Rolling-window SLO burn rate from cumulative histogram buckets.
 
@@ -871,10 +874,13 @@ class EngineVitals:
             # (Attribution is process-wide, like compile_guard itself: a
             # concurrent compile elsewhere costs one skipped sample.)
             return
-        ema = self._wall_ema.get(name)
-        self._wall_ema[name] = (
-            seconds if ema is None else 0.8 * ema + 0.2 * seconds
-        )
+        # under the lock: the sampler thread snapshots this dict per tick
+        # while engine dispatch threads land EMA updates here
+        with self._lock:
+            ema = self._wall_ema.get(name)
+            self._wall_ema[name] = (
+                seconds if ema is None else 0.8 * ema + 0.2 * seconds
+            )
 
     def inflight(self) -> Optional[Dict]:
         name = self._inflight_name
@@ -1023,6 +1029,9 @@ class EngineVitals:
         with self._lock:
             self._ring.append(snap)
             self.samples_taken += 1
+            # snapshot the EMA table while no dispatch thread is mid-update
+            # (dispatch_end mutates it under this lock)
+            wall_ema = dict(self._wall_ema)
         if self._m_inflight_age is not None:
             inflight = snap.get("dispatch_inflight")
             self._m_inflight_age.set(inflight["age_s"] if inflight else 0.0)
@@ -1038,8 +1047,9 @@ class EngineVitals:
             ).items():
                 self._m_hbm.labels(dev).set(stats.get("bytes_in_use", 0))
         if self.watchdog is not None:
-            self.watchdog.check(snap, self._wall_ema)
+            self.watchdog.check(snap, wall_ema)
         if self.slo is not None:
+            # tracelint: disable=TL013 -- SLOTracker.update() is a method call, not a dict mutation; the tracker guards its windows with its own lock (review-hardening round, PR 7)
             self.slo.update()
         return snap
 
@@ -1071,10 +1081,12 @@ class EngineVitals:
 
     def detail(self, n: Optional[int] = None) -> Dict:
         """JSON payload for `GET /debug/vitals`."""
+        with self._lock:  # ticked by the sampler thread under this lock
+            samples_taken = self.samples_taken
         out = {
             "enabled": self.enabled,
             "interval_s": self.interval_s,
-            "samples_taken": self.samples_taken,
+            "samples_taken": samples_taken,
             "summary": self.window_summary(),
             "samples": self.recent(n),
         }

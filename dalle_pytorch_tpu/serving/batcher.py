@@ -576,20 +576,26 @@ class MicroBatcher:
 
     @property
     def queue_depth_rows(self) -> int:
-        return self._queue.rows
+        with self._cond:  # the worker mutates the queue under this lock
+            return self._queue.rows
 
     @property
     def inflight_rows(self) -> int:
         """Rows currently being served by the engine (drain hook: a
         micro-batch in flight; the continuous batcher overrides with its
         live slot count)."""
-        return self._inflight_rows
+        with self._cond:  # the worker counts rows in under this lock
+            return self._inflight_rows
 
     @property
     def quiesced(self) -> bool:
         """True when nothing is queued and nothing is in flight — the
-        'safe to restart this replica' predicate behind graceful drain."""
-        return not len(self._queue) and self.inflight_rows == 0
+        'safe to restart this replica' predicate behind graceful drain.
+        Taken under the lock so drain can't observe 'idle' between a
+        queue pop and the matching in-flight count (the RLock-backed
+        condition makes the nested inflight_rows read reentrant-safe)."""
+        with self._cond:
+            return not len(self._queue) and self.inflight_rows == 0
 
     def class_depths(self) -> Dict[str, int]:
         """{priority class: queued rows} — vitals/healthz snapshot."""
@@ -787,15 +793,16 @@ class MicroBatcher:
         try:
             tokens, pixels = self.engine.generate(specs)
         except Exception as exc:  # fail fast: every waiter gets the error
+            failed_at = time.monotonic()
             # timestamp first: readers check last_error then error_age_s
-            self._last_error_at = time.monotonic()
+            self._last_error_at = failed_at
             self.last_error = exc
             self._m_errors.inc()
             self._mint_incident(batch, exc)
             # errored batches still observe the stage so /metrics and the
             # traces keep agreeing (same contract as the harvest path)
             self.stage_seconds.labels("generate").observe(
-                self._last_error_at - t0, exemplar=_first_trace_id(batch)
+                failed_at - t0, exemplar=_first_trace_id(batch)
             )
             for req in batch:
                 req.trace.end(req._stage_span, error=repr(exc))

@@ -33,6 +33,7 @@ class InjectedFault(RuntimeError):
     """The deliberate failure a fail-Nth rule raises."""
 
 
+# tracelint: threads
 class FaultInjector:
     """Fail, stall, or CRASH the Nth dispatch of a named engine program,
     and corrupt named compile-cache artifacts before they load.

@@ -196,6 +196,7 @@ def request_fingerprint(body: Dict) -> str:
     ).hexdigest()[:24]
 
 
+# tracelint: threads
 class CheckpointRegistry:
     """Bounded store of decode-state checkpoints keyed by request
     fingerprint — the crash-recovery half of migration. Filled by the
@@ -265,6 +266,7 @@ class CheckpointRegistry:
             }
 
 
+# tracelint: threads
 class QuarantineTracker:
     """Consecutive-incident accounting per request fingerprint.
 

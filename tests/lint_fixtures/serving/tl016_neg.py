@@ -1,0 +1,35 @@
+"""TL016 negative fixture: the disciplined shapes.
+
+* the lock protects only the bookkeeping; sleep / engine dispatch / the
+  thread join all happen OUTSIDE the `with` block (the batcher's
+  dispatch-lock timing idiom);
+* the held condition's own `wait_for` releases the lock while parked;
+* `", ".join(...)` under a lock is string work, not a thread join.
+"""
+
+import threading
+import time
+
+
+class Batcher:
+    def __init__(self, engine):
+        self._cond = threading.Condition()
+        self.engine = engine
+        self.names = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: bool(self.names), timeout=0.1)
+                batch = list(self.names)
+            out = self.engine.step_chunk(batch)  # dispatch OUTSIDE the lock
+            time.sleep(0.01)
+            with self._cond:
+                label = ", ".join(str(x) for x in out)
+            del label
+
+    def stop(self):
+        with self._cond:
+            self.names.clear()
+        self._thread.join()

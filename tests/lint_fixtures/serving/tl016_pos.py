@@ -1,0 +1,29 @@
+"""TL016 positive fixture: blocking calls inside `with <lock>:` bodies.
+Three findings — a sleep, an engine dispatch, and a thread join — while
+the condition's own `wait()` (which releases the lock) stays silent."""
+
+import threading
+import time
+
+
+class Batcher:
+    def __init__(self, engine):
+        self._cond = threading.Condition()
+        self._lock = threading.Lock()
+        self.engine = engine
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._cond:
+                time.sleep(0.01)  # TL016: parked with the lock held
+                out = self.engine.step_chunk()  # TL016: dispatch under lock
+                self._cond.wait(0.1)  # silent: releases the held lock
+            self._retire(out)
+
+    def _retire(self, out):
+        del out
+
+    def stop(self):
+        with self._lock:
+            self._thread.join()  # TL016: waits out a thread under a lock

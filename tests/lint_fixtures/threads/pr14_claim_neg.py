@@ -1,0 +1,33 @@
+"""Regression fixture — PR 14's shipped fix: the worker CLAIMS the
+export request under the queue lock before serving, so a timed-out
+caller's withdraw either fully wins or fully loses. Clean."""
+
+import threading
+
+
+class ExportQueue:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending_export = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            claim = None
+            with self._cond:
+                if self._pending_export is not None:
+                    claim, self._pending_export = self._pending_export, None
+            if claim is not None:
+                self._serve(claim)
+
+    def _serve(self, claim):
+        del claim
+
+    def request_export(self):
+        with self._cond:
+            self._pending_export = object()
+
+    def withdraw(self):
+        with self._cond:
+            self._pending_export = None

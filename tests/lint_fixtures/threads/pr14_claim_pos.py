@@ -1,0 +1,36 @@
+"""Regression fixture — PR 14's export-withdraw claim race, as shipped
+before its review-hardening round: the batcher worker served a pending
+checkpoint export with a lock-free check-then-act on the request slot,
+so a timed-out caller's `withdraw()` could clear the slot between the
+worker's check and its destructive serve — a nobody-asked migration.
+TL013 must flag the worker's unguarded claim."""
+
+import threading
+
+
+class ExportQueue:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._pending_export = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            # check-then-act outside the lock: withdraw() can win the
+            # race between the check and the destructive serve
+            if self._pending_export is not None:
+                bundle = self._serve()
+                self._pending_export = None  # TL013: unguarded claim
+                del bundle
+
+    def _serve(self):
+        return object()
+
+    def request_export(self):
+        with self._cond:
+            self._pending_export = object()
+
+    def withdraw(self):
+        with self._cond:
+            self._pending_export = None

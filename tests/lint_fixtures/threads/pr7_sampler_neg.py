@@ -1,0 +1,29 @@
+"""Regression fixture — PR 7's shipped fix: the ring gained a lock;
+appends happen under it and `recent_stalls()` snapshots under it before
+iterating. Clean."""
+
+import collections
+import threading
+
+
+class StallWatchdog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._recent = collections.deque(maxlen=16)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            stall = self._check()
+            if stall is not None:
+                with self._lock:
+                    self._recent.append(stall)
+
+    def _check(self):
+        return None
+
+    def recent_stalls(self):
+        with self._lock:
+            snap = list(self._recent)
+        return [dict(s) for s in snap]

@@ -1,0 +1,28 @@
+"""Regression fixture — PR 7's sampler-vs-/healthz race, as shipped
+before the review-hardening round: the vitals sampler thread appended
+stall records to a plain deque with NO lock while the /healthz handler
+thread iterated it (`RuntimeError: deque mutated during iteration`).
+TL014 must flag the iteration (mutations unguarded too)."""
+
+import collections
+import threading
+
+
+class StallWatchdog:
+    def __init__(self):
+        self._recent = collections.deque(maxlen=16)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            stall = self._check()
+            if stall is not None:
+                self._recent.append(stall)  # sampler thread, lock-free
+
+    def _check(self):
+        return None
+
+    def recent_stalls(self):
+        # the /healthz handler thread called this mid-append
+        return [dict(s) for s in self._recent]  # TL014

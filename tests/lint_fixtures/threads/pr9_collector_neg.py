@@ -1,0 +1,21 @@
+"""Regression fixture — PR 9's shipped collector fix: read endpoints
+iterate SNAPSHOTS taken under the lock. Clean."""
+
+import threading
+
+
+# tracelint: threads
+class TraceCollector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bundles = {}
+
+    def ingest(self, record):
+        with self._lock:
+            self._bundles[record["trace_id"]] = record
+
+    def traces(self, n=None):
+        with self._lock:
+            snap = list(self._bundles.values())
+        out = [b for b in snap]
+        return out[:n] if n else out

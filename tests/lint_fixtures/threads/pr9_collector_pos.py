@@ -1,0 +1,24 @@
+"""Regression fixture — PR 9's collector read race, as shipped before
+its review-hardening round: `POST /ingest` handler threads mutated the
+bundle dict under the lock while `GET /traces` iterated the LIVE dict
+outside it. The class has no worker thread of its own — the concurrency
+is handler fan-in, declared with `# tracelint: threads` (each public
+method is its own concurrent root). TL014 must flag the read."""
+
+import threading
+
+
+# tracelint: threads
+class TraceCollector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bundles = {}
+
+    def ingest(self, record):
+        with self._lock:
+            self._bundles[record["trace_id"]] = record
+
+    def traces(self, n=None):
+        # GET /traces iterated the live dict with no lock
+        out = [b for b in self._bundles.values()]  # TL014
+        return out[:n] if n else out

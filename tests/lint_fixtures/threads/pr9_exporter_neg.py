@@ -1,0 +1,42 @@
+"""Regression fixture — PR 9's shipped exporter fix: every counter
+mutation happens under the lock, and `detail()` snapshots them under it
+too. Clean."""
+
+import collections
+import threading
+
+
+class TraceExporter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = collections.deque()
+        self.traces_sent = 0
+        self.dropped = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            batch = None
+            with self._lock:
+                if self._buf:
+                    batch = self._buf.popleft()
+            if batch is None:
+                continue
+            ok = self._post(batch)
+            with self._lock:
+                if ok:
+                    self.traces_sent += 1
+                else:
+                    self.dropped += 1
+
+    def _post(self, batch):
+        return batch is not None
+
+    def export(self, trace):
+        with self._lock:
+            self._buf.append(trace)
+
+    def detail(self):
+        with self._lock:
+            return {"sent": self.traces_sent, "dropped": self.dropped}

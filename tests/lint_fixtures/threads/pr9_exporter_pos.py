@@ -1,0 +1,40 @@
+"""Regression fixture — PR 9's exporter-counter race, as shipped before
+its review-hardening round: the shipper thread bumped delivery counters
+lock-free while `export()` (request threads) bumped the drop counter and
+`detail()` read them. Two TL013 findings (one per counter)."""
+
+import collections
+import threading
+
+
+class TraceExporter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buf = collections.deque()
+        self.traces_sent = 0
+        self.dropped = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            batch = None
+            with self._lock:
+                if self._buf:
+                    batch = self._buf.popleft()
+            if batch is None:
+                continue
+            if not self._post(batch):
+                self.dropped += 1  # TL013: shipper thread, no lock
+            else:
+                self.traces_sent += 1  # TL013: racing detail()'s read
+
+    def _post(self, batch):
+        return batch is not None
+
+    def export(self, trace):
+        with self._lock:
+            self._buf.append(trace)
+
+    def detail(self):
+        return {"sent": self.traces_sent, "dropped": self.dropped}

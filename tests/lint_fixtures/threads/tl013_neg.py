@@ -1,0 +1,48 @@
+"""TL013 negative fixture: the same worker/caller shapes, disciplined.
+
+* `_counter`: both sides under one lock.
+* `_running`: the GIL-atomic flag idiom — plain write-only rebind in
+  `stop()`, plain read in the worker loop — exempt by design.
+* `_config`: written only in `__init__` (construction happens-before
+  thread start), read everywhere: clean.
+* `_pending`: check-then-act, but entirely under the lock.
+* `_helper_total`: compound-written in a private helper whose only call
+  site holds the lock — the inherited-lock pass must keep this clean.
+"""
+
+import threading
+
+
+class Worker:
+    def __init__(self, config):
+        self._lock = threading.Lock()
+        self._counter = 0
+        self._running = True
+        self._config = dict(config)
+        self._pending = None
+        self._helper_total = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while self._running:
+            with self._lock:
+                self._counter += 1
+                self._bump()
+                if self._pending is not None:
+                    self._pending = None
+
+    def _bump(self):
+        # caller holds the lock (inherited-lock pass)
+        self._helper_total += len(self._config)
+
+    def request(self):
+        with self._lock:
+            self._pending = object()
+
+    def stop(self):
+        self._running = False
+
+    def snapshot(self):
+        with self._lock:
+            return (self._counter, self._helper_total)

@@ -1,0 +1,32 @@
+"""TL013 positive fixture: shared state compound-written on one thread
+root and touched on another with no common lock. Three findings:
+
+1. `_counter`: augassign on the worker thread, no lock at all, read by
+   the caller-root `snapshot()`.
+2. `_errors`: augassign under the lock on the worker, but `snapshot()`
+   reads it lock-free — one side guarded is not guarded.
+3. `_backlog`: container mutation on the worker, no lock, read (len) by
+   the caller root.
+"""
+
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counter = 0
+        self._errors = 0
+        self._backlog = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self._counter += 1  # TL013: unguarded vs snapshot()'s read
+            with self._lock:
+                self._errors += 1  # TL013: snapshot() reads without the lock
+            self._backlog.append(self._counter)  # TL013: unguarded mutation
+
+    def snapshot(self):
+        return (self._counter, self._errors, len(self._backlog))

@@ -1,0 +1,36 @@
+"""TL014 negative fixture: the shipped fixes.
+
+* `export()` snapshots under the lock and iterates the snapshot — the
+  canonical fix.
+* the worker iterating its OWN container lock-free is single-threaded
+  with respect to its mutations: silent.
+* `replace()` swaps the whole list by plain rebind (not a mutation), so
+  a lock-free iteration elsewhere reads a consistent snapshot object.
+"""
+
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans = []
+        self._latest = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._spans.append(object())
+            for s in self._spans:  # same-root iteration: silent
+                _ = s
+            self._latest = [object(), object()]  # whole-object rebind
+
+    def export(self):
+        with self._lock:
+            snap = list(self._spans)
+        return [s for s in snap]
+
+    def recent(self):
+        return [x for x in self._latest]  # iterates a rebind snapshot

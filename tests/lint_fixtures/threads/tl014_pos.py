@@ -1,0 +1,36 @@
+"""TL014 positive fixture: shared containers mutated under the lock on
+the worker thread, iterated lock-free from caller-root methods. Three
+findings — a comprehension, a list(...items()) snapshot call, and a
+`for` loop."""
+
+import collections
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans = []
+        self._index = {}
+        self._rows = collections.deque()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._spans.append(object())
+                self._index[len(self._spans)] = object()
+                self._rows.append(object())
+
+    def export(self):
+        return [s for s in self._spans]  # TL014: iterate outside the lock
+
+    def dump(self):
+        return list(self._index.items())  # TL014: snapshot call, no lock
+
+    def tail(self):
+        out = []
+        for r in self._rows:  # TL014: for-loop outside the lock
+            out.append(r)
+        return out

@@ -1,0 +1,46 @@
+"""TL015 negative fixture: consistent lock ordering.
+
+* every nesting in the file takes `_a` before `_b`;
+* `after()` calls a `_b`-acquiring helper AFTER its `with self._a:`
+  block closed — sequential acquisition, not nesting;
+* Condition(self._a) aliases `_a`, so nesting `_cond` inside `_a` is a
+  reentrant acquisition of the SAME mutex, not a second lock (and never
+  an edge).
+"""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._cond = threading.Condition(self._a)
+        self.entries = []
+
+    def dispatch(self):
+        with self._a:
+            with self._b:
+                return len(self.entries)
+
+    def flush(self):
+        with self._a:
+            self._drain()
+
+    def _drain(self):
+        with self._b:
+            self.entries.clear()
+
+    def after(self):
+        with self._a:
+            self.entries.append(object())
+        self._take_b()
+
+    def _take_b(self):
+        with self._b:
+            self.entries.clear()
+
+    def nudge(self):
+        with self._a:
+            with self._cond:
+                self._cond.notify_all()

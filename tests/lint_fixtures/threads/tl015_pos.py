@@ -1,0 +1,48 @@
+"""TL015 positive fixture: two lock-order inversions, each reported
+ONCE (one finding per cycle).
+
+1. `Router`: `dispatch()` nests state_lock -> seed_lock, `reseed()`
+   nests them the other way round.
+2. `Spool`: the inversion hides one hop away — `flush()` holds `_a` and
+   calls `_drain()` which acquires `_b`, while `park()` nests `_b` ->
+   `_a` directly.
+"""
+
+import threading
+
+
+class Router:
+    def __init__(self):
+        self._state_lock = threading.Lock()
+        self._seed_lock = threading.Lock()
+        self.seed = 0
+
+    def dispatch(self):
+        with self._state_lock:
+            with self._seed_lock:  # TL015: opposite order vs reseed()
+                return self.seed
+
+    def reseed(self):
+        with self._seed_lock:
+            with self._state_lock:
+                self.seed += 1
+
+
+class Spool:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.entries = []
+
+    def flush(self):
+        with self._a:
+            self._drain()  # TL015: _drain takes _b while _a is held
+
+    def _drain(self):
+        with self._b:
+            self.entries.clear()
+
+    def park(self):
+        with self._b:
+            with self._a:
+                self.entries.append(object())

@@ -1,0 +1,569 @@
+"""Thread-model tracelint rules (TL013-TL016), the historical-bug
+regression corpus, the incremental `--watch` cache, and the rule
+selection/timing CLI contracts.
+
+The regression corpus reconstructs the four concurrency bugs this repo
+actually shipped and fixed by hand in review (PR 7 sampler iteration,
+PR 9 collector read, PR 9 exporter counters, PR 14 export-withdraw
+claim) — each must be flagged by the new rules, and each shipped fix
+must lint clean. The package-stays-clean gate in tests/test_analysis.py
+covers the new rules automatically (they are in ALL_RULES).
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from dalle_pytorch_tpu.analysis import PACKAGE_DIR, lint_paths, main
+from dalle_pytorch_tpu.analysis.watch import LintCache, watch_paths
+
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+# ------------------------------------------------------------ rule corpus
+
+
+class TestThreadRuleCorpus:
+    @pytest.mark.parametrize(
+        "fixture, code, expected",
+        [
+            ("threads/tl013_pos.py", "TL013", 3),
+            ("threads/tl014_pos.py", "TL014", 3),
+            ("threads/tl015_pos.py", "TL015", 2),
+            ("serving/tl016_pos.py", "TL016", 3),
+        ],
+    )
+    def test_positive_fixture_caught(self, fixture, code, expected):
+        result = lint_paths([FIXTURES / fixture])
+        got = codes(result)
+        assert got.count(code) == expected, (
+            f"{fixture}: expected {expected} {code} findings, got {got}"
+        )
+        assert all(c == code for c in got), (
+            f"{fixture}: unexpected extra findings {got}"
+        )
+
+    @pytest.mark.parametrize(
+        "fixture",
+        [
+            "threads/tl013_neg.py",
+            "threads/tl014_neg.py",
+            "threads/tl015_neg.py",
+            "serving/tl016_neg.py",
+        ],
+    )
+    def test_negative_fixture_clean(self, fixture):
+        result = lint_paths([FIXTURES / fixture])
+        assert result.clean, (
+            f"{fixture} should be clean, got: "
+            + "; ".join(f.render() for f in result.findings)
+        )
+
+
+class TestRegressionCorpus:
+    """The four known past concurrency bugs, reconstructed: the new
+    rules must flag each buggy shape, and the shipped fix stays clean."""
+
+    @pytest.mark.parametrize(
+        "fixture, expected",
+        [
+            ("pr7_sampler_pos.py", ["TL014"]),
+            ("pr9_collector_pos.py", ["TL014"]),
+            ("pr9_exporter_pos.py", ["TL013", "TL013"]),
+            ("pr14_claim_pos.py", ["TL013"]),
+        ],
+    )
+    def test_historical_bug_flagged(self, fixture, expected):
+        result = lint_paths([FIXTURES / "threads" / fixture])
+        assert sorted(codes(result)) == sorted(expected), (
+            f"{fixture}: " + "; ".join(f.render() for f in result.findings)
+        )
+
+    @pytest.mark.parametrize(
+        "fixture",
+        [
+            "pr7_sampler_neg.py",
+            "pr9_collector_neg.py",
+            "pr9_exporter_neg.py",
+            "pr14_claim_neg.py",
+        ],
+    )
+    def test_shipped_fix_clean(self, fixture):
+        result = lint_paths([FIXTURES / "threads" / fixture])
+        assert result.clean, "; ".join(f.render() for f in result.findings)
+
+
+# ------------------------------------------------------- model behaviors
+
+
+UNMARKED_SHARED = textwrap.dedent(
+    """\
+    import threading
+
+    class Collector:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._traces = {}
+
+        def ingest(self, rec):
+            with self._lock:
+                self._traces[rec["id"]] = rec
+
+        def traces(self):
+            return [t for t in self._traces.values()]
+    """
+)
+
+
+class TestThreadModel:
+    def test_threads_marker_promotes_public_methods_to_roots(self, tmp_path):
+        """A class with no worker thread has one (collective) caller and
+        stays silent; `# tracelint: threads` declares the handler fan-in
+        and the same code flags."""
+        f = tmp_path / "plain.py"
+        f.write_text(UNMARKED_SHARED)
+        assert lint_paths([f]).clean
+        g = tmp_path / "marked.py"
+        g.write_text(
+            UNMARKED_SHARED.replace(
+                "class Collector:", "# tracelint: threads\nclass Collector:"
+            )
+        )
+        assert codes(lint_paths([g])) == ["TL014"]
+
+    def test_plain_flag_rebind_exempt_but_checked_act_flagged(self, tmp_path):
+        """`self._running = False` from stop() is the GIL-atomic flag
+        idiom (exempt); the same store becomes a finding once the worker
+        check-then-acts on the attribute lock-free."""
+        base = textwrap.dedent(
+            """\
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._running = True
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    while self._running:
+                        pass
+
+                def stop(self):
+                    self._running = False
+            """
+        )
+        f = tmp_path / "flag.py"
+        f.write_text(base)
+        assert lint_paths([f]).clean
+        claim = base.replace(
+            "while self._running:\n            pass",
+            "if self._running:\n            self._running = False",
+        )
+        assert claim != base
+        g = tmp_path / "claim.py"
+        g.write_text(claim)
+        assert codes(lint_paths([g])) == ["TL013"]
+
+    def test_inherited_lock_through_private_helper(self, tmp_path):
+        """The `_viable_head` convention: a private helper whose every
+        call site holds the lock runs under it; making ONE call site
+        lock-free breaks the inheritance and the finding appears."""
+        locked = textwrap.dedent(
+            """\
+            import threading
+
+            class B:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                    self._n = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    while True:
+                        with self._cond:
+                            self._bump()
+
+                def _bump(self):
+                    self._n += 1
+
+                def total(self):
+                    with self._cond:
+                        return self._n
+            """
+        )
+        f = tmp_path / "locked.py"
+        f.write_text(locked)
+        assert lint_paths([f]).clean
+        leaky = locked.replace(
+            "with self._cond:\n                self._bump()",
+            "self._bump()",
+        )
+        assert leaky != locked
+        g = tmp_path / "leaky.py"
+        g.write_text(leaky)
+        assert codes(lint_paths([g])) == ["TL013"]
+
+    def test_annotated_lock_binding_recognized(self, tmp_path):
+        """`self._lock: threading.Lock = threading.Lock()` (AnnAssign)
+        binds the lock like the plain form — correctly guarded code must
+        not read as unguarded (code-review regression)."""
+        f = tmp_path / "annotated.py"
+        f.write_text(textwrap.dedent(
+            """\
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock: threading.Lock = threading.Lock()
+                    self._n = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    while True:
+                        with self._lock:
+                            self._n += 1
+
+                def total(self):
+                    with self._lock:
+                        return self._n
+            """
+        ))
+        assert lint_paths([f]).clean, [
+            x.render() for x in lint_paths([f]).findings
+        ]
+
+    def test_tl016_exempts_init(self, tmp_path):
+        """A blocking call under a lock in `__init__` cannot contend
+        with anything — construction happens-before thread start, the
+        same exemption the access index applies (code-review
+        regression). The identical call in a post-construction method
+        still fires."""
+        serving = tmp_path / "serving"
+        serving.mkdir()
+        f = serving / "boot.py"
+        f.write_text(textwrap.dedent(
+            """\
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    with self._lock:
+                        time.sleep(0.1)
+            """
+        ))
+        assert lint_paths([f]).clean
+        g = serving / "live.py"
+        g.write_text(textwrap.dedent(
+            """\
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def step(self):
+                    with self._lock:
+                        time.sleep(0.1)
+            """
+        ))
+        assert codes(lint_paths([g])) == ["TL016"]
+
+    def test_condition_aliases_wrapped_lock(self, tmp_path):
+        """`Condition(self._lock)` acquires the SAME mutex as
+        `with self._lock:` — a write under one and a read under the
+        other share a lock and stay clean (the router's `_drained`
+        idiom)."""
+        f = tmp_path / "alias.py"
+        f.write_text(textwrap.dedent(
+            """\
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._drained = threading.Condition(self._lock)
+                    self._outstanding = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    while True:
+                        with self._drained:
+                            self._outstanding += 1
+
+                def outstanding(self):
+                    with self._lock:
+                        return self._outstanding
+            """
+        ))
+        assert lint_paths([f]).clean
+
+    def test_tl015_cycle_crosses_files(self, tmp_path):
+        """TL015 is package-scope: the two halves of an inversion can
+        live in different modules (same class, methods split across
+        files) and the graph still closes the cycle."""
+        (tmp_path / "one.py").write_text(textwrap.dedent(
+            """\
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """
+        ))
+        (tmp_path / "two.py").write_text(textwrap.dedent(
+            """\
+            import threading
+
+            class R:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """
+        ))
+        result = lint_paths([tmp_path])
+        assert codes(result) == ["TL015"]
+        # each file alone is order-consistent
+        assert lint_paths([tmp_path / "one.py"]).clean
+        assert lint_paths([tmp_path / "two.py"]).clean
+
+    def test_tl016_scoped_to_serving_and_obs(self, tmp_path):
+        """The same sleep-under-lock outside serving//obs/ is out of
+        scope — training scripts hold no latency-critical locks."""
+        src = textwrap.dedent(
+            """\
+            import threading
+            import time
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def step(self):
+                    with self._lock:
+                        time.sleep(0.1)
+            """
+        )
+        outside = tmp_path / "elsewhere.py"
+        outside.write_text(src)
+        assert lint_paths([outside]).clean
+        obs = tmp_path / "obs"
+        obs.mkdir()
+        inside = obs / "sampler.py"
+        inside.write_text(src)
+        assert codes(lint_paths([inside])) == ["TL016"]
+
+    def test_reasoned_suppression_silences_tl013(self, tmp_path):
+        f = tmp_path / "justified.py"
+        f.write_text(textwrap.dedent(
+            """\
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._n = 0
+                    self._thread = threading.Thread(target=self._run)
+
+                def _run(self):
+                    while True:
+                        self._n += 1  # tracelint: disable=TL013 -- fixture: stat is advisory, torn reads acceptable
+
+                def total(self):
+                    return self._n
+            """
+        ))
+        result = lint_paths([f])
+        assert result.clean and len(result.suppressed) == 1
+
+
+# ------------------------------------------------- incremental lint cache
+
+
+class TestIncrementalCache:
+    def _seed(self, tmp_path):
+        (tmp_path / "a.py").write_text("def a():\n    return 1\n")
+        (tmp_path / "b.py").write_text("def b():\n    breakpoint()\n")
+        (tmp_path / "c.py").write_text("def c():\n    return 3\n")
+
+    def test_single_edit_reparses_only_that_file(self, tmp_path):
+        """The acceptance pin: a re-lint after one edit re-parses ONE
+        file; the others hit both the AST and the finding cache."""
+        self._seed(tmp_path)
+        cache = LintCache()
+        first = lint_paths([tmp_path], cache=cache)
+        assert first.cache == {
+            "files": 3, "reparsed": 3, "ast_hits": 0, "finding_hits": 0,
+        }
+        again = lint_paths([tmp_path], cache=cache)
+        assert again.cache == {
+            "files": 3, "reparsed": 0, "ast_hits": 3, "finding_hits": 3,
+        }
+        (tmp_path / "a.py").write_text("def a():\n    return 2\n")
+        third = lint_paths([tmp_path], cache=cache)
+        assert third.cache == {
+            "files": 3, "reparsed": 1, "ast_hits": 2, "finding_hits": 2,
+        }
+        # findings identical across cached and fresh runs
+        assert codes(third) == codes(lint_paths([tmp_path])) == ["TL006"]
+
+    def test_touch_without_content_change_is_a_hit(self, tmp_path):
+        """The cache keys on CONTENT, not mtime: rewriting identical
+        bytes re-parses nothing."""
+        self._seed(tmp_path)
+        cache = LintCache()
+        lint_paths([tmp_path], cache=cache)
+        (tmp_path / "b.py").write_text("def b():\n    breakpoint()\n")
+        again = lint_paths([tmp_path], cache=cache)
+        assert again.cache["reparsed"] == 0
+
+    def test_cross_file_fact_change_invalidates_findings_not_parses(
+        self, tmp_path
+    ):
+        """An edit that changes the donation registry re-runs every
+        file's rules (stale TL003 state) but still re-parses only the
+        edited file."""
+        (tmp_path / "dispatch.py").write_text(textwrap.dedent(
+            """\
+            def _chunk_builder(model, key):
+                def fn(state):
+                    return state
+                return fn
+
+            def _jit_sample(builder, model, key, *args):
+                return builder(model, key)(*args)
+
+            def chunk(state):
+                return _jit_sample(_chunk_builder, None, (), state)
+            """
+        ))
+        (tmp_path / "caller.py").write_text(textwrap.dedent(
+            """\
+            from dispatch import chunk
+
+            def serve(state):
+                new = chunk(state)
+                return state["img_pos"]
+            """
+        ))
+        cache = LintCache()
+        first = lint_paths([tmp_path], cache=cache)
+        assert first.clean  # no donation tag yet: caller.py is clean
+        src = (tmp_path / "dispatch.py").read_text()
+        (tmp_path / "dispatch.py").write_text(
+            src.replace(
+                "def _jit_sample",
+                "_chunk_builder._donate_argnums = (0,)\n\ndef _jit_sample",
+            )
+        )
+        second = lint_paths([tmp_path], cache=cache)
+        assert second.cache["reparsed"] == 1
+        assert second.cache["finding_hits"] == 0  # registry changed
+        assert codes(second) == ["TL003"]
+        assert second.findings[0].path.endswith("caller.py")
+
+    def test_watch_loop_emits_one_json_doc_per_event(self, tmp_path):
+        import io
+
+        self._seed(tmp_path)
+        edits = iter([
+            None,
+            lambda: (tmp_path / "a.py").write_text("import ipdb\n"),
+        ])
+
+        def sleeper(_s):
+            e = next(edits, None)
+            if callable(e):
+                e()
+
+        out = io.StringIO()
+        rc = watch_paths(
+            [tmp_path], fmt="json", max_events=2, stream=out,
+            sleep_fn=sleeper, poll_s=0.01,
+        )
+        assert rc == 1
+        docs, cur = [], []
+        for line in out.getvalue().splitlines():
+            cur.append(line)
+            if line == "}":
+                docs.append(json.loads("\n".join(cur)))
+                cur = []
+        assert len(docs) == 2
+        assert [f["rule"] for f in docs[0]["findings"]] == ["TL006"]
+        assert sorted(f["rule"] for f in docs[1]["findings"]) == [
+            "TL006", "TL006",
+        ]
+        # event 2 is incremental: one reparse, and the per-event JSON
+        # carries the cache counters + per-rule wall times
+        assert docs[1]["cache"]["reparsed"] == 1
+        assert docs[1]["rule_times_ms"]
+
+
+# ----------------------------------------------------- CLI flag contracts
+
+
+class TestSelectionFlags:
+    def test_rules_alias_selects(self):
+        assert main(
+            [str(FIXTURES / "threads" / "tl013_pos.py"), "--rules", "TL006"]
+        ) == 0
+        assert main(
+            [str(FIXTURES / "threads" / "tl013_pos.py"), "--rules", "TL013"]
+        ) == 1
+
+    def test_exclude_rules_drops_only_named(self):
+        target = str(FIXTURES / "threads" / "tl013_pos.py")
+        assert main([target, "--exclude-rules", "TL013"]) == 0
+        assert main([target, "--exclude-rules", "TL014"]) == 1
+
+    def test_exclude_unknown_rule_is_usage_error(self):
+        assert main(["--exclude-rules", "TL999"]) == 2
+
+    def test_rule_times_in_json(self, tmp_path, capsys):
+        f = tmp_path / "x.py"
+        f.write_text("def a():\n    return 1\n")
+        main([str(f), "--format", "json"])
+        payload = json.loads(capsys.readouterr().out)
+        times = payload["rule_times_ms"]
+        assert "TL013" in times and "TL015" in times
+        assert all(t >= 0 for t in times.values())
+        # restricted runs time only the selected rules
+        main([str(f), "--format", "json", "--rules", "TL013"])
+        payload = json.loads(capsys.readouterr().out)
+        assert set(payload["rule_times_ms"]) == {"TL013"}
+
+
+# ------------------------------------------------------- pre-commit gate
+
+
+def test_precommit_entry_point_clean_on_package_files():
+    """The pre-commit hook calls the `dalle-tpu-lint` console script
+    (analysis.lint:main) with the staged .py files as EXPLICIT
+    arguments — which skips the shipped baseline by design. The shipped
+    package must exit 0 through that exact path, new rules included."""
+    staged = sorted(
+        str(p)
+        for sub in ("serving", "obs", "analysis")
+        for p in (PACKAGE_DIR / sub).glob("*.py")
+    )
+    assert staged, "package layout changed?"
+    assert main(staged) == 0
