@@ -72,6 +72,19 @@ accounting; the headline value is the killed-window completion fraction
 SERVE_FLEET_SECONDS (6) / SERVE_FLEET_RPS (auto) / SERVE_FLEET_SLOTS (4)
 / SERVE_HEDGE_MS (off) size it.
 
+Streaming previews (`--stream`, SERVE_STREAM=1): the progressive-preview
+acceptance instrument. One continuous engine warmed WITH the preview
+fill-decode program (`preview_enabled=True`), open-loop Poisson arrivals
+each submitted with a live `RequestStream` — the same object an SSE
+client hangs on — so every chunk boundary emits progress and every
+SERVE_PREVIEW_EVERY (default 1) chunks pays the snapshot + preview
+dispatch. The JSON line reports TTFP (time-to-first-preview) p50/p95
+alongside TTFT and the headline `ttfp_p95_chunk_periods`: the p95 gap
+between first preview and first token in measured chunk periods, which
+the streaming PR accepts at <= ~2 (one period to reach a boundary, one
+for the preview dispatch riding it). SERVE_STREAM_SECONDS (8) sizes the
+window.
+
 Fleet tracing (`--trace_export`, SERVE_TRACE_EXPORT=1): every measured
 request is traced client-side (the bench plays the ingress role) and
 shipped through a real `TraceExporter` to an in-process
@@ -703,6 +716,211 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
     print(json.dumps(cont_line), flush=True)
     if collector_srv is not None:
         collector_srv.shutdown()
+
+
+def run_stream_open_loop(batcher, arrivals, seeds, texts, timeout_s=120.0):
+    """Replay a Poisson schedule with a live event stream per request.
+
+    Every submit carries a `RequestStream` (the same object the SSE
+    handler hangs a client on), so the batcher's chunk-boundary callback
+    emits progress events and — every `preview_every` chunks — pays the
+    snapshot + preview fill-decode dispatch. TTFP (time-to-first-preview)
+    is stamped the moment `preview()` lands the event in the ring: that
+    is when an attached SSE reader would wake, so it times exactly what a
+    streaming client sees minus PNG encoding (the server's cost, not the
+    engine's). Returns TTFT percentiles like `run_open_loop` plus
+    ttfp_p50/p95/mean and per-stream event accounting.
+    """
+    from dalle_pytorch_tpu.serving.engine import SampleSpec
+    from dalle_pytorch_tpu.serving.streaming import RequestStream
+
+    class _TimedStream(RequestStream):
+        # bench-side stamps: the batcher worker calls progress()/preview()
+        # at chunk boundaries, so monotonic-on-emit is reader-visible time
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            self.first_progress_at = None
+            self.first_preview_at = None
+
+        def progress(self, chunk, **data):
+            ok = super().progress(chunk, **data)
+            if ok and self.first_progress_at is None:
+                self.first_progress_at = time.monotonic()
+            return ok
+
+        def preview(self, chunk, **data):
+            ok = super().preview(chunk, **data)
+            if ok and self.first_preview_at is None:
+                self.first_preview_at = time.monotonic()
+            return ok
+
+    submitted, rejected = [], 0
+    t_start = time.monotonic()
+    for i, (offset, seed) in enumerate(zip(arrivals, seeds)):
+        delay = t_start + offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        stream = _TimedStream(key=f"bench-stream-{i}")
+        try:
+            req = batcher.submit(
+                [SampleSpec(texts[i], seed=int(seed))], timeout_s=timeout_s,
+                stream=stream,
+            )
+            submitted.append((time.monotonic(), req, stream))
+        except Exception:  # queue-full backpressure counts against the engine
+            rejected += 1
+
+    ttfts, ttfps, errors = [], [], 0
+    previews_total = progress_total = 0
+    last_done = time.monotonic()
+    for t_submit, req, stream in submitted:
+        try:
+            req.future.result(timeout=timeout_s)
+        except Exception:
+            errors += 1
+            continue
+        last_done = max(last_done, time.monotonic())
+        if req.first_token_at is not None:
+            ttfts.append(req.first_token_at - t_submit)
+        if stream.first_preview_at is not None:
+            ttfps.append(stream.first_preview_at - t_submit)
+        previews_total += stream.previews_sent
+        progress_total += stream.events_emitted - stream.previews_sent
+    wall = last_done - t_start
+    completed = len(submitted) - errors
+    span = max(wall, 1e-9)
+    return {
+        "offered": len(arrivals),
+        "submitted": len(submitted),
+        "rejected": rejected,
+        "completed": completed,
+        "errors": errors,
+        "wall_s": round(wall, 3),
+        "rps": round(completed / span, 3),
+        "ttft_p50_ms": round(1000 * _percentile(ttfts, 0.5), 1) if ttfts else None,
+        "ttft_p95_ms": round(1000 * _percentile(ttfts, 0.95), 1) if ttfts else None,
+        "ttfp_p50_ms": round(1000 * _percentile(ttfps, 0.5), 1) if ttfps else None,
+        "ttfp_p95_ms": round(1000 * _percentile(ttfps, 0.95), 1) if ttfps else None,
+        "ttfp_mean_ms": round(1000 * sum(ttfps) / len(ttfps), 1) if ttfps else None,
+        "streams_with_preview": len(ttfps),
+        "previews_total": int(previews_total),
+        "progress_events_total": int(progress_total),
+    }
+
+
+def main_stream_bench(kv_layout="slot"):
+    """`--stream`: the streaming-previews acceptance instrument.
+
+    One continuous engine with the preview fill-decode program warmed
+    (`preview_enabled=True`), one open-loop Poisson replay where every
+    request carries a live event stream. The headline is p95 TTFP in
+    chunk periods (`ttfp_p95_chunk_periods`): a preview is one snapshot +
+    one extra compiled dispatch at a chunk boundary, so time-to-first-
+    pixels should sit within ~2 chunk periods of admission — the
+    acceptance bound — while full-image TTFT is the whole decode away.
+    SERVE_PREVIEW_EVERY (default 1) sets the preview cadence;
+    SERVE_STREAM_SECONDS (default 8) the window.
+    """
+    import jax
+    import numpy as np
+
+    from dalle_pytorch_tpu.serving.batcher import ContinuousBatcher
+    from dalle_pytorch_tpu.serving.engine import (
+        ContinuousEngine, PagedContinuousEngine,
+    )
+    from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+    os.environ.setdefault("SERVE_DIM", "128")
+    os.environ.setdefault("SERVE_DEPTH", "3")
+    os.environ.setdefault("SERVE_FMAP", "8")
+    shapes = tuple(
+        int(b) for b in os.environ.get("SERVE_BATCH_SHAPES", "1,4,8").split(",")
+    )
+    chunk_tokens = int(os.environ.get("SERVE_CHUNK_TOKENS", "8"))
+    duration_s = float(os.environ.get("SERVE_STREAM_SECONDS", "8"))
+    preview_every = int(os.environ.get("SERVE_PREVIEW_EVERY", "1"))
+    prefill_batch = int(os.environ.get("SERVE_PREFILL_BATCH", "4"))
+    max_batch = max(shapes)
+
+    model, params, vae, vae_params, text_ids = build_toy()
+    engine_kw = dict(
+        model=model, variables=params, vae=vae, vae_params=vae_params,
+        max_batch=max_batch, chunk_tokens=chunk_tokens,
+        prefill_batch=prefill_batch, registry=MetricsRegistry(),
+        preview_enabled=True,
+    )
+    if kv_layout == "paged":
+        cont = PagedContinuousEngine(
+            page_size=int(os.environ.get("SERVE_PAGE_SIZE", "16")),
+            **engine_kw,
+        )
+    else:
+        cont = ContinuousEngine(**engine_kw)
+    from dalle_pytorch_tpu.obs import ProgramCostTable
+
+    cont.cost_table = ProgramCostTable(registry=cont.registry)
+    cont.warmup()
+    cb = ContinuousBatcher(
+        cont, max_queue_rows=max(64, 4 * max_batch), registry=cont.registry,
+        preview_every=preview_every,
+    )
+
+    def _unique_text(cid, i):
+        r = np.random.default_rng([cid, i])
+        return r.integers(
+            1, model.num_text_tokens, size=model.text_seq_len
+        ).astype(np.int32)
+
+    cap = _sustained_rps(cb, text_ids, make_text=_unique_text)
+    rate = float(os.environ.get("SERVE_RATE_RPS", 0.4 * cap))
+    rng = np.random.default_rng(int(os.environ.get("SERVE_ARRIVAL_SEED", "0")))
+    gaps = rng.exponential(1.0 / rate, size=int(rate * duration_s) + 1)
+    arrivals = np.cumsum(gaps)
+    arrivals = arrivals[arrivals < duration_s]
+    seeds = rng.integers(0, 2**31 - 1, size=len(arrivals))
+    texts = draw_prompt_schedule(
+        rng, len(arrivals), model.text_seq_len, model.num_text_tokens, 0.0,
+    )
+
+    stages0 = _stage_snapshot(cont.registry)
+    stats = run_stream_open_loop(cb, arrivals, seeds, texts)
+    cb.shutdown(drain=True)
+    stages = _stage_breakdown(cont.registry, stages0)
+    line = {
+        "metric": "serving_stream_ttfp",
+        "unit": "ms",
+        "device": jax.devices()[0].platform,
+        "mode": "stream",
+        "engine": "continuous",
+        "kv_layout": kv_layout,
+        "value": stats["ttfp_p95_ms"],
+        "rate_rps": round(rate, 3),
+        "duration_s": duration_s,
+        "chunk_tokens": chunk_tokens,
+        "preview_every": preview_every,
+        "continuous_saturation_rps": round(cap, 3),
+        **stats,
+        "stream_events": _class_counter_values(
+            cont.registry, "dalle_serving_stream_events_total"
+        ),
+        "stages": stages,
+    }
+    # the acceptance bound: first preview within ~2 chunk periods of the
+    # request's first decode work (one period to REACH a boundary, one for
+    # the snapshot + preview dispatch riding it); the chunk period is
+    # measured from this window's own stage breakdown
+    chunk_ms = (stages.get("chunk") or {}).get("mean_ms")
+    if chunk_ms and stats["ttfp_p95_ms"] and stats["ttft_p95_ms"]:
+        line["chunk_period_ms"] = chunk_ms
+        # queueing + prefill delay is TTFT-side, common to both numbers;
+        # the preview machinery's own cost is the gap between first
+        # preview and first token, which is what the bound polices
+        ttfp_over_ttft_ms = stats["ttfp_p95_ms"] - stats["ttft_p95_ms"]
+        line["ttfp_p95_minus_ttft_p95_ms"] = round(ttfp_over_ttft_ms, 1)
+        line["ttfp_p95_chunk_periods"] = round(
+            max(ttfp_over_ttft_ms, 0.0) / chunk_ms, 2
+        )
+    print(json.dumps(line), flush=True)
 
 
 def _class_counter_values(registry, name):
@@ -1468,6 +1686,7 @@ class _ReplicaProc:
         )
         self.lines = []
         self.events = []
+        self._lock = threading.Lock()
         self.ready_at = None
         self.port = None
         self._ready = threading.Event()
@@ -1476,7 +1695,8 @@ class _ReplicaProc:
 
     def _pump(self):
         for line in self.proc.stdout:
-            self.lines.append(line)
+            with self._lock:
+                self.lines.append(line)
             if "listening on http://" in line:
                 self.ready_at = time.perf_counter()
                 self.port = int(
@@ -1485,19 +1705,24 @@ class _ReplicaProc:
                 self._ready.set()
             elif line.startswith("{"):
                 try:
-                    self.events.append(json.loads(line))
+                    rec = json.loads(line)
                 except ValueError:
-                    pass
+                    continue
+                with self._lock:
+                    self.events.append(rec)
         self._ready.set()  # EOF: unblock waiters (boot failed)
 
     def wait_ready(self, timeout=600.0):
-        assert self._ready.wait(timeout) and self.port is not None, (
-            "replica never came up:\n" + "".join(self.lines[-40:])
-        )
+        ok = self._ready.wait(timeout) and self.port is not None
+        with self._lock:
+            tail = "".join(self.lines[-40:])
+        assert ok, "replica never came up:\n" + tail
         return self.ready_at - self.t0
 
     def event(self, name, default=None):
-        for rec in reversed(self.events):
+        with self._lock:
+            events = list(self.events)
+        for rec in reversed(events):
             if rec.get("event") == name:
                 return rec
         return default
@@ -1806,6 +2031,16 @@ def main():
         "(SERVE_RESTART_SECONDS / SERVE_RESTART_RPS)",
     )
     p.add_argument(
+        "--stream", action="store_true",
+        default=os.environ.get("SERVE_STREAM", "0") in ("1", "true"),
+        help="streaming-previews mode: one continuous engine with the "
+        "preview fill-decode program warmed, open-loop arrivals each "
+        "carrying a live event stream; the JSON line reports TTFP "
+        "(time-to-first-preview) p50/p95 alongside TTFT and the "
+        "headline ttfp_p95_chunk_periods acceptance ratio "
+        "(SERVE_PREVIEW_EVERY / SERVE_STREAM_SECONDS)",
+    )
+    p.add_argument(
         "--trace_export", action="store_true",
         default=os.environ.get("SERVE_TRACE_EXPORT", "0") in ("1", "true"),
         help="open-loop: trace every measured request through an "
@@ -1815,7 +2050,9 @@ def main():
         "engine's JSON line",
     )
     args = p.parse_args()
-    if args.drain_bench:
+    if args.stream:
+        main_stream_bench(kv_layout=args.kv_layout)
+    elif args.drain_bench:
         main_drain_bench()
     elif args.restart_bench:
         main_restart_bench()

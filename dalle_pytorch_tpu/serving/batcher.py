@@ -101,14 +101,35 @@ class _Future:
         self._event = threading.Event()
         self._result = None
         self._exception: Optional[BaseException] = None
+        self._callbacks: List = []
+
+    def add_done_callback(self, fn) -> None:
+        """Run `fn()` once the future resolves (immediately if it
+        already has). The SSE layer uses this to nudge a stream reader
+        blocked on its event condition, whatever path resolved the
+        future (retire, reap, recover, migrate). Callbacks must be
+        idempotent and non-blocking; a late concurrent add may fire
+        twice."""
+        self._callbacks.append(fn)
+        if self._event.is_set():
+            fn()
+
+    def _notify(self) -> None:
+        for fn in list(self._callbacks):
+            try:
+                fn()
+            except Exception:
+                pass
 
     def set_result(self, result) -> None:
         self._result = result
         self._event.set()
+        self._notify()
 
     def set_exception(self, exc: BaseException) -> None:
         self._exception = exc
         self._event.set()
+        self._notify()
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -197,6 +218,11 @@ class GenRequest:
         # engine doesn't report admission stats. Rides into the structured
         # request log so per-request traces explain cheap vs full prefills.
         self.prefix_hit: Optional[bool] = None
+        #: SSE event channel (serving/streaming.py RequestStream) when
+        #: the client asked for a streamed response — the worker emits
+        #: chunk-boundary progress/preview events onto it; None for
+        #: ordinary request/response traffic
+        self.stream = None
 
     @property
     def rows(self) -> int:
@@ -448,6 +474,7 @@ class MicroBatcher:
         request_key: Optional[str] = None,
         resume=None,
         resume_bytes: Optional[int] = None,
+        stream=None,
     ) -> GenRequest:
         """Enqueue one request; returns it (result via `req.future.result()`).
 
@@ -462,13 +489,22 @@ class MicroBatcher:
         decode-state resume — it enters like a preempt-resume, at the
         FRONT of its own (class, tenant) queue, and every admission
         bound below charges only its PENDING rows (rows the checkpoint
-        already completed occupy nothing).
+        already completed occupy nothing). `stream` (a
+        `streaming.RequestStream`) opts the request into chunk-boundary
+        SSE events from the continuous worker.
         """
         req = GenRequest(
             specs, timeout_s=timeout_s, trace=trace,
             priority=priority, tenant=tenant,
         )
         req.request_key = request_key
+        if stream is not None:
+            req.stream = stream
+            stream.request = req
+            # whatever path resolves the future (retire/reap/recover/
+            # migrate), the blocked SSE reader wakes to write the
+            # terminal event instead of sleeping out its poll timeout
+            req.future.add_done_callback(stream.wake)
         if resume is not None:
             req.apply_resume(resume, nbytes=resume_bytes)
         with self._cond:
@@ -898,6 +934,7 @@ class ContinuousBatcher(MicroBatcher):
         reserve_slots: int = 0,
         spool=None,
         spool_every: int = 8,
+        preview_every: int = 4,
     ):
         """`engine` needs the slot surface of `ContinuousEngine`
         (`prefill_slot` / `step_chunk` / `harvest` / `release` /
@@ -914,7 +951,12 @@ class ContinuousBatcher(MicroBatcher):
         capacity). `spool` (a `migrate.CheckpointSpool`) arms the crash
         progress beacon: every `spool_every` chunks the worker journals
         in-flight decode-state checkpoints to it at the chunk boundary,
-        so a hard kill loses at most that many chunks of bookkeeping."""
+        so a hard kill loses at most that many chunks of bookkeeping.
+        `preview_every` sets the progressive-preview cadence for
+        streaming requests (one shared fill+decode dispatch per due
+        chunk boundary; 0 disables previews — progress events still
+        flow)."""
+        self.preview_every = max(0, int(preview_every))
         self.preempt = bool(preempt)
         self.deadline_shed = bool(deadline_shed)
         self.reserve_slots = int(reserve_slots)
@@ -1016,6 +1058,20 @@ class ContinuousBatcher(MicroBatcher):
             f"{p}_migrated_out_total",
             "requests exported as decode-state checkpoints at a chunk "
             "boundary by drain?migrate=1",
+        )
+        # ------------------------------- streaming (serving/streaming.py)
+        self._m_ttfp = self.registry.histogram(
+            f"{p}_ttfp_seconds",
+            "enqueue-to-first-preview-pixels latency per streaming "
+            "request (chunk-boundary granularity) — the user-facing "
+            "first-paint metric, vs ttft's first-token",
+        )
+        self._m_stream_events = self.registry.counter_family(
+            f"{p}_stream_events_total",
+            "SSE stream events emitted, by type (progress/preview from "
+            "the worker at chunk boundaries; open/result/error/migrated "
+            "from the HTTP layer)",
+            label_name="type",
         )
 
     def state_summary(self) -> dict:
@@ -1395,6 +1451,12 @@ class ContinuousBatcher(MicroBatcher):
                     if img_pos[slot] >= self.engine.image_seq_len:
                         finished.append(slot)
                 self._last_img_pos = img_pos
+                # streaming: progress events for every live streamed
+                # request, plus (cadence-gated, see _emit_stream_events)
+                # one shared preview snapshot + fill+decode dispatch —
+                # BEFORE _retire so the final boundary's progress event
+                # still sees the finished rows' slots
+                self._emit_stream_events(inflight, img_pos, now)
                 if finished:
                     # harvest/release are engine dispatches too — a failure
                     # here must fail fast like the chunk path, not kill the
@@ -1429,6 +1491,147 @@ class ContinuousBatcher(MicroBatcher):
                 self._recover(exc, inflight, partial)
                 continue
             self._set_slots_gauge()
+
+    # ----------------------------------------------- streaming (boundary)
+
+    def _emit_stream_events(self, inflight, img_pos, now) -> None:  # tracelint: hotloop
+        """Chunk-boundary streaming emission (worker thread). Every live
+        streamed request gets a progress event keyed by its REQUEST-level
+        chunk index — min decode position across its in-flight rows, in
+        chunks — which the stream's monotonic high water deduplicates
+        (a restarted non-resume re-decode replays below it silently, so
+        readers never see a duplicated or regressing chunk). Requests
+        whose index crossed a `preview_every` multiple share ONE
+        `snapshot_rows` transfer and ONE fill+decode dispatch
+        (`engine.preview_pixels`, the warmed `preview` program) for the
+        whole boundary; pixels ride the event raw — the SSE reader
+        thread pays the PNG encode, never this loop. Preview cadence is
+        the TL012 guard: the snapshot runs at most once per
+        `preview_every` request-chunks, only at a boundary, and a
+        preview failure drops this boundary's previews without touching
+        decode."""
+        per_req: dict = {}
+        for slot, (req, idx) in inflight.items():
+            if req.stream is not None:
+                per_req.setdefault(req, []).append((slot, idx))
+        if not per_req:
+            return
+        chunk_tokens = max(
+            1,
+            int(getattr(
+                self.engine, "chunk_tokens", getattr(self.engine, "chunk", 1)
+            )),
+        )
+        seq_len = int(self.engine.image_seq_len)
+        due: List = []  # (req, stream, req_chunk, {slot: pos}, {idx: slot})
+        for req, rows in per_req.items():
+            stream = req.stream
+            info = self._partial.get(req)
+            done_rows = sum(
+                1 for t in (info["tokens"] if info else ()) if t is not None
+            )
+            positions = {slot: int(img_pos[slot]) for slot, _ in rows}
+            req_chunk = min(positions.values()) // chunk_tokens
+            if stream.progress(
+                req_chunk,
+                tokens=sum(positions.values()) + done_rows * seq_len,
+                total_tokens=req.rows * seq_len,
+                rows=req.rows,
+                slots=sorted(positions),
+                trace_id=req.trace.trace_id or None,
+            ):
+                self._m_stream_events.labels("progress").inc()
+            if stream.preview_due(req_chunk, self.preview_every):
+                due.append((
+                    req, stream, req_chunk, positions,
+                    {idx: slot for slot, idx in rows},
+                ))
+        if not due:
+            return
+        previewer = getattr(self.engine, "preview_pixels", None)
+        snap_fn = getattr(self.engine, "snapshot_rows", None)
+        if (
+            previewer is None or snap_fn is None
+            or not getattr(self.engine, "preview_enabled", True)
+        ):
+            # engine can't preview (no fill+decode program warmed):
+            # progress streams still flow, previews just never fire
+            return
+        t0 = time.monotonic()
+        spans = [
+            (req, req.trace.begin("preview", chunk=c))
+            for req, _st, c, _pos, _rows in due
+        ]
+        try:
+            all_slots = sorted(
+                s for _r, _st, _c, positions, _rows in due for s in positions
+            )
+            snap = dict(zip(all_slots, snap_fn(all_slots)))
+            batch_toks: List = []
+            batch_pos: List = []
+            layout: List = []  # (req, stream, req_chunk, ordered row idxs)
+            for req, stream, req_chunk, positions, slot_of in due:
+                info = self._partial.get(req)
+                order = []
+                for i in range(req.rows):
+                    slot = slot_of.get(i)
+                    if slot is not None:
+                        batch_toks.append(np.asarray(snap[slot], np.int32))  # tracelint: disable=TL002 -- snapshot_rows already ran its fused device_get; this slices the host copy at the chunk boundary
+                        batch_pos.append(positions[slot])
+                        order.append(i)
+                    elif info is not None and info["tokens"][i] is not None:
+                        # row finished earlier: preview it complete
+                        batch_toks.append(
+                            np.asarray(info["tokens"][i], np.int32)  # tracelint: disable=TL002 -- harvested rows are host arrays already; no device sync here
+                        )
+                        batch_pos.append(seq_len)
+                        order.append(i)
+                layout.append((req, stream, req_chunk, order))
+            pixels = previewer(
+                np.stack(batch_toks), np.asarray(batch_pos, np.int32)
+            )
+        except Exception as exc:
+            # previews are best-effort: a failed fill+decode loses this
+            # boundary's previews, never the requests (unlike the chunk
+            # path, no decode state was donated into it)
+            for req, sp in spans:
+                req.trace.end(sp, error=repr(exc))
+            if self.log is not None:
+                self.log.event("preview_failed", error=repr(exc))
+            return
+        if pixels is None:
+            for req, sp in spans:
+                req.trace.end(sp, rows=0)
+            return
+        preview_s = time.monotonic() - t0
+        span_of = dict((id(req), sp) for req, sp in spans)
+        offset = 0
+        for req, stream, req_chunk, order in layout:
+            pix = pixels[offset : offset + len(order)]
+            offset += len(order)
+            first = stream.previews_sent == 0
+            if stream.preview(
+                req_chunk,
+                rows=list(order),
+                pixels=np.asarray(pix),  # tracelint: disable=TL002 -- preview_pixels returns after its own designed sync; this is a host-side slice
+                trace_id=req.trace.trace_id or None,
+            ):
+                self._m_stream_events.labels("preview").inc()
+                if first:
+                    # time-to-first-pixels: the streaming analogue of
+                    # TTFT — enqueue to the first preview a client could
+                    # have painted
+                    self._m_ttfp.observe(
+                        now - req.enqueued_at,
+                        exemplar=req.trace.trace_id or None,
+                    )
+            req.trace.end(
+                span_of[id(req)], rows=len(order), previews=stream.previews_sent
+            )
+        self.stage_seconds.labels("preview").observe(
+            preview_s,
+            exemplar=_first_trace_id([req for req, _st, _c, _o in layout]),
+        )
 
     # --------------------------------------------------- QoS / preemption
 

@@ -526,6 +526,7 @@ class ContinuousEngine(GenerationEngine):
         registry=None,
         cfg=None,
         resume_enabled: bool = False,
+        preview_enabled: bool = False,
     ):
         assert float(cond_scale) == 1.0, (
             "ContinuousEngine does not support classifier-free guidance yet "
@@ -552,6 +553,13 @@ class ContinuousEngine(GenerationEngine):
         # position instead of 0. Opt-in: the ladder, warmup and boot
         # fingerprint grow the `resume` program only when enabled.
         self.resume_enabled = bool(resume_enabled)
+        # progressive previews (serving/streaming.py): one extra compiled
+        # fill+decode program — undecoded grid positions filled with the
+        # mean-codebook token, then the standard pixel decode — shared by
+        # every streaming request. Opt-in like `resume`: the ladder,
+        # warmup and boot fingerprint grow the `preview` program only
+        # when enabled (serving boots enable it by default).
+        self.preview_enabled = bool(preview_enabled)
         self.chunk_tokens = int(chunk_tokens)
         # admission never spans more slots than exist; 1 degrades to the
         # per-row admission of PR 2
@@ -575,6 +583,8 @@ class ContinuousEngine(GenerationEngine):
             "rows in one fixed-shape program)",
         )
         self._decode_pixels_jit = None
+        self._preview_jit = None
+        self._preview_fill = None
         #: monotonic chunk-dispatch index (non-warmup), read by the
         #: batcher as span metadata so a trace's chunk spans can be lined
         #: up against engine-side dispatch accounting
@@ -946,6 +956,136 @@ class ContinuousEngine(GenerationEngine):
         pixels = np.concatenate(outs)[:n] * 0.5 + 0.5
         return np.clip(pixels, 0.0, 1.0)
 
+    # ---------------------------------------------------------- previews
+
+    def preview_fill_token(self) -> int:
+        """Codebook index used to fill undecoded grid positions in a
+        progressive preview: the entry nearest the mean codebook vector
+        (a neutral canvas rather than whatever index 0 happens to look
+        like). Host-side, computed once; falls back to 0 when the
+        codebook is not readable (pretrained wrappers)."""
+        if self._preview_fill is None:
+            tok = 0
+            try:
+                emb = np.asarray(
+                    self.vae_params["codebook"]["embedding"], np.float32
+                )
+                tok = int(np.argmin(
+                    np.linalg.norm(emb - emb.mean(axis=0), axis=-1)
+                ))
+            except Exception:
+                pass
+            self._preview_fill = tok
+        return self._preview_fill
+
+    def _preview_fn(self):
+        """Body of the fill+decode program: mask undecoded positions,
+        fill with the mean-codebook token, run the standard VAE decode —
+        fused so a streaming preview wave pays ONE dispatch (the
+        fused-dispatch pattern of the pixel-decode program)."""
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        vae, vae_params = self.vae, self.vae_params
+        fill = self.preview_fill_token()
+        seq = self.image_seq_len
+
+        def fn(toks, pos):
+            mask = jnp.arange(seq)[None, :] < pos[:, None]
+            filled = jnp.where(mask, toks, jnp.int32(fill))
+            return vae.apply(
+                {"params": vae_params}, filled, method=DiscreteVAE.decode
+            )
+
+        return fn
+
+    def preview_pixels(  # tracelint: hotloop
+        self, tokens: np.ndarray, positions: np.ndarray
+    ) -> Optional[np.ndarray]:
+        """Progressive-preview pixels [n, H, W, 3] in [0, 1] for partial
+        token rows (`snapshot_rows` output) with per-row decode
+        positions: undecoded grid positions are filled with the mean-
+        codebook token and the whole grid decodes through ONE compiled
+        fill+decode shape (pad to max_batch, slice) shared by every
+        streaming request — or None without a VAE. The program must be
+        warmed (`preview_enabled`) before serving traffic reaches it."""
+        if self.vae is None:
+            return None
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+        tokens = np.asarray(tokens, np.int32)
+        positions = np.asarray(positions, np.int32)
+        n = len(tokens)
+        if not isinstance(self.vae, DiscreteVAE):
+            # pretrained wrappers decode host-side; fill host-side too
+            mask = np.arange(tokens.shape[1])[None, :] < positions[:, None]
+            filled = np.where(
+                mask, tokens, np.int32(self.preview_fill_token())
+            ).astype(np.int32)
+            # tracelint: disable=TL002 -- pretrained-wrapper decode is host-side by contract; its output leaves the device here by design
+            return np.clip(np.asarray(self.vae.decode(filled)), 0.0, 1.0)
+        import jax
+        import jax.numpy as jnp
+
+        if self._preview_jit is None:
+            self._preview_jit = jax.jit(self._preview_fn())
+        pad = self.max_batch - (n % self.max_batch or self.max_batch)
+        ptoks = np.concatenate(
+            [tokens, np.zeros((pad, tokens.shape[1]), np.int32)]
+        )
+        ppos = np.concatenate([positions, np.zeros(pad, np.int32)])
+        outs = []
+        with self._lock:
+            t0 = time.perf_counter()
+            self.vitals.dispatch_begin("preview")
+            try:
+                self._fault_point("preview")
+                for i in range(0, len(ptoks), self.max_batch):
+                    outs.append(
+                        np.asarray(  # tracelint: disable=TL002 -- preview pixels ship as a host-side stream event; rows leave the device here by design
+                            self._preview_jit(
+                                jnp.asarray(ptoks[i : i + self.max_batch]),
+                                jnp.asarray(ppos[i : i + self.max_batch]),
+                            )
+                        )
+                    )
+            finally:
+                wall = time.perf_counter() - t0
+                self.vitals.dispatch_end("preview", wall)
+            if self.cost_table is not None and len(ptoks) == self.max_batch:
+                # np.asarray synced; single-dispatch calls only, so the
+                # wall maps to ONE program execution
+                self.cost_table.record_wall("preview", wall)
+        pixels = np.concatenate(outs)[:n] * 0.5 + 0.5
+        return np.clip(pixels, 0.0, 1.0)
+
+    def _warmup_preview(self) -> None:
+        """Dispatch + AOT-capture the fill+decode program during warmup
+        (after the pixel-decode capture, same post-dispatch ordering).
+        No-op unless previews are enabled AND the fused decode exists."""
+        if not (self.preview_enabled and self._has_fused_pixel_decode()):
+            return
+        self.preview_pixels(
+            np.zeros((1, self.image_seq_len), np.int32),
+            np.zeros(1, np.int32),
+        )
+        self._capture_preview_cost()
+
+    def _capture_preview_cost(self) -> None:
+        """Like `_capture_decode_pixels_cost`: the preview jit exists
+        only after the warmup dispatch built it."""
+        if self._preview_jit is None:
+            return
+        import jax.numpy as jnp
+
+        self._capture_cost(
+            "preview",
+            lambda t, p: self._preview_jit(t, p),
+            jnp.zeros((self.max_batch, self.image_seq_len), jnp.int32),
+            jnp.zeros((self.max_batch,), jnp.int32),
+        )
+
     def slots_active_gauge(self, n: int) -> None:
         self._m_slots.set(n)
 
@@ -990,6 +1130,7 @@ class ContinuousEngine(GenerationEngine):
             np.zeros((1, self.image_seq_len), np.int32)
         )
         self._capture_decode_pixels_cost()
+        self._warmup_preview()
         with self._lock:
             # _fresh_state, not init_slot_state directly: subclasses
             # rebuild host-side managers alongside the device state
@@ -1031,6 +1172,8 @@ class ContinuousEngine(GenerationEngine):
         out += ["chunk", "release"]
         if self._has_fused_pixel_decode():
             out.append("decode_pixels")
+            if self.preview_enabled:
+                out.append("preview")
         return tuple(out)
 
     def _has_fused_pixel_decode(self) -> bool:
@@ -1108,6 +1251,7 @@ class PagedContinuousEngine(ContinuousEngine):
         kv_pages: Optional[int] = None,
         prefix_entries: int = 64,
         resume_enabled: bool = False,
+        preview_enabled: bool = False,
     ):
         self.page_size = int(page_size)
         assert self.page_size >= 1
@@ -1137,6 +1281,7 @@ class PagedContinuousEngine(ContinuousEngine):
             registry=registry,
             cfg=cfg,
             resume_enabled=resume_enabled,
+            preview_enabled=preview_enabled,
         )
         assert self.kv.can_ever_admit(1), (
             f"kv_pages={self.kv_pages} cannot hold a single row "
@@ -1636,6 +1781,7 @@ class PagedContinuousEngine(ContinuousEngine):
             np.zeros((1, self.image_seq_len), np.int32)
         )
         self._capture_decode_pixels_cost()
+        self._warmup_preview()
         with self._lock:
             self._state = self._fresh_state()
             self.stats.warmup_batches += 1
@@ -1664,6 +1810,8 @@ class PagedContinuousEngine(ContinuousEngine):
         out += ["chunk", "release"]
         if self._has_fused_pixel_decode():
             out.append("decode_pixels")
+            if self.preview_enabled:
+                out.append("preview")
         return tuple(out)
 
     def state_dump(self) -> dict:
@@ -1687,6 +1835,7 @@ def engine_from_checkpoint(
     prefix_entries: int = 64,
     mesh=None,
     resume_enabled: Optional[bool] = None,
+    preview_enabled: Optional[bool] = None,
 ):
     """Build a serving engine from a single-file DALLE checkpoint.
 
@@ -1793,6 +1942,12 @@ def engine_from_checkpoint(
             except Exception:  # pragma: no cover - jax always importable here
                 is_mesh = False
             paged_kw = dict(mesh=mesh) if is_mesh else dict(mesh_shape=mesh)
+        # progressive-preview decode (streaming) defaults ON for serving
+        # boots on every continuous engine — the preview program rides
+        # the replicated VAE, so the sharded engine warms it too
+        paged_kw["preview_enabled"] = (
+            True if preview_enabled is None else bool(preview_enabled)
+        )
         return cls(
             max_batch=max(int(b) for b in batch_shapes),
             chunk_tokens=chunk_tokens,

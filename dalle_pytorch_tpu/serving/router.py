@@ -115,6 +115,11 @@ from dalle_pytorch_tpu.obs.aggregate import (
 )
 from dalle_pytorch_tpu.obs.tracing import Tracer
 from dalle_pytorch_tpu.serving.qos import PRIORITY_CLASSES, priority_class
+from dalle_pytorch_tpu.serving.streaming import (
+    KEEPALIVE,
+    SSEParser,
+    encode_sse,
+)
 
 #: routing-decision header the router stamps on every forwarded dispatch;
 #: replicas parse it into their request log lines so a fleet log join can
@@ -1660,6 +1665,472 @@ class FleetRouter:
             last = (res, kind)
             attempt += 1
 
+    # ---------------------------------------------------------- streaming
+
+    #: seconds of upstream silence before a streaming dispatch reads as
+    #: wedged and fails over — replicas keep-alive every ~10s, so this is
+    #: three missed heartbeats, not one slow chunk
+    stream_read_timeout_s: float = 30.0
+    #: idle keep-alive cadence toward the CLIENT while splicing (covers
+    #: seams where upstream bytes arrive but nothing new is forwardable)
+    stream_keepalive_s: float = 10.0
+
+    def handle_generate_stream(self, raw: bytes, inbound_headers,
+                               write) -> Optional[Tuple[
+                                   int, bytes, List[Tuple[str, str]]
+                               ]]:
+        """Route one STREAMING /generate through the fleet, splicing the
+        replicas' SSE event streams into ONE continuous client stream.
+
+        `write(bytes)` ships frames to the client (the HTTP layer sends
+        the SSE response head lazily on the first call). Returns a
+        `(status, body, headers)` tuple only while NOTHING has been
+        written yet (plain JSON error reply); returns None once the
+        stream started — every later failure reaches the client as an
+        `error` event, and a migrated/failed-over request is
+        re-dispatched (resume checkpoint attached, same key/seed/trace)
+        with the new replica's events spliced on. The splice is
+        content-addressed: progress/preview events carry the
+        request-level chunk index, and only an index ABOVE the client's
+        high water is forwarded — a resumed replica re-announcing chunks
+        the client has seen (or a non-resume restart replaying from 0)
+        is swallowed, so the client observes a gapless, duplicate-free
+        sequence across every seam. Client-facing `id:` sequence numbers
+        are the router's own (upstream streams restart per replica).
+
+        No hedging for streams: a duplicated stream would double-decode
+        for its whole lifetime, not just the tail."""
+        try:
+            body = json.loads(raw)
+            assert isinstance(body, dict), "body must be a JSON object"
+            assert body.get("stream") is True, "not a streaming request"
+            priority = body.get("priority", "normal")
+            assert priority in PRIORITY_CLASSES, (
+                f"priority must be one of {list(PRIORITY_CLASSES)}"
+            )
+            rows = int(body.get("num_images", 1))
+            assert rows >= 1, "num_images must be >= 1"
+            timeout_s = float(body.get("timeout_s", self.request_timeout_s))
+            assert 0.0 < timeout_s <= self.request_timeout_s, (
+                f"timeout_s must be in (0, {self.request_timeout_s}]"
+            )
+        except Exception as exc:
+            return 400, json.dumps(
+                {"error": f"bad request: {exc}"}
+            ).encode(), []
+        klass = priority_class(priority)
+        qkey = (
+            request_fingerprint(body) if self.quarantine is not None
+            else None
+        )
+        if qkey is not None and self.quarantine.is_quarantined(qkey):
+            self._m_quarantined.inc()
+            incidents = self.quarantine.incidents_for(qkey)
+            return 422, json.dumps({
+                "error": "request quarantined: implicated in "
+                f"{len(incidents)} consecutive replica crash incidents",
+                "incidents": incidents,
+            }).encode(), []
+        if body.get("seed") is None:
+            # seed pinned before attempt one: re-dispatches decode
+            # bit-identical tokens, which is what makes the chunk-index
+            # dedup below CORRECT and not just tidy
+            body["seed"] = self.next_seed(rows)
+        payload = json.dumps(body).encode("utf-8")
+
+        ctx = parse_trace_header(inbound_headers.get(TRACE_HEADER))
+        trace = self.tracer.start_trace(
+            "route",
+            trace_id=ctx[0] if ctx else None,
+            parent_uid=ctx[1] if ctx else None,
+            rows=rows, priority=priority, streamed=True,
+        )
+        t0 = self._now()
+        deadline = t0 + timeout_s
+        tried: set = set()
+        attempt = 0
+        free_attempts = 0
+        resume_reason: Optional[str] = None
+        migrated_from: Optional[str] = None
+        resumed_at_chunk: Optional[int] = None
+        last: Optional[Dict] = None
+
+        # client-facing splice state: one outgoing sequence, one chunk
+        # high water per event type, one `open` ever
+        out_seq = 0
+        progress_hw = -1
+        preview_hw = -1
+        opened = False
+        started = False  # any byte reached the client
+
+        def forward(etype: str, data: dict) -> None:
+            nonlocal out_seq, started
+            write(encode_sse(etype, data, seq=out_seq))
+            out_seq += 1
+            started = True
+
+        def mig_fields() -> Dict:
+            if resume_reason is None:
+                return {}
+            out = {"migrated_from": migrated_from, "resume": resume_reason}
+            if resumed_at_chunk is not None:
+                out["resumed_at_chunk"] = resumed_at_chunk
+            return out
+
+        def closed_out(outcome: str, status: int, replica=None, **fields):
+            trace.finish(outcome=outcome)
+            if self.log is not None:
+                self.log.request(
+                    trace_id=trace.trace_id if trace else None,
+                    outcome=outcome, status=status,
+                    latency_ms=round((self._now() - t0) * 1e3, 2),
+                    stages=trace.stage_seconds(),
+                    replica=replica, attempt=attempt, hedged=False,
+                    priority=priority, rows=rows, streamed=True,
+                    stream_events=out_seq,
+                    **mig_fields(), **fields,
+                )
+
+        def fail(outcome: str, status: int, err: dict, extra=(),
+                 replica=None, **fields):
+            """One exit for every routing failure: JSON reply while the
+            stream hasn't started, a terminal `error` event once it
+            has."""
+            closed_out(outcome, status, replica=replica, **fields)
+            if not started:
+                return status, json.dumps(err).encode(), list(extra)
+            forward("error", dict(err, status=status))
+            return None
+
+        def run_attempt(rep: Replica) -> Tuple[Dict, Tuple[str, object]]:
+            """One streaming dispatch to `rep`. Returns (res, marker):
+            `res` feeds `_settle`; marker is ("done", status) — terminal
+            forwarded, stream complete; ("migrated", event data) — the
+            replica handed back a checkpoint mid-stream; ("http", None)
+            — non-SSE answer, classify like the buffered path;
+            ("deadline", None); or ("retry", None) — transport/5xx
+            failure, try elsewhere. Client-socket write failures
+            propagate (the caller severs upstream, which makes the
+            replica orphan the stream and cancel the decode)."""
+            nonlocal opened, progress_hw, preview_hw, started
+            span = trace.begin(
+                "dispatch", replica=rep.name, attempt=attempt,
+                streamed=True,
+            )
+            headers = {
+                "Content-Type": "application/json",
+                ROUTE_HEADER: format_route_header(rep.name, attempt, False),
+            }
+            if qkey is not None:
+                headers[REQUEST_KEY_HEADER] = qkey
+            if trace:
+                headers[TRACE_HEADER] = format_trace_header(
+                    trace.trace_id, self._span_uid(span)
+                )
+            self._begin_attempt(rep, rows, key=qkey)
+            conn = http.client.HTTPConnection(
+                rep.host, rep.port, timeout=self.stream_read_timeout_s
+            )
+            try:
+                try:
+                    conn.request(
+                        "POST", "/generate", body=payload, headers=headers
+                    )
+                    resp = conn.getresponse()
+                except Exception as exc:
+                    trace.end(span, error=repr(exc))
+                    return {
+                        "kind": "error", "replica": rep, "error": exc,
+                        "hedged": False, "cancelled": False,
+                    }, ("retry", None)
+                if resp.status != 200 or "text/event-stream" not in (
+                    resp.getheader("Content-Type") or ""
+                ):
+                    data = resp.read()
+                    keep = {}
+                    ra = resp.getheader("Retry-After")
+                    if ra is not None:
+                        keep["Retry-After"] = ra
+                    trace.end(span, status=resp.status)
+                    return {
+                        "kind": "http", "replica": rep,
+                        "status": resp.status, "body": data,
+                        "headers": keep, "hedged": False,
+                    }, ("http", None)
+                parser = SSEParser()
+                last_write = self._now()
+                while True:
+                    if self._now() >= deadline:
+                        trace.end(span, error="deadline")
+                        return {
+                            "kind": "http", "replica": rep, "status": 504,
+                            "body": b"", "headers": {}, "hedged": False,
+                        }, ("deadline", None)
+                    try:
+                        chunk = resp.read1(65536)
+                    except Exception as exc:  # incl. socket timeouts
+                        trace.end(span, error=repr(exc))
+                        return {
+                            "kind": "error", "replica": rep, "error": exc,
+                            "hedged": False, "cancelled": False,
+                        }, ("retry", None)
+                    if not chunk:
+                        # EOF without a terminal event: severed stream
+                        # (hard kill mid-decode) — crash-grade evidence
+                        exc = ConnectionError(
+                            "replica stream ended without a terminal event"
+                        )
+                        trace.end(span, error=repr(exc))
+                        return {
+                            "kind": "error", "replica": rep, "error": exc,
+                            "hedged": False, "cancelled": False,
+                        }, ("retry", None)
+                    forwarded = False
+                    for etype, data, _seq in parser.feed(chunk):
+                        if etype == "open":
+                            if not opened:
+                                opened = True
+                                forward("open", data)
+                                forwarded = True
+                            continue
+                        if etype in ("progress", "preview"):
+                            c = int(data.get("chunk", -1))
+                            if etype == "progress":
+                                if c <= progress_hw:
+                                    continue  # replayed chunk: swallow
+                                progress_hw = c
+                            else:
+                                if c <= preview_hw:
+                                    continue
+                                preview_hw = c
+                            forward(etype, data)
+                            forwarded = True
+                            continue
+                        if etype == "migrated":
+                            trace.end(span, status=409)
+                            # settle EXACTLY like a buffered 409: the
+                            # synthetic body keys the migrate disposition
+                            return {
+                                "kind": "http", "replica": rep,
+                                "status": 409,
+                                "body": json.dumps(
+                                    dict(data, migrated=True)
+                                ).encode(),
+                                "headers": {}, "hedged": False,
+                            }, ("migrated", data)
+                        if etype == "error":
+                            status = int(data.get("status", 500))
+                            if status >= 500 and status != 504:
+                                # replica-side failure terminal: NOT
+                                # forwarded — fail over (a resume may
+                                # still rescue the decode)
+                                trace.end(span, status=status)
+                                return {
+                                    "kind": "http", "replica": rep,
+                                    "status": status,
+                                    "body": json.dumps(data).encode(),
+                                    "headers": {}, "hedged": False,
+                                }, ("retry", None)
+                            forward("error", data)
+                            trace.end(span, status=status)
+                            return {
+                                "kind": "http", "replica": rep,
+                                "status": status, "body": b"",
+                                "headers": {}, "hedged": False,
+                            }, ("done", status)
+                        if etype == "result":
+                            forward("result", data)
+                            trace.end(span, status=200)
+                            return {
+                                "kind": "http", "replica": rep,
+                                "status": 200, "body": b"",
+                                "headers": {}, "hedged": False,
+                            }, ("done", 200)
+                        forward(etype, data)  # unknown types pass through
+                        forwarded = True
+                    if forwarded:
+                        last_write = self._now()
+                    elif (
+                        self._now() - last_write >= self.stream_keepalive_s
+                    ):
+                        write(KEEPALIVE)
+                        started = True  # response head is on the wire now
+                        last_write = self._now()
+            finally:
+                # severing the upstream connection on ANY exit makes the
+                # abandoned replica handler orphan its stream and cancel
+                # the decode at the next chunk boundary
+                conn.close()
+                self._end_attempt(rep, rows, key=qkey)
+
+        while True:
+            now = self._now()
+            if now >= deadline:
+                return fail(
+                    "timeout", 504,
+                    {"error": "router exhausted the request deadline "
+                     "across failover attempts"},
+                    replica=last["replica"].name if last else None,
+                )
+            cands = self._routable(klass, tried)
+            if not cands and tried:
+                cands = self._routable(klass, frozenset())
+            if not cands:
+                self._m_unroutable.inc()
+                retry = self._retry_after_s(klass)
+                return fail(
+                    "unroutable", 503,
+                    {"error": "no replica routable for priority "
+                     f"{priority!r} (all ejected, draining, or cooling)"},
+                    extra=[("Retry-After", str(int(round(retry))))],
+                    replica=last["replica"].name if last else None,
+                )
+            if resume_reason is not None and qkey is not None:
+                cands = self._prefer_cache_warm(cands, qkey)
+            if attempt - free_attempts > 0 and not self.budget.withdraw():
+                self._m_budget.set(self.budget.balance)
+                return fail(
+                    "budget_exhausted", 503,
+                    {"error": "retry budget exhausted (fleet-wide "
+                     "failures; no retry capacity left)"},
+                    extra=[("Retry-After", "1")],
+                    replica=last["replica"].name if last else None,
+                )
+            self._m_budget.set(self.budget.balance)
+            primary, _hedge_pool = self._claim(cands)
+            if primary is None:
+                self._m_unroutable.inc()
+                return fail(
+                    "unroutable", 503,
+                    {"error": "all routable replicas are mid-trial "
+                     "(recovering); retry shortly"},
+                    extra=[("Retry-After", "1")],
+                    replica=last["replica"].name if last else None,
+                )
+            try:
+                res, (marker, minfo) = run_attempt(primary)
+            except (BrokenPipeError, ConnectionResetError):
+                # OUR client went away mid-stream: upstream is already
+                # severed (run_attempt's finally), which cancels the
+                # decode on the replica — nothing left to route
+                closed_out(
+                    "disconnected", 200, replica=primary.name,
+                )
+                return None
+            kind = self._settle(res, primary, klass, key=qkey)
+            last = res
+            if marker == "done":
+                if int(minfo) == 200 and resume_reason is not None:
+                    with self._lock:
+                        primary.resumes += 1
+                closed_out(
+                    "ok" if int(minfo) == 200 else "replica_status",
+                    int(minfo), replica=primary.name,
+                )
+                return None
+            if marker == "deadline":
+                return fail(
+                    "timeout", 504,
+                    {"error": "router exhausted the request deadline "
+                     "mid-stream"},
+                    replica=primary.name,
+                )
+            if marker == "migrated" or kind == "migrate":
+                # checkpoint hand-off (mid-stream terminal event, or a
+                # buffered-style 409): re-dispatch THE SAME request as a
+                # resume; its replayed chunks fall below the high water
+                payload409 = (
+                    dict(minfo) if marker == "migrated"
+                    else self._migrated_checkpoint(res)
+                )
+                body["resume"] = payload409["checkpoint"]
+                payload = json.dumps(body).encode("utf-8")
+                migrated_from = payload409.get("migrated_from") or (
+                    res["replica"].name
+                )
+                resume_reason = "drain"
+                rc = payload409.get("resumed_at_chunk")
+                resumed_at_chunk = int(rc) if rc is not None else None
+                self._m_migrations.labels("drain").inc()
+                if self.log is not None:
+                    self.log.event(
+                        "request_migrated", reason="drain", streamed=True,
+                        replica=res["replica"].name, key=qkey,
+                        resumed_at_chunk=resumed_at_chunk,
+                        checkpoint_bytes=len(payload409["checkpoint"]),
+                    )
+                free_attempts += 1
+                tried.add(res["replica"].name)
+                attempt += 1
+                continue
+            if marker == "http" and kind == "pass":
+                # non-SSE replica answer (400/422/429/504...): surface it
+                status = res["status"]
+                if not started:
+                    closed_out(
+                        "replica_status", status, replica=primary.name,
+                    )
+                    extra = [("x-dalle-replica", primary.name)]
+                    extra.extend(res.get("headers", {}).items())
+                    return status, res["body"], extra
+                try:
+                    err = json.loads(res["body"] or b"{}")
+                    assert isinstance(err, dict)
+                except Exception:
+                    err = {"error": f"replica answered {status}"}
+                return fail(
+                    "replica_status", status, err, replica=primary.name,
+                )
+            if (
+                qkey is not None
+                and self.quarantine.is_quarantined(qkey)
+            ):
+                self._m_quarantined.inc()
+                incidents = self.quarantine.incidents_for(qkey)
+                return fail(
+                    "quarantined", 422,
+                    {"error": "request quarantined: implicated in "
+                     f"{len(incidents)} consecutive replica crash "
+                     "incidents",
+                     "incidents": incidents},
+                    replica=primary.name, incidents=incidents,
+                )
+            # failover: transport failure, severed stream, 5xx terminal,
+            # or cooled backpressure — identical bookkeeping to the
+            # buffered path, including the crash-spool resume rescue
+            reason = (
+                "transport" if res["kind"] == "error"
+                else "backpressure" if kind == "cooled"
+                else "status"
+            )
+            if (
+                reason == "transport" and qkey is not None
+                and resume_reason is None
+            ):
+                entry = self.checkpoints.take(qkey)
+                if entry is None and self.migrate_wait_s > 0:
+                    entry = self.checkpoints.wait_for(
+                        qkey,
+                        min(self.migrate_wait_s,
+                            max(0.0, deadline - self._now())),
+                    )
+                if entry is not None:
+                    body["resume"] = entry["wire"]
+                    payload = json.dumps(body).encode("utf-8")
+                    migrated_from = entry.get("source")
+                    resume_reason = "crash"
+                    self._m_migrations.labels("crash").inc()
+                    if self.log is not None:
+                        self.log.event(
+                            "request_migrated", reason="crash",
+                            streamed=True, replica=res["replica"].name,
+                            key=qkey, source=entry.get("source"),
+                            checkpoint_bytes=len(entry["wire"]),
+                        )
+            self._m_failovers.labels(reason).inc()
+            tried.add(res["replica"].name)
+            attempt += 1
+
     # --------------------------------------------------------------- admin
 
     def _find(self, name: str) -> Optional[Replica]:
@@ -1951,6 +2422,45 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._reply(400, {"error": f"bad request: {exc}"})
             return
         raw = self.rfile.read(length)
+        stream_req = False
+        try:
+            obj = json.loads(raw)
+            stream_req = isinstance(obj, dict) and bool(obj.get("stream"))
+        except Exception:
+            pass  # malformed body: handle_generate's 400 covers it
+        if stream_req:
+            # streaming splice: the router owns the socket for the whole
+            # stream; the SSE response head goes out lazily on the first
+            # forwarded frame so pre-stream failures stay JSON replies
+            started = {"v": False}
+
+            def write(data: bytes) -> None:
+                if not started["v"]:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Cache-Control", "no-cache")
+                    self.send_header("Connection", "close")
+                    self.close_connection = True
+                    self.end_headers()
+                    started["v"] = True
+                self.wfile.write(data)
+                self.wfile.flush()
+
+            try:
+                out = router.handle_generate_stream(
+                    raw, self.headers, write
+                )
+            except (BrokenPipeError, ConnectionResetError):
+                return  # client went away; upstream was already severed
+            except Exception as exc:
+                if started["v"]:
+                    return  # a live event stream can't become a 500
+                self._reply(500, {"error": f"router failure: {exc}"})
+                return
+            if out is not None:
+                status, body, extra = out
+                self._reply(status, body, extra)
+            return
         try:
             status, body, extra = router.handle_generate(raw, self.headers)
         except Exception as exc:  # router bug: an orderly 500 beats a

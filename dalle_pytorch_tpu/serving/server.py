@@ -77,6 +77,21 @@ queue full -> 503 + Retry-After, per-request timeout -> 504 (the queued
 request is cancelled so it never costs a batch row), engine error ->
 500. Client disconnects are NOT detected mid-wait (stdlib handler
 limitation); an abandoned request still completes and is discarded.
+
+Streaming mode (`"stream": true` in the /generate body, continuous
+engine only) switches the response to Server-Sent Events
+(serving/streaming.py): a `progress` event at every decode chunk
+boundary, a `preview` event (base64 PNGs of the partial token grid run
+through the engine's warmed fill+decode program) every
+`--preview_every` chunks, keep-alive comments on idle, and ONE terminal
+event — `result` (the exact non-streamed payload), `migrated` (the 409
+checkpoint as an event, so the fleet router can splice a resumed
+replica's stream onto the client's), or `error`. Unlike the buffered
+path, a streamed client disconnect IS detected (the next event write
+fails) and cancels the request at the next chunk boundary via the
+batcher's reap path; a re-dispatched request with the same
+`x-dalle-request-key` re-attaches to the live stream instead of
+double-submitting.
 """
 
 from __future__ import annotations
@@ -132,6 +147,12 @@ from dalle_pytorch_tpu.serving.qos import (
     PRIORITY_CLASSES,
     ShedError,
     TenantQuotaError,
+)
+from dalle_pytorch_tpu.serving.streaming import (
+    KEEPALIVE,
+    RequestStream,
+    StreamRegistry,
+    encode_sse,
 )
 from dalle_pytorch_tpu.serving.engine import (
     ContinuousEngine,
@@ -419,6 +440,13 @@ class _Handler(BaseHTTPRequestHandler):
             assert resume_wire is None or isinstance(resume_wire, str), (
                 "resume must be a wire-encoded checkpoint string"
             )
+            stream_mode = bool(body.get("stream", False))
+            assert not stream_mode or isinstance(
+                owner.batcher, ContinuousBatcher
+            ), (
+                "stream=true requires the continuous engine "
+                "(start the server with --continuous)"
+            )
         except Exception as exc:
             self._reply(400, {"error": f"bad request: {exc}"})
             return
@@ -500,13 +528,48 @@ class _Handler(BaseHTTPRequestHandler):
             admission["priority"] = priority
             if tenant:
                 admission["tenant"] = tenant
+            stream = None
+            if stream_mode:
+                existing = owner.streams.reattach(request_key)
+                if existing is not None and existing.request is not None:
+                    # this replica is ALREADY decoding this request key (a
+                    # router failover retry or a network blip between
+                    # router and replica re-dispatched it): steal the
+                    # reader generation and continue the live stream
+                    # instead of double-submitting the decode
+                    admission["stream_reattach"] = True
+                    self._stream_serve(
+                        existing, existing.attach(), existing.request,
+                        prompt=prompt, do_rerank=do_rerank,
+                        timeout_s=timeout_s, t0=t0, trace=trace,
+                        closed_out=closed_out, reattach=True,
+                    )
+                    return
+                stream = RequestStream(
+                    key=request_key, trace_id=trace.trace_id or None
+                )
+                if not owner.streams.register(stream):
+                    # registry full of LIVE attached streams: shed rather
+                    # than run an untracked stream past the bound
+                    closed_out(
+                        "rejected", 503, streamed=True,
+                        error="stream registry full",
+                    )
+                    self._reply(
+                        503, {"error": "stream registry full"},
+                        [("Retry-After", "1")],
+                    )
+                    return
             req = owner.batcher.submit(
                 specs, timeout_s=timeout_s, trace=trace,
                 priority=priority, tenant=tenant,
                 request_key=request_key,
                 resume=resume_cp, resume_bytes=resume_bytes,
+                stream=stream,
             )
         except QueueFullError as exc:
+            if stream is not None:
+                owner.streams.discard(stream)
             closed_out("rejected", 503, error=str(exc))
             # Retry-After from the batcher's chunk-wall-EMA drain
             # estimate where it has one; the pre-first-measurement
@@ -521,6 +584,8 @@ class _Handler(BaseHTTPRequestHandler):
             # deadline-aware admission shed: the cost model says this
             # request's own timeout is unmeetable — 503 now beats a 504
             # after timeout_s of queueing
+            if stream is not None:
+                owner.streams.discard(stream)
             closed_out("shed", 503, error=str(exc))
             self._reply(
                 503, {"error": str(exc)},
@@ -528,6 +593,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         except TenantQuotaError as exc:
+            if stream is not None:
+                owner.streams.discard(stream)
             closed_out("quota", 429, error=str(exc))
             self._reply(
                 429, {"error": str(exc)},
@@ -535,8 +602,19 @@ class _Handler(BaseHTTPRequestHandler):
             )
             return
         except ShuttingDownError as exc:
+            if stream is not None:
+                owner.streams.discard(stream)
             closed_out("shutdown", 503)
             self._reply(503, {"error": str(exc)})
+            return
+
+        if stream is not None:
+            # first attachment of a fresh stream (not a re-attach)
+            self._stream_serve(
+                stream, stream.attach(mark_reattach=False), req,
+                prompt=prompt, do_rerank=do_rerank, timeout_s=timeout_s,
+                t0=t0, trace=trace, closed_out=closed_out, reattach=False,
+            )
             return
 
         try:
@@ -646,6 +724,287 @@ class _Handler(BaseHTTPRequestHandler):
         closed_out("ok", 200, **extra)
         self._reply(200, payload)
 
+    # ------------------------------------------------------ SSE streaming
+
+    #: idle keep-alive cadence on an event stream — an SSE comment line
+    #: every this-many seconds of silence keeps proxies and clients from
+    #: mistaking a slow decode for a dead connection
+    KEEPALIVE_S = 10.0
+
+    @staticmethod
+    def _stream_payload(data: dict) -> dict:
+        """Event data -> JSON-safe dict. Preview events carry raw pixel
+        arrays off the worker; the PNG/base64 encode happens HERE, on the
+        handler thread that owns the socket — the decode hotloop never
+        pays image encoding."""
+        pixels = data.get("pixels")
+        if pixels is None:
+            return data
+        out = {k: v for k, v in data.items() if k != "pixels"}
+        try:
+            out["previews_png_b64"] = [
+                _png_b64(img) for img in np.asarray(pixels)
+            ]
+        except Exception as exc:  # PIL hiccup: degrade, don't kill the stream
+            out["preview_error"] = repr(exc)
+        return out
+
+    def _stream_serve(self, stream, gen, req, *, prompt, do_rerank,
+                      timeout_s, t0, trace, closed_out, reattach) -> None:
+        """Serve one streaming /generate response: SSE frames off the
+        request's `RequestStream` until its terminal event.
+
+        The batcher worker writes progress/preview events at chunk
+        boundaries; this handler thread drains them to the socket,
+        emitting keep-alive comments on idle. When the request future
+        resolves, the CURRENT reader converts it into the stream's one
+        terminal event (`result`/`migrated`/`error` — same status
+        mapping as the buffered path). A write failure means the client
+        went away: the request is cancelled at the next chunk boundary
+        via the batcher's reap path — unless a re-dispatched copy of the
+        request already re-attached and stole the reader generation, in
+        which case this handler exits WITHOUT cancelling the stream its
+        successor is serving."""
+        owner = self.server.owner
+        try:
+            cursor = int(self.headers.get("Last-Event-ID", "0"))
+        except (TypeError, ValueError):
+            cursor = 0
+        # backstop only: the worker's reaper expires the request (and
+        # resolves the future) on its own; this guards a wedged worker
+        deadline = t0 + timeout_s + 30.0
+        logged = False  # exactly one request-log line per handler
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            # SSE frames are self-delimiting and the stream ends with the
+            # connection — no Content-Length, no keep-alive reuse
+            self.send_header("Connection", "close")
+            self.close_connection = True
+            self.end_headers()
+            self.wfile.write(encode_sse("open", {
+                "request_key": stream.key,
+                "trace_id": stream.trace_id,
+                "site": owner.identity.get("site"),
+                "reattach": bool(reattach),
+                "cursor": int(cursor),
+            }))
+            self.wfile.flush()
+            owner.count_stream_event("open")
+            while True:
+                if not stream.current(gen):
+                    # superseded: a re-dispatch of this request key
+                    # re-attached — the successor owns the stream now
+                    if not logged:
+                        closed_out(
+                            "superseded", 200, streamed=True,
+                            previews_sent=stream.previews_sent,
+                            stream_reattaches=stream.reattaches,
+                        )
+                    return
+                events, drained = stream.next_events(
+                    cursor, timeout=self.KEEPALIVE_S
+                )
+                for seq, etype, data in events:
+                    self.wfile.write(
+                        encode_sse(etype, self._stream_payload(data),
+                                   seq=seq)
+                    )
+                    cursor = seq + 1
+                self.wfile.flush()
+                if drained:
+                    break
+                if stream.finished:
+                    continue  # terminal queued above the cursor: drain it
+                if req.future.done():
+                    logged = self._stream_finish(
+                        stream, req, prompt=prompt, do_rerank=do_rerank,
+                        t0=t0, trace=trace, closed_out=closed_out,
+                    ) or logged
+                    continue
+                if not events:
+                    if time.monotonic() > deadline:
+                        # wedged-worker backstop: the reaper never expired
+                        # the request, so the handler ends the stream
+                        req.cancel()
+                        if stream.finish(
+                            "error", status=504,
+                            error="stream deadline exceeded",
+                        ):
+                            owner.count_stream_event("error")
+                            closed_out(
+                                "timeout", 504, streamed=True,
+                                previews_sent=stream.previews_sent,
+                                stream_reattaches=stream.reattaches,
+                            )
+                            logged = True
+                        continue
+                    self.wfile.write(KEEPALIVE)
+                    self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError) as exc:
+            # client went away mid-stream: cancel at the next chunk
+            # boundary (reap path frees the slots) — but only while this
+            # handler is still the CURRENT reader; a superseded reader
+            # must never cancel the request its successor is streaming
+            if stream.orphan(gen) and not req.future.done():
+                req.cancel()
+            if not logged:
+                closed_out(
+                    "disconnected", 200, streamed=True, error=repr(exc),
+                    previews_sent=stream.previews_sent,
+                    stream_reattaches=stream.reattaches,
+                )
+            return
+        # terminal written and acknowledged by the socket: the stream is
+        # complete — drop it from the registry (a late re-dispatch of the
+        # same key starts a fresh request/stream, as on the buffered path)
+        owner.streams.discard(stream)
+        if not logged:
+            # this handler replayed a terminal another handler resolved
+            # (re-attach racing completion); the winner logged the
+            # authoritative outcome line already
+            closed_out(
+                "streamed", 200, streamed=True,
+                previews_sent=stream.previews_sent,
+                stream_reattaches=stream.reattaches,
+            )
+
+    def _stream_finish(self, stream, req, *, prompt, do_rerank, t0, trace,
+                       closed_out) -> bool:
+        """Resolve the request future into the stream's ONE terminal
+        event, with the same outcome/status mapping as the buffered
+        path. Returns True when THIS caller won the terminal (and wrote
+        the request-log line); False when another handler already
+        finished the stream."""
+        owner = self.server.owner
+        num_images = len(req.specs)
+        seed = int(req.specs[0].seed)
+
+        def fields(**extra):
+            out = dict(
+                streamed=True,
+                previews_sent=stream.previews_sent,
+                stream_reattaches=stream.reattaches,
+            )
+            out.update(extra)
+            return out
+
+        try:
+            tokens, pixels = req.future.result(timeout=0)
+        except RequestTimeout as exc:
+            req.cancel()
+            if not stream.finish("error", status=504, error=str(exc)):
+                return False
+            owner.count_stream_event("error")
+            closed_out("timeout", 504, **fields())
+            return True
+        except MigratedError as exc:
+            # drain?migrate=1 exported this request at the chunk
+            # boundary: the checkpoint rides the TERMINAL EVENT (the SSE
+            # analogue of the buffered path's 409 body) so the fleet
+            # router re-dispatches the same request as a resume and
+            # splices the new replica's stream onto the client's
+            blob = exc.checkpoint.encoded or encode_checkpoint(
+                exc.checkpoint, owner.resume_fingerprint
+            )
+            if not stream.finish(
+                "migrated",
+                checkpoint=to_wire(blob),
+                resumed_at_chunk=int(exc.checkpoint.chunk_index),
+                migrated_from=exc.checkpoint.site,
+            ):
+                return False
+            owner.count_stream_event("migrated")
+            closed_out(
+                "migrated", 409, **fields(
+                    resumed_at_chunk=int(exc.checkpoint.chunk_index),
+                    checkpoint_bytes=len(blob),
+                ),
+            )
+            return True
+        except Exception as exc:
+            incidents = list(getattr(req, "incidents", ()) or ())
+            status, outcome = 500, "error"
+            data = {"error": f"generation failed: {exc}"}
+            if (
+                owner.quarantine_after
+                and len(incidents) >= owner.quarantine_after
+            ):
+                owner.count_quarantined()
+                status, outcome = 422, "quarantined"
+                data = {
+                    "error": "request quarantined after "
+                    f"{len(incidents)} failed engine dispatches: {exc}",
+                    "incidents": incidents,
+                }
+            if not stream.finish("error", status=status, **data):
+                return False
+            owner.count_stream_event("error")
+            extra = fields(error=repr(exc))
+            if incidents:
+                extra["incidents"] = incidents
+            closed_out(outcome, status, **extra)
+            return True
+
+        # success terminal: the SAME payload shape the buffered path
+        # replies with, carried in the `result` event
+        tr0 = time.monotonic()
+        respond_span = trace.begin("respond")
+        try:
+            tokens = np.asarray(tokens)
+            payload = {
+                "prompt": prompt,
+                "num_images": num_images,
+                "seed": seed,
+                "latency_ms": round((time.monotonic() - t0) * 1000.0, 2),
+            }
+            if trace:
+                payload["trace_id"] = trace.trace_id
+            if pixels is not None:
+                clip_scores = None
+                if do_rerank:
+                    pixels, scores, order = owner.engine.rerank(
+                        prompt, pixels
+                    )
+                    tokens = tokens[order]
+                    if owner.engine.clip is not None:
+                        clip_scores = np.asarray(scores).tolist()
+                payload["shape"] = list(np.asarray(pixels).shape)
+                payload["images_png_b64"] = [_png_b64(img) for img in pixels]
+                if clip_scores is not None:
+                    payload["clip_scores"] = clip_scores
+            payload["tokens"] = tokens.tolist()
+        except Exception as exc:  # rerank/PNG-encode failure
+            trace.end(respond_span, error=repr(exc))
+            owner.batcher.stage_seconds.labels("respond").observe(
+                time.monotonic() - tr0, exemplar=trace.trace_id or None
+            )
+            if not stream.finish(
+                "error", status=500,
+                error=f"response encoding failed: {exc}",
+            ):
+                return False
+            owner.count_stream_event("error")
+            closed_out("error", 500, **fields(error=repr(exc)))
+            return True
+        trace.end(respond_span)
+        owner.batcher.stage_seconds.labels("respond").observe(
+            time.monotonic() - tr0, exemplar=trace.trace_id or None
+        )
+        if not stream.finish("result", **payload):
+            return False
+        owner.count_stream_event("result")
+        extra = fields()
+        if req.prefix_hit is not None:
+            extra["prefix_hit"] = req.prefix_hit
+        if req.preemptions:
+            extra["preemptions"] = req.preemptions
+        if req.dispatch_retries:
+            extra["dispatch_retries"] = req.dispatch_retries
+        closed_out("ok", 200, **extra)
+        return True
+
 
 class _Server(ThreadingHTTPServer):
     daemon_threads = True
@@ -689,6 +1048,8 @@ class ServingServer:
         quarantine_after: int = 2,
         checkpoint_spool=None,
         spool_every: int = 8,
+        preview_every: int = 4,
+        max_streams: int = 256,
     ):
         self.engine = engine
         self.registry = engine.registry
@@ -757,6 +1118,18 @@ class ServingServer:
             or isinstance(checkpoint_spool, CheckpointSpool)
             else CheckpointSpool(checkpoint_spool)
         )
+        # streaming /generate (serving/streaming.py): request-key -> live
+        # SSE stream. Built for every batcher flavor (the gauge reads 0
+        # on a micro engine, where stream=true is a 400) so /healthz and
+        # tests see one shape.
+        self._m_streams_active = self.registry.gauge(
+            "dalle_serving_streams_active",
+            "live SSE event streams currently registered "
+            "(streaming /generate requests incl. re-attachable orphans)",
+        )
+        self.streams = StreamRegistry(
+            max_streams=max_streams, gauge=self._m_streams_active.set
+        )
         if isinstance(engine, ContinuousEngine):
             # token-boundary admission: max_delay_ms does not apply (there
             # is no flush deadline; admission happens at chunk boundaries)
@@ -772,6 +1145,7 @@ class ServingServer:
                 reserve_slots=reserve_slots,
                 spool=self.spool,
                 spool_every=spool_every,
+                preview_every=preview_every,
             )
             self.batcher.checkpoint_fingerprint = self.resume_fingerprint
         else:
@@ -847,6 +1221,16 @@ class ServingServer:
 
     def count_quarantined(self) -> None:
         self._m_quarantined.inc()
+
+    def count_stream_event(self, etype: str) -> None:
+        """Terminal/open events are minted by handler threads; the
+        chunk-boundary progress/preview counts come from the batcher
+        worker at emit time — one `stream_events_total` family covers
+        both sides (absent on the micro batcher, where streaming is a
+        400 and this is a no-op)."""
+        fam = getattr(self.batcher, "_m_stream_events", None)
+        if fam is not None:
+            fam.labels(etype).inc()
 
     def log_request(self, trace, outcome: str, status: int,
                     latency_ms: float, **fields) -> None:
@@ -1084,6 +1468,12 @@ class ServingServer:
             detail["slots_active"] = self.batcher.allocator.n_active
             detail["chunk_tokens"] = self.engine.chunk_tokens
             detail["qos"] = self.qos_detail()
+            # streaming block: live SSE streams + lifetime open/re-attach
+            # counts, with the oldest few streams' snapshots
+            detail["streaming"] = dict(
+                self.streams.detail(),
+                preview_every=self.batcher.preview_every,
+            )
             kv_detail = getattr(self.engine, "kv_detail", None)
             if kv_detail is not None:
                 # paged engine: block-pool occupancy + prefix-cache size,

@@ -153,6 +153,7 @@ class ShardedContinuousEngine(ContinuousEngine):
         mesh=None,
         mesh_shape: Union[str, dict, None] = None,
         model_axis: str = "tp",  # serving_partition.SERVING_MODEL_AXIS
+        preview_enabled: bool = False,
     ):
         import jax
 
@@ -203,6 +204,7 @@ class ShardedContinuousEngine(ContinuousEngine):
             tokenizer=tokenizer,
             registry=registry,
             cfg=cfg,
+            preview_enabled=preview_enabled,
         )
 
     # ---------------------------------------------------------- placement

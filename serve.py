@@ -175,6 +175,14 @@ def parse_args(argv=None):
                    help="chunk boundaries between beacon writes (a hard "
                    "kill loses at most this many chunks of journaled "
                    "progress)")
+    p.add_argument("--preview_every", type=int, default=4,
+                   help="streaming /generate: decode chunks between "
+                   "progressive preview events (partial token grid filled "
+                   "with the mean codebook token, run through the warmed "
+                   "fill+decode program, shipped as base64 PNG). 0 "
+                   "disables previews — and drops the preview program "
+                   "from the warmup ladder — while per-chunk progress "
+                   "events still flow")
     p.add_argument("--spool_notify", type=str, default=None, metavar="URL",
                    help="with --supervise: fleet router base URL the "
                    "supervisor POSTs the spool to (/admin/spool) once "
@@ -261,6 +269,8 @@ def parse_args(argv=None):
                 "state)")
     if args.spool_every < 1:
         p.error("--spool_every must be >= 1")
+    if args.preview_every < 0:
+        p.error("--preview_every must be >= 0 (0 disables previews)")
     if args.router:
         if not args.replicas:
             p.error("--router needs --replicas URL[,URL...]")
@@ -399,6 +409,10 @@ def main(argv=None):
             prefix_entries=args.prefix_entries,
             mesh=args.mesh,
             resume_enabled=not args.no_resume,
+            # --preview_every 0 drops the preview fill+decode program
+            # from the warmup ladder entirely (micro engines never
+            # stream, so the knob is continuous-only either way)
+            preview_enabled=args.preview_every > 0,
         )
     if cache is not None:
         # identity of this compiled-ladder universe: any drift (jax
@@ -518,6 +532,7 @@ def main(argv=None):
         quarantine_after=args.replica_quarantine_after,
         checkpoint_spool=args.checkpoint_spool,
         spool_every=args.spool_every,
+        preview_every=args.preview_every,
     )
 
     import threading

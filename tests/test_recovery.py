@@ -365,6 +365,7 @@ class TestWarmupLadderExport:
         stub = object.__new__(ContinuousEngine)
         stub.vae = None  # tokens-only engine never compiles decode_pixels
         stub.resume_enabled = False
+        stub.preview_enabled = False
         assert ContinuousEngine.program_ladder(stub) == (
             "prefill", "chunk", "release",
         )
@@ -381,6 +382,12 @@ class TestWarmupLadderExport:
         stub.resume_enabled = True
         assert ContinuousEngine.program_ladder(stub) == (
             "prefill", "resume", "chunk", "release", "decode_pixels",
+        )
+        # so does the streaming preview fill+decode program
+        stub.preview_enabled = True
+        assert ContinuousEngine.program_ladder(stub) == (
+            "prefill", "resume", "chunk", "release", "decode_pixels",
+            "preview",
         )
 
 

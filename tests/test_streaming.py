@@ -1,0 +1,770 @@
+"""Streaming /generate: SSE codec, per-request event streams, progressive
+previews, and fleet-safe re-attach across preemption, migration, and
+failover.
+
+The load-bearing contracts:
+
+  * CHUNK EVENTS ARE GAPLESS AND DUPLICATE-FREE, FLEET-WIDE — progress is
+    content-addressed (a request-level chunk index with a monotonic high
+    water in `RequestStream`, plus a second high water in the router's
+    stream splice across replica seams), so a preemption resume, a
+    drain-migration re-dispatch, and a from-scratch failover re-decode
+    all replay silently: the client sees every chunk exactly once, in
+    order, on one continuous stream.
+  * A PREVIEW IS ONE EXTRA WARMED PROGRAM — `preview_enabled=True` adds
+    exactly the `preview` entry to the program ladder, and a warm
+    streaming cycle (admit, chunks, snapshot + preview fill-decode,
+    harvest, release) compiles NOTHING after warmup.
+  * A DISCONNECTED CLIENT CANCELS ITS DECODE — the SSE writer's broken
+    pipe cancels the request, and the batcher's `_reap` frees its slots
+    at the next chunk boundary instead of decoding for nobody.
+  * A STREAMED REQUEST IS THE SAME REQUEST — terminal `result` tokens are
+    bit-identical to the buffered (non-streaming) run of the same body.
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+from dalle_pytorch_tpu.models.dalle import DALLE
+from dalle_pytorch_tpu.obs.logging import StructuredLog
+from dalle_pytorch_tpu.serving.batcher import ContinuousBatcher
+from dalle_pytorch_tpu.serving.engine import (
+    ContinuousEngine,
+    PagedContinuousEngine,
+    SampleSpec,
+)
+from dalle_pytorch_tpu.serving.faults import FaultInjector
+from dalle_pytorch_tpu.serving.router import FleetRouter, RouterServer
+from dalle_pytorch_tpu.serving.server import ServingServer
+from dalle_pytorch_tpu.serving.streaming import (
+    TERMINAL_TYPES,
+    RequestStream,
+    SSEParser,
+    StreamRegistry,
+    encode_sse,
+)
+from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+TEXT_SEQ = 8
+FMAP = 4
+IMG_SEQ = FMAP * FMAP
+CHUNK = 4
+N_CHUNKS = IMG_SEQ // CHUNK
+
+
+@pytest.fixture(scope="module")
+def toy():
+    from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+
+    model = DALLE(
+        dim=32, depth=2, heads=2, dim_head=8,
+        num_image_tokens=32, image_fmap_size=FMAP,
+        num_text_tokens=64, text_seq_len=TEXT_SEQ,
+        shift_tokens=True, rotary_emb=True,
+    )
+    text = jnp.zeros((1, TEXT_SEQ), jnp.int32)
+    toks = jnp.zeros((1, IMG_SEQ), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(42), text, toks)
+    # previews need a real pixel decode: a tiny dVAE with a matching
+    # codebook (4x4 grid -> 16x16 images)
+    vae = DiscreteVAE(
+        image_size=4 * FMAP, num_layers=2, num_tokens=32,
+        codebook_dim=16, hidden_dim=8,
+    )
+    vae_params = jax.jit(vae.init)(
+        jax.random.PRNGKey(1), jnp.zeros((1, 4 * FMAP, 4 * FMAP, 3))
+    )["params"]
+    return model, params, vae, vae_params
+
+
+def _engine(toy, preview=True, paged=False, max_batch=2, chunk_tokens=CHUNK,
+            **kw):
+    model, params, vae, vae_params = toy
+    cls = PagedContinuousEngine if paged else ContinuousEngine
+    if paged:
+        kw.setdefault("page_size", 4)
+    eng = cls(
+        model=model, variables=params, vae=vae, vae_params=vae_params,
+        max_batch=max_batch,
+        chunk_tokens=chunk_tokens, prefill_batch=max_batch,
+        registry=MetricsRegistry(), preview_enabled=preview, **kw,
+    )
+    eng.tokenizer = ByteTokenizer()
+    return eng
+
+
+def _server(toy, preview_every=1, **kw):
+    eng = _engine(toy)
+    return eng, ServingServer(
+        eng, port=0, request_timeout_s=60, preview_every=preview_every, **kw
+    ).start()
+
+
+# ----------------------------------------------------------- wire format
+
+
+class TestSSECodec:
+    def test_round_trip_including_split_chunks(self):
+        frames = (
+            encode_sse("open", {"request_key": "k1", "cursor": 0})
+            + encode_sse("progress", {"chunk": 1, "tokens": 4}, seq=0)
+            + b": keep-alive\n\n"
+            + encode_sse("result", {"tokens": [[1, 2]]}, seq=1)
+        )
+        parser = SSEParser()
+        events = []
+        # worst-case delivery: one byte at a time across reads
+        for i in range(0, len(frames), 3):
+            events.extend(parser.feed(frames[i:i + 3]))
+        assert [e[0] for e in events] == ["open", "progress", "result"]
+        assert events[0][2] is None  # open carries no id:
+        assert events[1][1]["chunk"] == 1 and events[1][2] == 0
+        assert events[2][2] == 1
+        assert events[2][0] in TERMINAL_TYPES
+
+    def test_non_json_data_degrades_to_raw(self):
+        parser = SSEParser()
+        events = parser.feed(b"event: weird\ndata: not json\n\n")
+        assert events == [("weird", {"raw": "not json"}, None)]
+
+
+class TestRequestStream:
+    def test_progress_high_water_swallows_replays(self):
+        s = RequestStream(key="k")
+        assert s.progress(1, tokens=4)
+        assert s.progress(2, tokens=8)
+        # a restarted non-resume re-decode replays chunks 1..2: silent
+        assert not s.progress(1, tokens=4)
+        assert not s.progress(2, tokens=8)
+        assert s.progress(3, tokens=12)
+        events, _ = s.next_events(0, timeout=0.0)
+        assert [d["chunk"] for _s, t, d in events if t == "progress"] == [1, 2, 3]
+
+    def test_preview_cadence_and_dedup(self):
+        s = RequestStream(key="k")
+        assert not s.preview_due(0, 2)  # never before chunk 1
+        assert not s.preview_due(1, 2)
+        assert s.preview_due(2, 2)
+        assert s.preview(2, rows=[0])
+        assert not s.preview_due(2, 2)  # already sent for this boundary
+        assert not s.preview(2, rows=[0])
+        assert not s.preview_due(3, 2)
+        assert s.preview_due(4, 2)
+        assert not s.preview_due(4, 0)  # 0 disables previews entirely
+        assert s.previews_sent == 1
+
+    def test_terminal_wins_once_and_seals_the_stream(self):
+        s = RequestStream(key="k")
+        assert s.finish("result", tokens=[[1]])
+        assert not s.finish("error", status=500)  # loser of the race
+        assert not s.emit("progress", chunk=9)
+        assert s.finished
+        events, drained = s.next_events(0, timeout=0.0)
+        assert [t for _s, t, _d in events] == ["result"]
+        assert not drained  # the terminal itself still had to be read
+        events, drained = s.next_events(s.end_seq(), timeout=0.0)
+        assert events == [] and drained
+
+    def test_ring_is_bounded_with_absolute_seqs(self):
+        s = RequestStream(key="k", max_events=8)  # 8 is the floor
+        for c in range(1, 21):
+            s.progress(c)
+        events, _ = s.next_events(0, timeout=0.0)
+        # early events fell off; sequence numbers stay absolute
+        assert [seq for seq, _t, _d in events] == list(range(12, 20))
+        assert [d["chunk"] for _s, _t, d in events] == list(range(13, 21))
+        assert s.detail()["dropped"] == 12
+
+    def test_attach_generations_supersede_and_orphan(self):
+        s = RequestStream(key="k")
+        g1 = s.attach(mark_reattach=False)
+        assert s.current(g1) and s.reattaches == 0
+        g2 = s.attach()  # re-attach: g1's reader must stand down
+        assert s.reattaches == 1
+        assert not s.current(g1) and s.current(g2)
+        # a superseded reader's disconnect must NOT cancel the request
+        assert not s.orphan(g1)
+        assert s.orphan(g2) and s.orphaned
+        # a fresh attach clears the orphan flag (client reconnected)
+        g3 = s.attach()
+        assert not s.orphaned and s.current(g3)
+
+
+class TestStreamRegistry:
+    def test_register_reattach_discard_and_gauge(self):
+        seen = []
+        reg = StreamRegistry(max_streams=4, gauge=seen.append)
+        s = RequestStream(key="req-1")
+        assert reg.register(s)
+        assert seen[-1] == 1
+        assert reg.get("req-1") is s
+        assert reg.reattach("req-1") is s
+        s.finish("result")
+        assert reg.reattach("req-1") is None  # finished: nothing to join
+        reg.discard(s)
+        assert reg.get("req-1") is None and seen[-1] == 0
+
+    def test_full_of_live_streams_rejects(self):
+        reg = StreamRegistry(max_streams=2)
+        a, b = RequestStream(key="a"), RequestStream(key="b")
+        assert reg.register(a) and reg.register(b)
+        assert not reg.register(RequestStream(key="c"))
+        # a finished stream is evictable headroom
+        a.finish("result")
+        c = RequestStream(key="c")
+        assert reg.register(c)
+        assert reg.get("a") is None and reg.get("c") is c
+        assert reg.active() == 2
+
+    def test_detail_shape(self):
+        reg = StreamRegistry(max_streams=2)
+        s = RequestStream(key="a")
+        reg.register(s)
+        s.progress(1)
+        d = reg.detail()
+        assert d["active"] == 1
+        assert d["streams"][0]["key"] == "a"
+
+
+# --------------------------------------------------- HTTP SSE end to end
+
+
+def _post(port, body, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as resp:
+        return resp.status, resp.read().decode()
+
+
+def _open_stream(port, body, headers=None, timeout=60):
+    """POST stream=true; returns (conn, resp) with the SSE head checked."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(
+        "POST", "/generate", body=json.dumps(dict(body, stream=True)),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    assert resp.getheader("Content-Type", "").startswith("text/event-stream")
+    return conn, resp
+
+
+def _read_events(resp, deadline_s=60, stop=None):
+    """Drain SSE frames until a terminal event (or `stop(events)` says
+    enough); returns the [(seq, etype, data)...] list in arrival order."""
+    parser = SSEParser()
+    events = []
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        for etype, data, seq in parser.feed(chunk):
+            events.append((seq, etype, data))
+            if etype in TERMINAL_TYPES:
+                return events
+            if stop is not None and stop(events):
+                return events
+    return events
+
+
+def _chunks(events, etype="progress"):
+    return [d["chunk"] for _s, t, d in events if t == etype]
+
+
+def _assert_gapless(events, last_chunk=N_CHUNKS):
+    """THE streaming invariant: progress chunks are strictly increasing,
+    duplicate-free, contiguous, and reach the final boundary."""
+    chunks = _chunks(events)
+    assert chunks == list(range(chunks[0], last_chunk + 1)), chunks
+    seqs = [s for s, _t, _d in events if s is not None]
+    assert seqs == sorted(set(seqs)), "event ids regressed or duplicated"
+
+
+class TestStreamingHTTP:
+    def test_stream_events_previews_and_bit_identity(self, toy):
+        stream_log = io.StringIO()
+        eng, server = _server(
+            toy, preview_every=1, log=StructuredLog(stream=stream_log),
+        )
+        try:
+            body = {"prompt": "red circle", "seed": 77, "timeout_s": 60}
+            _, ref = _post(server.port, body)
+
+            conn, resp = _open_stream(server.port, body)
+            events = _read_events(resp)
+            conn.close()
+            types = [t for _s, t, _d in events]
+            assert types[0] == "open" and types[-1] == "result"
+            assert events[0][2]["reattach"] is False
+            _assert_gapless(events)
+            # previews ride every boundary at preview_every=1, as PNGs
+            previews = [d for _s, t, d in events if t == "preview"]
+            assert len(previews) >= 1
+            assert _chunks(events, "preview") == sorted(
+                set(_chunks(events, "preview"))
+            )
+            import base64
+
+            png = base64.b64decode(previews[0]["previews_png_b64"][0])
+            assert png.startswith(b"\x89PNG")
+            assert "pixels" not in previews[0]  # raw array never hits the wire
+            # the streamed request IS the request: terminal tokens match
+            # the buffered run of the same body bit for bit
+            result = events[-1][2]
+            assert result["tokens"] == ref["tokens"]
+
+            # satellite instruments: TTFP histogram, typed event counter,
+            # live-streams gauge, /healthz detail block, log line fields
+            _, text = _get(server.port, "/metrics")
+            assert "dalle_serving_ttfp_seconds" in text
+            assert 'dalle_serving_stream_events_total{type="preview"}' in text
+            assert "dalle_serving_streams_active" in text
+            _, health = _get(server.port, "/healthz")
+            health = json.loads(health)
+            assert health["streaming"]["preview_every"] == 1
+            assert "active" in health["streaming"]
+            lines = [
+                json.loads(l) for l in stream_log.getvalue().splitlines()
+            ]
+            done = [
+                l for l in lines
+                if l.get("event") == "request" and l.get("streamed")
+            ]
+            assert done and done[-1]["outcome"] == "ok"
+            assert done[-1]["previews_sent"] >= 1
+            assert done[-1]["stream_reattaches"] == 0
+        finally:
+            server.shutdown()
+
+    def test_stream_requires_continuous_engine(self, toy):
+        from dalle_pytorch_tpu.serving.engine import GenerationEngine
+
+        model, params, _vae, _vp = toy
+        micro = GenerationEngine(
+            model=model, variables=params, batch_shapes=(1, 2),
+            registry=MetricsRegistry(),
+        )
+        micro.tokenizer = ByteTokenizer()
+        server = ServingServer(micro, port=0, request_timeout_s=30).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(server.port, {"prompt": "x", "stream": True})
+            assert exc.value.code == 400
+            assert b"continuous" in exc.value.read()
+        finally:
+            server.shutdown()
+
+    def test_client_disconnect_cancels_via_reap(self, toy):
+        """Closing the SSE socket mid-decode must cancel the request: the
+        writer's broken pipe marks the stream orphaned, and the batcher's
+        `_reap` frees the slots at the next chunk boundary (counted by
+        `dalle_serving_cancelled_total`)."""
+        import socket
+        import struct
+
+        # 8 chunks (chunk_tokens=2) so the cancel lands with decode work
+        # still outstanding — the reap must save real chunks, not fire
+        # after the request already finished
+        eng = _engine(toy, preview=False, chunk_tokens=2)
+        server = ServingServer(
+            eng, port=0, request_timeout_s=60, preview_every=0,
+        ).start()
+        hold = threading.Event()
+        try:
+            eng.faults = FaultInjector().stall_nth(
+                "chunk", 2, seconds=30.0, until=hold
+            )
+            conn, resp = _open_stream(
+                server.port,
+                {"prompt": "goes away", "seed": 5, "timeout_s": 60},
+            )
+            # read up to the first progress event so the decode is
+            # genuinely mid-flight, then vanish — SO_LINGER 0 turns the
+            # close into an RST, so the server's next event write fails
+            # immediately instead of after a buffered grace write
+            events = _read_events(
+                resp, stop=lambda ev: bool(_chunks(ev)),
+            )
+            assert _chunks(events) == [1]
+            # Connection: close detached conn.sock; the live socket is
+            # under the response's buffered reader
+            resp.fp.raw._sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_LINGER,
+                struct.pack("ii", 1, 0),
+            )
+            resp.close()
+            conn.close()
+            hold.set()
+            cancelled = server.registry.get("dalle_serving_cancelled_total")
+            deadline = time.monotonic() + 30
+            while cancelled.value < 1 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert cancelled.value >= 1, "disconnect never cancelled the decode"
+            deadline = time.monotonic() + 10
+            while server.batcher.inflight_rows and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert server.batcher.inflight_rows == 0, "slots squatted after reap"
+        finally:
+            hold.set()
+            server.shutdown()
+
+    def test_reattach_same_key_replays_and_supersedes(self, toy):
+        """A second connection with the same request key joins the LIVE
+        decode: full replay from its cursor, the first reader stands down
+        without cancelling, and the joined stream still ends gapless with
+        the terminal result."""
+        eng, server = _server(toy, preview_every=0)
+        try:
+            hold = threading.Event()
+            eng.faults = FaultInjector().stall_nth(
+                "chunk", 2, seconds=30.0, until=hold
+            )
+            body = {"prompt": "hand me over", "seed": 9, "timeout_s": 60}
+            # the fleet join identity rides the router's request-key
+            # header, exactly as a re-dispatched attempt would carry it
+            key_hdr = {"x-dalle-request-key": "reattach-me"}
+            conn1, resp1 = _open_stream(server.port, body, headers=key_hdr)
+            events1 = _read_events(resp1, stop=lambda ev: bool(_chunks(ev)))
+            assert _chunks(events1) == [1]
+
+            conn2, resp2 = _open_stream(server.port, body, headers=key_hdr)
+            hold.set()
+            events2 = _read_events(resp2)
+            conn1.close()
+            conn2.close()
+            assert events2[0][1] == "open"
+            assert events2[0][2]["reattach"] is True
+            _assert_gapless(events2)  # replay includes chunk 1: no gap
+            assert events2[-1][1] == "result"
+            # the decode ran once: re-attach joined it, not re-submitted
+            assert server.streams.total_reattached >= 1
+        finally:
+            hold.set()
+            server.shutdown()
+
+
+# ------------------------------------------- compile discipline (previews)
+
+
+class TestPreviewCompileDiscipline:
+    def test_preview_program_is_opt_in_on_the_ladder(self, toy):
+        assert "preview" in _engine(toy, preview=True).program_ladder()
+        assert "preview" not in _engine(toy, preview=False).program_ladder()
+
+    def test_warm_streaming_cycle_compiles_nothing(self, toy):
+        """Warmup compiles the preview fill-decode alongside the decode
+        ladder; a warm admit -> chunk -> snapshot+preview -> harvest ->
+        release cycle must hit only the compile cache."""
+        from dalle_pytorch_tpu.utils import assert_no_recompiles
+
+        eng = _engine(toy)
+        eng.warmup()
+        ids = np.zeros(TEXT_SEQ, np.int32)
+        ids[:3] = (5, 6, 7)
+        with assert_no_recompiles() as tally:
+            eng.prefill_slot(0, SampleSpec(ids, seed=3))
+            pos, act = eng.step_chunk()
+            rows = eng.snapshot_rows([0])
+            pix = eng.preview_pixels(
+                np.asarray(rows, np.int32),
+                np.asarray([int(pos[0])], np.int32),
+            )
+            for _ in range(N_CHUNKS):
+                pos, act = eng.step_chunk()
+            eng.harvest([0])
+            eng.release([0])
+        assert tally.count == 0
+        assert pix is not None and pix.shape[-1] == 3
+        assert float(pix.min()) >= 0.0 and float(pix.max()) <= 1.0
+
+    def test_warm_batcher_stream_cycle_compiles_nothing(self, toy):
+        """The full streaming serve cycle — batcher worker, progress +
+        preview events at every boundary — pins zero compiles end to end
+        (the TL011 claim for the preview program, enforced live)."""
+        from dalle_pytorch_tpu.utils import assert_no_recompiles
+
+        eng = _engine(toy)
+        eng.warmup()
+        batcher = ContinuousBatcher(
+            eng, registry=eng.registry, preview_every=1,
+        )
+        try:
+            ids = np.zeros(TEXT_SEQ, np.int32)
+            stream = RequestStream(key="warm")
+            with assert_no_recompiles() as tally:
+                req = batcher.submit(
+                    [SampleSpec(ids, seed=8)], timeout_s=60, stream=stream,
+                )
+                req.future.result(timeout=60)
+            assert tally.count == 0
+            assert stream.previews_sent >= 1
+            events, _ = stream.next_events(0, timeout=0.0)
+            assert [
+                d["chunk"] for _s, t, d in events if t == "progress"
+            ] == list(range(1, N_CHUNKS + 1))
+        finally:
+            batcher.shutdown(drain=False)
+
+
+# ----------------------------------------- fleet: preempt / migrate / kill
+
+
+def _submit_stream(batcher, seed, key, priority="normal"):
+    ids = np.arange(TEXT_SEQ, dtype=np.int32) % 5 + 1
+    stream = RequestStream(key=key)
+    req = batcher.submit(
+        [SampleSpec(ids, seed=seed)], timeout_s=120, priority=priority,
+        stream=stream,
+    )
+    return req, stream
+
+
+class TestStreamAcrossPreemption:
+    def test_preempted_stream_stays_gapless_and_bit_identical(self, toy):
+        """Flavor (a): preemption -> resume on one replica. The low
+        request's stream must keep its chunk sequence gapless and
+        duplicate-free across the suspend/resume, and its final tokens
+        equal the undisturbed run."""
+        ids = np.arange(TEXT_SEQ, dtype=np.int32) % 5 + 1
+        ref_eng = _engine(toy, preview=False, max_batch=2)
+        ref_b = ContinuousBatcher(ref_eng, registry=ref_eng.registry)
+        try:
+            ref = np.asarray(ref_b.submit(
+                [SampleSpec(ids, seed=88), SampleSpec(ids, seed=89)],
+                timeout_s=120,
+            ).future.result(timeout=120)[0])
+        finally:
+            ref_b.shutdown(drain=False)
+
+        eng = _engine(toy, preview=False, max_batch=2)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            # park the low request mid-decode so the high arrival finds
+            # both slots occupied and must preempt
+            eng.faults = FaultInjector().stall_nth("chunk", 2, seconds=1.0)
+            low_stream = RequestStream(key="low")
+            low = b.submit(
+                [SampleSpec(ids, seed=88), SampleSpec(ids, seed=89)],
+                timeout_s=120, priority="low", stream=low_stream,
+            )
+            deadline = time.monotonic() + 30
+            while not eng.faults.fired and time.monotonic() < deadline:
+                time.sleep(0.005)
+            high, _ = _submit_stream(b, 99, "high", priority="high")
+            high.future.result(timeout=120)
+            toks, _ = low.future.result(timeout=120)
+            np.testing.assert_array_equal(np.asarray(toks), ref)
+            assert low.preemptions >= 1
+            events, _ = low_stream.next_events(0, timeout=0.0)
+            chunks = [d["chunk"] for _s, t, d in events if t == "progress"]
+            assert chunks == sorted(set(chunks)), chunks
+            assert chunks[-1] == N_CHUNKS
+            assert all(b - a == 1 for a, b in zip(chunks, chunks[1:])), chunks
+        finally:
+            b.shutdown(drain=False)
+
+    def test_dispatch_failure_restart_replays_silently(self, toy):
+        """A recovered dispatch failure re-admits the request from
+        scratch; the re-decoded chunks replay BELOW the stream's high
+        water, so the reader sees no duplicate and no regression."""
+        eng = _engine(toy, preview=False, max_batch=2)
+        b = ContinuousBatcher(eng, registry=eng.registry)
+        try:
+            eng.faults = FaultInjector().fail_nth("chunk", 3)
+            req, stream = _submit_stream(b, 44, "restarted")
+            toks, _ = req.future.result(timeout=120)
+            assert req.dispatch_retries == 1
+            events, _ = stream.next_events(0, timeout=0.0)
+            chunks = [d["chunk"] for _s, t, d in events if t == "progress"]
+            assert chunks == sorted(set(chunks)), chunks
+            assert chunks[-1] == N_CHUNKS
+        finally:
+            b.shutdown(drain=False)
+
+
+def _stream_fleet(toy, n=2, preview_every=2, server_kw=None, **router_kw):
+    engs, servers = [], []
+    for _ in range(n):
+        # resume_enabled: a drain-migrated stream should RESUME on the
+        # survivor (restored prefix counted), not re-decode from zero
+        eng = _engine(toy, resume_enabled=True)
+        engs.append(eng)
+        servers.append(ServingServer(
+            eng, port=0, request_timeout_s=60, preview_every=preview_every,
+            **(server_kw or {}),
+        ).start())
+    router = FleetRouter(
+        [f"r{i}=http://127.0.0.1:{s.port}" for i, s in enumerate(servers)],
+        registry=MetricsRegistry(), **router_kw,
+    )
+    front = RouterServer(router, port=0, probes=False).start()
+    return engs, servers, router, front
+
+
+def _shutdown_fleet(front, servers):
+    front.shutdown()
+    for s in servers:
+        try:
+            s.shutdown()
+        except Exception:
+            pass
+
+
+class TestStreamAcrossFleet:
+    def test_drain_migrate_splices_one_continuous_stream(self, toy):
+        """Flavor (b): drain?migrate=1 mid-stream. The holder 409s with a
+        checkpoint; the router re-dispatches the resume to the survivor
+        and SPLICES its event stream onto the same client connection —
+        exactly one open, gapless duplicate-free chunks across the seam,
+        bit-identical terminal tokens."""
+        engs, servers, router, front = _stream_fleet(toy)
+        try:
+            body = {"prompt": "drain me", "seed": 901, "timeout_s": 60}
+            _, ref = _post(front.port, body)
+
+            # a timed stall (the proven drain-under-stall pattern from the
+            # migration tests): the drain below is issued WHILE the holder
+            # is parked inside chunk dispatch 2, and the export happens at
+            # the boundary the stall releases into
+            for e in engs:
+                e.faults = FaultInjector().stall_nth(
+                    "chunk", 2, seconds=4.0
+                )
+            out = {}
+
+            def client():
+                conn, resp = _open_stream(front.port, body, timeout=90)
+                out["events"] = _read_events(resp, deadline_s=90)
+                conn.close()
+
+            t = threading.Thread(target=client)
+            t.start()
+            deadline = time.monotonic() + 30
+            while not any(e.faults.fired for e in engs) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            holder = 0 if engs[0].faults.fired else 1
+            engs[1 - holder].faults = None
+            detail = router.drain(f"r{holder}", wait_s=30.0, migrate=True)
+            assert detail["mode"] == "drained"
+            t.join(timeout=90)
+
+            events = out["events"]
+            assert [t_ for _s, t_, _d in events].count("open") == 1
+            assert events[-1][1] == "result"
+            _assert_gapless(events)
+            assert events[-1][2]["tokens"] == ref["tokens"]
+            migs = {
+                label: int(c.value)
+                for label, c in router.registry.get(
+                    "dalle_router_migrations_total"
+                ).items()
+            }
+            assert migs.get("drain", 0) >= 1
+            # the survivor resumed rather than re-decoding from scratch
+            assert int(engs[1 - holder].registry.get(
+                "dalle_serving_resumed_tokens_total"
+            ).value) > 0
+        finally:
+            _shutdown_fleet(front, servers)
+
+    def test_hard_failure_failover_stream_stays_gapless(self, toy):
+        """Flavor (c): the serving replica hard-fails the request
+        mid-stream (its retry budget exhausted -> terminal 5xx). The
+        router must NOT forward the replica's error: it fails over, the
+        survivor re-decodes from scratch, the replayed chunks are
+        suppressed by the splice's high water, and the client sees one
+        gapless stream with bit-identical tokens."""
+        engs, servers, router, front = _stream_fleet(
+            toy, preview_every=0,
+            # two consecutive incidents would normally quarantine (422);
+            # this test wants the terminal-5xx failover seam instead
+            server_kw={"quarantine_after": 5},
+        )
+        try:
+            body = {"prompt": "kill me", "seed": 907, "timeout_s": 60}
+            _, ref = _post(front.port, body)
+
+            # chunk dispatch 2 AND the recovery retry's first chunk both
+            # fail on whichever replica takes the stream: the batcher's
+            # one bounded retry dies too, so the request errors
+            # terminally on that replica
+            for e in engs:
+                e.faults = FaultInjector().fail_nth("chunk", 2).fail_nth(
+                    "chunk", 3
+                )
+            out = {}
+
+            def client():
+                conn, resp = _open_stream(front.port, body, timeout=90)
+                out["events"] = _read_events(resp, deadline_s=90)
+                conn.close()
+
+            t = threading.Thread(target=client)
+            t.start()
+            deadline = time.monotonic() + 30
+            while not any(e.faults.fired for e in engs) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.005)
+            holder = 0 if engs[0].faults.fired else 1
+            engs[1 - holder].faults = None
+            t.join(timeout=90)
+
+            events = out["events"]
+            assert [t_ for _s, t_, _d in events].count("open") == 1
+            assert events[-1][1] == "result", events[-1]
+            _assert_gapless(events)
+            assert events[-1][2]["tokens"] == ref["tokens"]
+            fails = {
+                label: int(c.value)
+                for label, c in router.registry.get(
+                    "dalle_router_failovers_total"
+                ).items()
+            }
+            assert sum(fails.values()) >= 1, fails
+        finally:
+            _shutdown_fleet(front, servers)
+
+    def test_replica_dead_before_dispatch_streams_from_survivor(self, toy):
+        """Corpse flavor: ECONNREFUSED on the streaming dispatch is a
+        clean failover — the client still gets one full gapless stream."""
+        engs, servers, router, front = _stream_fleet(toy, preview_every=0)
+        try:
+            body = {"prompt": "corpse", "seed": 17, "timeout_s": 60}
+            _, ref = _post(front.port, body)
+            victim = min(
+                range(2), key=lambda i: router.replicas[i].requests
+            )
+            servers[victim].shutdown(drain=False)
+            conn, resp = _open_stream(front.port, body, timeout=90)
+            events = _read_events(resp, deadline_s=90)
+            conn.close()
+            assert events[-1][1] == "result"
+            _assert_gapless(events)
+            assert events[-1][2]["tokens"] == ref["tokens"]
+        finally:
+            _shutdown_fleet(front, servers)
