@@ -43,11 +43,21 @@ workload on which prefix caching turns repeat admissions into
 near-zero-cost TTFT; both engines replay the identical prompt schedule.
 
 Mesh-sharded serving (`--mesh tp=2`, SERVE_MESH): the continuous side
-runs as `ShardedContinuousEngine` (slot layout) over a `make_mesh`
-device mesh, and its JSON line gains a `mesh` block — axis sizes,
-per-device state-buffer bytes, and the per-device memory PEAK over the
-measured window. On CPU pair it with
-XLA_FLAGS=--xla_force_host_platform_device_count=8.
+runs as `ShardedContinuousEngine` — or `ShardedPagedContinuousEngine`
+when combined with `--kv_layout paged` (the page pool head-splits, page
+tables stay host-side) — over a `make_mesh` device mesh, and its JSON
+line gains a `mesh` block — axis sizes, per-device state-buffer bytes,
+and the per-device memory PEAK over the measured window. On CPU pair it
+with XLA_FLAGS=--xla_force_host_platform_device_count=8.
+
+Quantized KV cache (`--kv_dtype int8`, SERVE_KV_DTYPE): the continuous
+engine stores its KV pages/lanes as int8 with per-(position, head)
+scales (dequantized inside the decode kernels), and its JSON line gains
+a `quality` block: the SAME (prompt, seed) rows generated through the
+bf16 micro engine and the quantized engine, scored by a toy CLIP —
+clip_mean_ref / clip_mean_quantized / clip_delta_mean put the quality
+cost beside the `kv_bytes_per_slot` capacity win (speed AND quality,
+never speed alone).
 
 Priority mix (`--priority_mix P`, SERVE_PRIORITY_MIX): the QoS acceptance
 instrument. Open-loop Poisson arrivals at an OVERLOAD rate
@@ -434,8 +444,74 @@ def _sustained_rps(batcher, text_ids, seconds=2.5, clients=16,
     return len(done) / max(time.monotonic() - t0, 1e-9)
 
 
+def _kv_quality_block(model, micro, cont, n=4):
+    """CLIP-score parity of a quantized KV cache, reported BESIDE the
+    speed numbers: the same (prompt, seed) rows generate through the
+    bf16 micro engine (the reference — a bf16 continuous engine is
+    bit-identical to it by the composition-invariance contract) and the
+    `--kv_dtype` continuous engine, and one toy CLIP (fixed init) scores
+    both image sets against their prompts. `clip_delta_mean` is
+    quantized minus reference — ~0 means int8 paid no quality for its
+    ~2x capacity. Runs AFTER the measured window on already-warm
+    programs; the token-agreement fraction is reported too (int8 decode
+    is a different numerical path, so tokens MAY diverge — the CLIP
+    delta is the acceptance metric, not token identity)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dalle_pytorch_tpu.models.clip import CLIP, clip_scores
+    from dalle_pytorch_tpu.serving.engine import SampleSpec
+
+    n = max(1, min(n, cont.max_batch))
+    rng = np.random.default_rng(1234)
+    texts = rng.integers(
+        1, model.num_text_tokens, size=(n, model.text_seq_len)
+    ).astype(np.int32)
+    specs = [SampleSpec(texts[i], seed=9000 + i) for i in range(n)]
+
+    ref_toks, ref_px = micro.generate(specs)
+    for i, sp in enumerate(specs):
+        cont.prefill_slot(i, sp)
+    for _ in range(4 * model.image_seq_len):
+        pos, act = cont.step_chunk()
+        if (pos[act] >= cont.image_seq_len).all():
+            break
+    q_toks = np.asarray(cont.harvest(list(range(n))))
+    cont.release(list(range(n)))
+    q_px = cont.decode_pixels(q_toks)
+
+    image_size = int(np.asarray(ref_px).shape[1])
+    clip = CLIP(
+        dim_text=32, dim_image=32, dim_latent=16,
+        num_text_tokens=model.num_text_tokens,
+        text_enc_depth=1, text_seq_len=model.text_seq_len, text_heads=2,
+        visual_enc_depth=1, visual_heads=2,
+        visual_image_size=image_size,
+        visual_patch_size=max(1, image_size // 4),
+    )
+    cv = clip.init(
+        jax.random.PRNGKey(7), jnp.asarray(texts), jnp.asarray(ref_px)
+    )
+    ref_s = np.asarray(
+        clip_scores(clip, cv, jnp.asarray(texts), jnp.asarray(ref_px))
+    )
+    q_s = np.asarray(
+        clip_scores(clip, cv, jnp.asarray(texts), jnp.asarray(q_px))
+    )
+    return {
+        "rows": int(n),
+        "token_agreement": round(
+            float((np.asarray(ref_toks)[:n] == q_toks[:n]).mean()), 4
+        ),
+        "clip_mean_ref": round(float(ref_s.mean()), 5),
+        "clip_mean_quantized": round(float(q_s.mean()), 5),
+        "clip_delta_mean": round(float((q_s - ref_s).mean()), 5),
+    }
+
+
 def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
-                   trace_export=False):
+                   trace_export=False, kv_dtype="model"):
     import jax
     import numpy as np
 
@@ -445,10 +521,7 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
     )
     from dalle_pytorch_tpu.training.metrics import MetricsRegistry
 
-    assert mesh is None or kv_layout == "slot", (
-        "--mesh benches the sharded slot engine; the paged pool's mesh "
-        "split is the ROADMAP follow-on"
-    )
+    kv_dt = None if kv_dtype in (None, "model") else str(kv_dtype)
 
     # open-loop defaults use a LARGER toy than the closed-loop sweep
     # (dim 128 / depth 3 / 8x8 grid = 64 image tokens): on the tiny model
@@ -481,30 +554,32 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
 
     prefill_batch = int(os.environ.get("SERVE_PREFILL_BATCH", "4"))
     page_size = int(os.environ.get("SERVE_PAGE_SIZE", "16"))
+    cont_kw = dict(
+        model=model, variables=params, vae=vae, vae_params=vae_params,
+        max_batch=max_batch, chunk_tokens=chunk_tokens,
+        prefill_batch=prefill_batch, registry=MetricsRegistry(),
+        kv_dtype=kv_dt,
+    )
     if kv_layout == "paged":
         kv_pages_env = os.environ.get("SERVE_KV_PAGES")
-        cont = PagedContinuousEngine(
-            model=model, variables=params, vae=vae, vae_params=vae_params,
-            max_batch=max_batch, chunk_tokens=chunk_tokens,
-            prefill_batch=prefill_batch, registry=MetricsRegistry(),
+        cont_kw.update(
             page_size=page_size,
             kv_pages=int(kv_pages_env) if kv_pages_env else None,
         )
+        if mesh is not None:
+            from dalle_pytorch_tpu.serving.sharded import (
+                ShardedPagedContinuousEngine,
+            )
+
+            cont = ShardedPagedContinuousEngine(mesh_shape=mesh, **cont_kw)
+        else:
+            cont = PagedContinuousEngine(**cont_kw)
     elif mesh is not None:
         from dalle_pytorch_tpu.serving.sharded import ShardedContinuousEngine
 
-        cont = ShardedContinuousEngine(
-            model=model, variables=params, vae=vae, vae_params=vae_params,
-            max_batch=max_batch, chunk_tokens=chunk_tokens,
-            prefill_batch=prefill_batch, registry=MetricsRegistry(),
-            mesh_shape=mesh,
-        )
+        cont = ShardedContinuousEngine(mesh_shape=mesh, **cont_kw)
     else:
-        cont = ContinuousEngine(
-            model=model, variables=params, vae=vae, vae_params=vae_params,
-            max_batch=max_batch, chunk_tokens=chunk_tokens,
-            prefill_batch=prefill_batch, registry=MetricsRegistry(),
-        )
+        cont = ContinuousEngine(**cont_kw)
     # per-program cost capture (obs/vitals.py) before warmup so the
     # continuous line can report live MFU over the measured window
     from dalle_pytorch_tpu.obs import EngineVitals, ProgramCostTable
@@ -647,6 +722,8 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
     cont_line = {
         **common, "engine": "continuous", "value": cont_stats["rps"],
         "kv_layout": kv_layout,
+        "kv_dtype": kv_dt or "model",
+        "kv_bytes_per_slot": int(cont.kv_bytes_per_slot()),
         "chunk_tokens": chunk_tokens,
         "prefill_batch": cont.prefill_batch,
         "prefill_rows": int(pf_rows),
@@ -705,6 +782,12 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
             # can evict against a capped pool before the schedule replays
             "evictions": int(cache.evictions - evictions0),
         }
+    if kv_dt is not None:
+        # quality beside speed: the quantized cache's CLIP-score cost on
+        # the SAME (prompt, seed) rows, scored against the bf16 micro
+        # engine's output (bit-identical to a bf16 continuous engine by
+        # the composition-invariance contract)
+        cont_line["quality"] = _kv_quality_block(model, micro, cont)
     if micro_stats["rps"]:
         cont_line["rps_ratio_vs_micro"] = round(
             cont_stats["rps"] / micro_stats["rps"], 3
@@ -1985,7 +2068,16 @@ def main():
         help="open-loop: run the continuous side as a mesh-sharded "
         "engine (axis=size pairs over dp/fsdp/tp/sp, e.g. 'tp=2'); the "
         "JSON line gains a `mesh` block with axis sizes and per-device "
-        "memory peaks (slot layout only)",
+        "memory peaks (slot and paged layouts both shard)",
+    )
+    p.add_argument(
+        "--kv_dtype", choices=("model", "int8"),
+        default=os.environ.get("SERVE_KV_DTYPE", "model"),
+        help="open-loop: continuous-engine KV-cache storage dtype; int8 "
+        "stores pages/lanes quantized (per-(position, head) scales, "
+        "in-kernel dequant) and adds a `quality` block — toy-CLIP score "
+        "mean/delta vs the bf16 reference on the same (prompt, seed) "
+        "rows — beside kv_bytes_per_slot",
     )
     p.add_argument(
         "--priority_mix", type=float,
@@ -2071,6 +2163,7 @@ def main():
         main_open_loop(
             prompt_reuse=args.prompt_reuse, kv_layout=args.kv_layout,
             mesh=args.mesh, trace_export=args.trace_export,
+            kv_dtype=args.kv_dtype,
         )
     else:
         main_closed_loop()

@@ -45,7 +45,12 @@ TL008  `shard_map` in_specs/out_specs (or a `NamedSharding` spec) naming
        `Mesh(..., ("a", "b"))` constructors and the repo's known
        factories (`make_mesh`, `build_serving_mesh`, `make_pp_mesh`);
        anything else stays silent (false-negative bias, like the rest of
-       the pack).
+       the pack). Also flags a `shard_map` wrapping a paged decode
+       kernel (`paged_flash_decode_attention` / `paged_decode_attention`,
+       directly or via `functools.partial`) whose pool specs
+       (in_specs positions 1/2) lead with a mesh axis — that splits the
+       PAGE axis, the host allocator's addressing unit; only the head
+       axis (position 1 of the pool shape) may shard.
 TL010  retry-hygiene in `serving/` loops: (a) a bare `except` /
        `except BaseException` inside a `while` loop that does not
        re-`raise` swallows KeyboardInterrupt and shutdown sentinels —
@@ -738,6 +743,17 @@ _MESH_FACTORY_AXES = {
     "make_pp_mesh": ("pp",),
 }
 
+#: paged decode kernels whose operand order is (q, k_pages, v_pages, ...):
+#: when `shard_map` wraps one (directly or through `functools.partial`),
+#: in_specs positions 1 and 2 describe the physical PAGE POOLS
+#: [n_pages, heads, page_size, dh] — the leading (page) axis is the host
+#: allocator's addressing unit and must NEVER shard (a split pool puts
+#: half of every page's tokens on the wrong device while the host page
+#: table keeps addressing pages globally); shard the HEAD axis instead
+_PAGED_POOL_KERNELS = frozenset(
+    {"paged_flash_decode_attention", "paged_decode_attention"}
+)
+
 
 class MeshAxisRule(Rule):
     code = "TL008"
@@ -745,8 +761,68 @@ class MeshAxisRule(Rule):
     description = (
         "shard_map/NamedSharding partition spec naming an axis the "
         "enclosing mesh does not define — trace-time rejection on the "
-        "real mesh, or a silent no-op shard after an axis rename"
+        "real mesh, or a silent no-op shard after an axis rename; also "
+        "flags a shard_map wrapping a paged decode kernel whose pool "
+        "specs (in_specs positions 1/2) split the PAGE axis — pages are "
+        "the host allocator's unit, only the head axis may shard"
     )
+
+    @staticmethod
+    def _wrapped_name(node: ast.Call) -> Optional[str]:
+        """Terminal name of the callable a `shard_map(...)` wraps —
+        unwrapping one `functools.partial(fn, ...)` layer."""
+        target = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "f"), None
+        )
+        if isinstance(target, ast.Call) and terminal_name(
+            target.func
+        ) == "partial" and target.args:
+            target = target.args[0]
+        if target is None:
+            return None
+        return terminal_name(target)
+
+    def _paged_pool_findings(self, ctx, node: ast.Call) -> Iterator[Finding]:
+        """shard_map over a paged decode kernel: the pool operands'
+        leading (page) axis must stay whole. Structural — needs no mesh
+        resolution, any string axis leading in_specs[1]/[2] is wrong."""
+        if self._wrapped_name(node) not in _PAGED_POOL_KERNELS:
+            return
+        in_expr = next(
+            (kw.value for kw in node.keywords if kw.arg == "in_specs"), None
+        )
+        if not isinstance(in_expr, (ast.Tuple, ast.List)):
+            return
+        for pos in (1, 2):
+            if pos >= len(in_expr.elts):
+                continue
+            spec = in_expr.elts[pos]
+            if not (
+                isinstance(spec, ast.Call)
+                and terminal_name(spec.func) in ("P", "PartitionSpec")
+                and spec.args
+            ):
+                continue
+            first = spec.args[0]
+            leads = (
+                isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+            ) or (
+                isinstance(first, (ast.Tuple, ast.List))
+                and any(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in first.elts
+                )
+            )
+            if leads:
+                operand = "k_pages" if pos == 1 else "v_pages"
+                yield ctx.finding(
+                    self.code, spec,
+                    f"shard_map over a paged decode kernel splits the "
+                    f"PAGE axis of {operand} (in_specs[{pos}] leads with "
+                    f"a mesh axis) — pages are the host allocator's "
+                    f"unit; shard the head axis (position 1) instead",
+                )
 
     @staticmethod
     def _literal_axes(call: ast.Call) -> Optional[Set[str]]:
@@ -812,6 +888,7 @@ class MeshAxisRule(Rule):
                 continue
             fname = terminal_name(node.func)
             if fname == "shard_map":
+                yield from self._paged_pool_findings(ctx, node)
                 mesh_expr = next(
                     (kw.value for kw in node.keywords if kw.arg == "mesh"),
                     None,

@@ -48,6 +48,7 @@ from dalle_pytorch_tpu.ops.pallas_decode import (
     paged_decode_attention,
     paged_gather,
     sharded_flash_decode_attention,
+    sharded_paged_decode_attention,
 )
 from dalle_pytorch_tpu.ops.rotary import apply_rotary
 
@@ -94,6 +95,37 @@ def _cache_write(buf: jnp.ndarray, val: jnp.ndarray, index) -> jnp.ndarray:
             b, v.astype(b.dtype), (0, i, 0)
         )
     )(buf, val, index)
+
+
+def _scale_write(buf: jnp.ndarray, val: jnp.ndarray, index) -> jnp.ndarray:
+    """`_cache_write` for the per-(position, head) scale leaves: val
+    [B,H,n] into buf [B,H,S] at sequence position `index`."""
+    if jnp.ndim(index) == 0:
+        return lax.dynamic_update_slice(
+            buf, val.astype(buf.dtype), (0, 0, index)
+        )
+    return jax.vmap(
+        lambda b, v, i: lax.dynamic_update_slice(b, v.astype(b.dtype), (0, i))
+    )(buf, val, index)
+
+
+def _kv_quantize(x: jnp.ndarray):
+    """Symmetric int8 quantization over the head dim: x [B,H,n,D] ->
+    (q int8 [B,H,n,D], scale fp32 [B,H,n]).
+
+    fp32 math end to end (quantization error must not depend on the
+    cache dtype), eps-clipped so an all-zero row round-trips to zeros
+    instead of NaN. Dequant is `q.astype(f32) * scale[..., None]` —
+    done INSIDE the decode kernels (ops/pallas_decode.py) so the HBM
+    read stays 1 byte/element."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _kv_dequantize(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    return q.astype(jnp.float32) * scale[..., None]
 
 
 class Attention(nn.Module):
@@ -235,6 +267,16 @@ class Attention(nn.Module):
                     rot = lax.dynamic_slice_in_dim(rotary, index, n, axis=0)
                     rot = jnp.expand_dims(rot, (0, 1))  # [1,1,n,dr]
                 q, k, v = (apply_rotary(rot, t) for t in (q, k, v))
+            # int8 KV cache: quantize AFTER rotary (the cache stores what
+            # attention reads), carry per-(position, head) fp32 scales in
+            # sibling leaves; q stays full precision
+            quant = "k_scale" in cache
+            cks = cvs = None
+            if quant:
+                qk, k_sc = _kv_quantize(k)
+                qv, v_sc = _kv_quantize(v)
+            else:
+                qk, qv = k, v
             if paged:
                 assert per_row, "paged caches always carry per-row indices"
                 pt = cache["page_table"]
@@ -250,14 +292,24 @@ class Attention(nn.Module):
                 page = jnp.take_along_axis(pt, pos // page_size, axis=1)
                 off = pos % page_size
                 ck = cache["k"].at[page, :, off, :].set(
-                    k.transpose(0, 2, 1, 3).astype(cache["k"].dtype)
+                    qk.transpose(0, 2, 1, 3).astype(cache["k"].dtype)
                 )
                 cv = cache["v"].at[page, :, off, :].set(
-                    v.transpose(0, 2, 1, 3).astype(cache["v"].dtype)
+                    qv.transpose(0, 2, 1, 3).astype(cache["v"].dtype)
                 )
+                if quant:
+                    cks = cache["k_scale"].at[page, :, off].set(
+                        k_sc.transpose(0, 2, 1)
+                    )
+                    cvs = cache["v_scale"].at[page, :, off].set(
+                        v_sc.transpose(0, 2, 1)
+                    )
             else:
-                ck = _cache_write(cache["k"], k, index)
-                cv = _cache_write(cache["v"], v, index)
+                ck = _cache_write(cache["k"], qk, index)
+                cv = _cache_write(cache["v"], qv, index)
+                if quant:
+                    cks = _scale_write(cache["k_scale"], k_sc, index)
+                    cvs = _scale_write(cache["v_scale"], v_sc, index)
                 max_len = ck.shape[2]
             if self._use_flash_decode(
                 max_len,
@@ -270,17 +322,25 @@ class Attention(nn.Module):
                 # builds below, but reads ONLY each row's live K/V blocks
                 # (scalar index = lockstep decode: every row at one length)
                 lengths = jnp.broadcast_to(index + n, (b,)).astype(jnp.int32)
+                scales = {"k_scale": cks, "v_scale": cvs} if quant else {}
                 if paged:
-                    out = paged_decode_attention(
-                        q, ck, cv, lengths, pt, max_len
-                    )
+                    if self.decode_mesh is not None:
+                        out = sharded_paged_decode_attention(
+                            self.decode_mesh, q, ck, cv, lengths, pt,
+                            max_len, head_axis=self.decode_heads_axis,
+                            **scales,
+                        )
+                    else:
+                        out = paged_decode_attention(
+                            q, ck, cv, lengths, pt, max_len, **scales
+                        )
                 elif self.decode_mesh is not None:
                     out = sharded_flash_decode_attention(
                         self.decode_mesh, q, ck, cv, lengths,
-                        head_axis=self.decode_heads_axis,
+                        head_axis=self.decode_heads_axis, **scales,
                     )
                 else:
-                    out = flash_decode_attention(q, ck, cv, lengths)
+                    out = flash_decode_attention(q, ck, cv, lengths, **scales)
             else:
                 if paged:
                     # one gathered view per dispatch; dead positions hold
@@ -289,8 +349,20 @@ class Attention(nn.Module):
                     # path uses, so outputs stay bit-identical
                     gk = paged_gather(ck, pt, max_len)
                     gv = paged_gather(cv, pt, max_len)
+                    if quant:
+                        gk = _kv_dequantize(
+                            gk,
+                            paged_gather(cks[..., None], pt, max_len)[..., 0],
+                        )
+                        gv = _kv_dequantize(
+                            gv,
+                            paged_gather(cvs[..., None], pt, max_len)[..., 0],
+                        )
                 else:
                     gk, gv = ck, cv
+                    if quant:
+                        gk = _kv_dequantize(gk, cks)
+                        gv = _kv_dequantize(gv, cvs)
                 # query row i sits at global position index + i: causal over
                 # the written prefix (the reference instead relies on only
                 # having written the prefix, `attention.py:71-76,86`)
@@ -335,6 +407,9 @@ class Attention(nn.Module):
                     mask = mask & mask_rows_at(mask_array)
                 out = dense_attention(q, gk, gv, mask=mask, stable=self.stable)
             new_cache = {"k": ck, "v": cv, "index": index + n}
+            if quant:
+                new_cache["k_scale"] = cks
+                new_cache["v_scale"] = cvs
             if paged:
                 new_cache["page_table"] = pt
         else:

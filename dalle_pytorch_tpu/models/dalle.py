@@ -115,6 +115,11 @@ class DALLE(nn.Module):
     # axis the engine's KV-cache shardings use
     decode_mesh: Any = None
     decode_heads_axis: str = "tp"
+    # KV-cache storage dtype for the serving/decode caches: None keeps
+    # K/V at `dtype` (bit-identical legacy behavior); "int8" stores
+    # quantized pages with per-(position, head) fp32 scales, dequantized
+    # inside the decode kernels (ops/pallas_decode.py)
+    kv_dtype: Any = None
     # layer executor: "unrolled" | "scan" (one compiled layer body,
     # ~depth× smaller program; see models/transformer.py docstring)
     executor: str = "unrolled"
@@ -533,6 +538,7 @@ def init_decode_cache(model: DALLE, batch: int, dtype=None) -> dict:
         shift_tokens=model.shift_tokens,
         dtype=model.dtype if dtype is None else dtype,
         executor=model.executor,
+        kv_dtype=getattr(model, "kv_dtype", None),
     )
 
 
@@ -893,6 +899,7 @@ def init_slot_state(model: DALLE, max_batch: int, dtype=None) -> dict:
             dtype=model.dtype if dtype is None else dtype,
             executor=model.executor,
             per_row=True,
+            kv_dtype=getattr(model, "kv_dtype", None),
         ),
         # pending next-position logits per slot (what the next sample
         # draws from; written by prefill, refreshed every decode step)
@@ -1316,6 +1323,7 @@ def init_paged_slot_state(
             shift_tokens=model.shift_tokens,
             dtype=model.dtype if dtype is None else dtype,
             executor=model.executor,
+            kv_dtype=getattr(model, "kv_dtype", None),
         ),
         "row": jnp.zeros((s, model.total_tokens), jnp.float32),
         "img_tokens": jnp.zeros((s, model.image_seq_len), jnp.int32),
@@ -1398,15 +1406,13 @@ def _prefill_slots_paged_builder(model, key):
     prefill_batch, page_size, n_text_pages = key
     batch_axis = 1 if model.executor == "scan" else 0
 
-    def block_of(p_leaf, r, j):
+    def block_of(p_leaf, r, j, last_axis=False):
         """Row r's K/V slice for text block j, zero-padded to page_size
-        past the prefill cache's end (static shapes throughout)."""
-        if batch_axis == 1:
-            row_kv = p_leaf[:, r]  # [depth, H, max_len, D]
-            seq_ax = 2
-        else:
-            row_kv = p_leaf[r]  # [H, max_len, D]
-            seq_ax = 1
+        past the prefill cache's end (static shapes throughout).
+        `last_axis` addresses scale leaves ([.., H, max_len]; the
+        sequence axis is LAST, there is no head-dim axis after it)."""
+        row_kv = p_leaf[:, r] if batch_axis == 1 else p_leaf[r]
+        seq_ax = row_kv.ndim - (1 if last_axis else 2)
         max_len = row_kv.shape[seq_ax]
         lo = j * page_size
         hi = min(lo + page_size, max_len)
@@ -1431,33 +1437,31 @@ def _prefill_slots_paged_builder(model, key):
             if key_ == "index":
                 # stamped from per-slot img_pos every chunk step
                 return s_leaf
-            if key_ in ("k", "v"):
+            if key_ in ("k", "v", "k_scale", "v_scale"):
+                last_axis = key_.endswith("_scale")
+
+                def put(out, blk, page):
+                    if batch_axis == 1:
+                        idx = (0, page) + (0,) * (out.ndim - 2)
+                        return jax.lax.dynamic_update_slice(
+                            out, blk[:, None], idx
+                        )
+                    idx = (page,) + (0,) * (out.ndim - 1)
+                    return jax.lax.dynamic_update_slice(out, blk[None], idx)
+
                 out = s_leaf
                 for r in range(prefill_batch):
                     for j in range(n_text_pages):
-                        blk = block_of(p_leaf, r, j).astype(out.dtype)
-                        if batch_axis == 1:
-                            out = jax.lax.dynamic_update_slice(
-                                out, blk[:, None],
-                                (0, page_rows[r, j], 0, 0, 0),
-                            )
-                        else:
-                            out = jax.lax.dynamic_update_slice(
-                                out, blk[None], (page_rows[r, j], 0, 0, 0)
-                            )
+                        blk = block_of(p_leaf, r, j, last_axis).astype(
+                            out.dtype
+                        )
+                        out = put(out, blk, page_rows[r, j])
                     # prefix-cache snapshot of the divergence block (page 0
                     # = not registering; the garbage page absorbs it)
-                    blk = block_of(p_leaf, r, n_text_pages - 1).astype(
-                        out.dtype
-                    )
-                    if batch_axis == 1:
-                        out = jax.lax.dynamic_update_slice(
-                            out, blk[:, None], (0, partial_dst[r], 0, 0, 0)
-                        )
-                    else:
-                        out = jax.lax.dynamic_update_slice(
-                            out, blk[None], (partial_dst[r], 0, 0, 0)
-                        )
+                    blk = block_of(
+                        p_leaf, r, n_text_pages - 1, last_axis
+                    ).astype(out.dtype)
+                    out = put(out, blk, partial_dst[r])
                 return out
             # shift rings: per-slot row scatter, same as the slotted path
             out = s_leaf
@@ -1550,15 +1554,12 @@ def _resume_slots_paged_builder(model, key):
     prefill_batch, page_size, n_pages_row = key
     batch_axis = 1 if model.executor == "scan" else 0
 
-    def block_of(p_leaf, r, j):
+    def block_of(p_leaf, r, j, last_axis=False):
         """Row r's K/V slice for block j, zero-padded to page_size past
-        the resume cache's end (static shapes throughout)."""
-        if batch_axis == 1:
-            row_kv = p_leaf[:, r]
-            seq_ax = 2
-        else:
-            row_kv = p_leaf[r]
-            seq_ax = 1
+        the resume cache's end (static shapes throughout). `last_axis`
+        addresses scale leaves (sequence axis LAST)."""
+        row_kv = p_leaf[:, r] if batch_axis == 1 else p_leaf[r]
+        seq_ax = row_kv.ndim - (1 if last_axis else 2)
         max_len = row_kv.shape[seq_ax]
         lo = j * page_size
         hi = min(lo + page_size, max_len)
@@ -1588,19 +1589,23 @@ def _resume_slots_paged_builder(model, key):
             key_ = getattr(path[-1], "key", None)
             if key_ == "index":
                 return s_leaf
-            if key_ in ("k", "v"):
+            if key_ in ("k", "v", "k_scale", "v_scale"):
+                last_axis = key_.endswith("_scale")
                 out = s_leaf
                 for r in range(prefill_batch):
                     for j in range(n_pages_row):
-                        blk = block_of(p_leaf, r, j).astype(out.dtype)
+                        blk = block_of(p_leaf, r, j, last_axis).astype(
+                            out.dtype
+                        )
                         if batch_axis == 1:
+                            idx = (0, page_rows[r, j]) + (0,) * (out.ndim - 2)
                             out = jax.lax.dynamic_update_slice(
-                                out, blk[:, None],
-                                (0, page_rows[r, j], 0, 0, 0),
+                                out, blk[:, None], idx
                             )
                         else:
+                            idx = (page_rows[r, j],) + (0,) * (out.ndim - 1)
                             out = jax.lax.dynamic_update_slice(
-                                out, blk[None], (page_rows[r, j], 0, 0, 0)
+                                out, blk[None], idx
                             )
                 return out
             # shift rings: per-slot row scatter, same as the slotted path
@@ -1707,7 +1712,7 @@ def _admit_prefix_builder(model, key):
 
         def upd(path, leaf):
             key_ = getattr(path[-1], "key", None)
-            if key_ in ("k", "v"):
+            if key_ in ("k", "v", "k_scale", "v_scale"):
                 if not has_partial:
                     return leaf
                 blk = jax.lax.dynamic_slice_in_dim(

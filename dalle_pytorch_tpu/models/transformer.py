@@ -974,6 +974,7 @@ def make_decode_cache(
     dtype=jnp.float32,
     executor: str = "unrolled",
     per_row: bool = False,
+    kv_dtype=None,
 ) -> dict:
     """Decode cache pytree for a Transformer of this geometry.
 
@@ -987,16 +988,29 @@ def make_decode_cache(
     instead of scalar, putting each batch row at its OWN sequence position —
     the continuous-batching slot cache, where rows are admitted at token
     boundaries rather than in lockstep (`models/dalle.py:init_slot_state`).
+
+    `kv_dtype="int8"` stores K/V quantized with symmetric per-(position,
+    head) fp32 scales in sibling `k_scale`/`v_scale` leaves ([B, H, L];
+    scan: [depth, B, H, L]) — dequantized inside the attention read
+    (`ops/pallas_decode.py`), never materialized back to fp. Everything
+    else (shift rings, index) stays in `dtype`.
     """
     idx_shape = (batch,) if per_row else ()
+    kv_dt, scaled = _kv_store_dtype(dtype, kv_dtype)
     if executor == "scan":
-        cache = {
-            "attn": {
-                "k": jnp.zeros((depth, batch, heads, max_len, dim_head), dtype),
-                "v": jnp.zeros((depth, batch, heads, max_len, dim_head), dtype),
-                "index": jnp.zeros((depth,) + idx_shape, jnp.int32),
-            }
+        attn = {
+            "k": jnp.zeros((depth, batch, heads, max_len, dim_head), kv_dt),
+            "v": jnp.zeros((depth, batch, heads, max_len, dim_head), kv_dt),
+            "index": jnp.zeros((depth,) + idx_shape, jnp.int32),
         }
+        if scaled:
+            attn["k_scale"] = jnp.zeros(
+                (depth, batch, heads, max_len), jnp.float32
+            )
+            attn["v_scale"] = jnp.zeros(
+                (depth, batch, heads, max_len), jnp.float32
+            )
+        cache = {"attn": attn}
         if shift_tokens:
             assert image_fmap_size is not None
             cache["shift_attn"] = jnp.zeros(
@@ -1008,19 +1022,34 @@ def make_decode_cache(
         return cache
     cache = {}
     for i in range(depth):
-        layer = {
-            "attn": {
-                "k": jnp.zeros((batch, heads, max_len, dim_head), dtype),
-                "v": jnp.zeros((batch, heads, max_len, dim_head), dtype),
-                "index": jnp.zeros(idx_shape, jnp.int32),
-            }
+        attn = {
+            "k": jnp.zeros((batch, heads, max_len, dim_head), kv_dt),
+            "v": jnp.zeros((batch, heads, max_len, dim_head), kv_dt),
+            "index": jnp.zeros(idx_shape, jnp.int32),
         }
+        if scaled:
+            attn["k_scale"] = jnp.zeros((batch, heads, max_len), jnp.float32)
+            attn["v_scale"] = jnp.zeros((batch, heads, max_len), jnp.float32)
+        layer = {"attn": attn}
         if shift_tokens:
             assert image_fmap_size is not None
             layer["shift_attn"] = jnp.zeros((batch, image_fmap_size, dim), dtype)
             layer["shift_ff"] = jnp.zeros((batch, image_fmap_size, dim), dtype)
         cache[f"layer_{i}"] = layer
     return cache
+
+
+def _kv_store_dtype(dtype, kv_dtype):
+    """(storage dtype, has-scale-leaves) for a KV cache request.
+
+    `kv_dtype=None` keeps the historical behavior (K/V stored at the
+    cache `dtype`, no scale leaves) so every default tree stays
+    byte-identical to pre-quantization builds.
+    """
+    if kv_dtype is None:
+        return dtype, False
+    assert str(kv_dtype) == "int8", f"unsupported kv_dtype: {kv_dtype!r}"
+    return jnp.int8, True
 
 
 def make_paged_decode_cache(
@@ -1035,6 +1064,7 @@ def make_paged_decode_cache(
     shift_tokens: bool = False,
     dtype=jnp.float32,
     executor: str = "unrolled",
+    kv_dtype=None,
 ) -> dict:
     """Block-paged decode cache: K/V live in a physical page pool
     [n_pages, heads, page_size, dim_head] shared by all `batch` rows
@@ -1044,19 +1074,30 @@ def make_paged_decode_cache(
     scatter/gather model ops tree-map across both layouts; shift rings and
     the per-row `index` stay row-indexed (they are small — paging them
     would buy nothing).
+
+    `kv_dtype="int8"` pairs the int8 pool with fp32 `k_scale`/`v_scale`
+    pools [n_pages, heads, page_size] (scan: +depth) addressed by the
+    SAME page table.
     """
+    kv_dt, scaled = _kv_store_dtype(dtype, kv_dtype)
     if executor == "scan":
-        cache = {
-            "attn": {
-                "k": jnp.zeros(
-                    (depth, n_pages, heads, page_size, dim_head), dtype
-                ),
-                "v": jnp.zeros(
-                    (depth, n_pages, heads, page_size, dim_head), dtype
-                ),
-                "index": jnp.zeros((depth, batch), jnp.int32),
-            }
+        attn = {
+            "k": jnp.zeros(
+                (depth, n_pages, heads, page_size, dim_head), kv_dt
+            ),
+            "v": jnp.zeros(
+                (depth, n_pages, heads, page_size, dim_head), kv_dt
+            ),
+            "index": jnp.zeros((depth, batch), jnp.int32),
         }
+        if scaled:
+            attn["k_scale"] = jnp.zeros(
+                (depth, n_pages, heads, page_size), jnp.float32
+            )
+            attn["v_scale"] = jnp.zeros(
+                (depth, n_pages, heads, page_size), jnp.float32
+            )
+        cache = {"attn": attn}
         if shift_tokens:
             assert image_fmap_size is not None
             cache["shift_attn"] = jnp.zeros(
@@ -1068,13 +1109,19 @@ def make_paged_decode_cache(
         return cache
     cache = {}
     for i in range(depth):
-        layer = {
-            "attn": {
-                "k": jnp.zeros((n_pages, heads, page_size, dim_head), dtype),
-                "v": jnp.zeros((n_pages, heads, page_size, dim_head), dtype),
-                "index": jnp.zeros((batch,), jnp.int32),
-            }
+        attn = {
+            "k": jnp.zeros((n_pages, heads, page_size, dim_head), kv_dt),
+            "v": jnp.zeros((n_pages, heads, page_size, dim_head), kv_dt),
+            "index": jnp.zeros((batch,), jnp.int32),
         }
+        if scaled:
+            attn["k_scale"] = jnp.zeros(
+                (n_pages, heads, page_size), jnp.float32
+            )
+            attn["v_scale"] = jnp.zeros(
+                (n_pages, heads, page_size), jnp.float32
+            )
+        layer = {"attn": attn}
         if shift_tokens:
             assert image_fmap_size is not None
             layer["shift_attn"] = jnp.zeros((batch, image_fmap_size, dim), dtype)

@@ -74,13 +74,22 @@ def _last_live_block(length, block_k):
 
 
 def _decode_kernel(
-    lengths_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-    *, sm_scale, block_k, n_real_q, nk_blocks,
+    lengths_ref, q_ref, *refs,
+    sm_scale, block_k, n_real_q, nk_blocks, quantized=False,
 ):
     """Grid (b, h, ki): the q chunk stays put over the inner ki steps while
     [block_k, d] K/V tiles stream through (auto double-buffered). Tiles
     fully above the row's live length never run — and never DMA (their
-    index-map steps repeat the last live tile, so the copy is elided)."""
+    index-map steps repeat the last live tile, so the copy is elided).
+
+    `quantized=True` interleaves per-(position, head) fp32 scale refs
+    ([block_k] tiles) after each int8 K/V ref and dequantizes IN KERNEL —
+    the HBM read stays 1 byte/element; compute is fp32 as always."""
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     ki = pl.program_id(2)
     length = lengths_ref[b]
@@ -98,6 +107,9 @@ def _decode_kernel(
         q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [bq, d]
         kb = k_ref[0, 0].astype(jnp.float32)  # [bk, d]
         vb = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            kb = kb * ks_ref[0, 0][:, None]
+            vb = vb * vs_ref[0, 0][:, None]
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)  # [bq, bk]
         bq = q.shape[0]
         col = ki * block_k + lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
@@ -136,6 +148,8 @@ def flash_decode_attention(
     sm_scale: Optional[float] = None,
     block_k: int = 128,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Cached-decode attention with per-row live lengths and KV block skip.
 
@@ -147,6 +161,14 @@ def flash_decode_attention(
        attends to cache positions <= lengths[b] - n + i, exactly the mask
        the dense cached path applies.
 
+    `k_scale`/`v_scale` ([B, H, S] fp32, both or neither) mark an int8
+    cache: K/V tiles are dequantized inside the kernel (tile element *
+    its position's scale) before the fp32 flash math — so the per-token
+    HBM read is 1 byte/element and no fp copy of the cache ever
+    materializes. (TPU note: the scale tiles are (1, 1, block_k) —
+    fine for the Mosaic layouts this repo's geometries use; the CPU
+    interpret path the tests pin is layout-agnostic.)
+
     Matches `dense_attention(q, k, v, mask)` over that mask to fp32
     tolerance (pinned in tests/test_pallas_decode.py). Not differentiable
     by design — decode only.
@@ -155,6 +177,12 @@ def flash_decode_attention(
     s_len = k.shape[2]
     assert k.shape == v.shape == (b, h, s_len, d), (q.shape, k.shape, v.shape)
     assert lengths.shape == (b,), f"lengths {lengths.shape} != ({b},)"
+    quantized = k_scale is not None
+    assert (k_scale is None) == (v_scale is None), "pass both scales or neither"
+    if quantized:
+        assert k_scale.shape == v_scale.shape == (b, h, s_len), (
+            k_scale.shape, (b, h, s_len),
+        )
     scale = d**-0.5 if sm_scale is None else sm_scale
     interp = _use_interpret() if interpret is None else interpret
 
@@ -172,6 +200,7 @@ def flash_decode_attention(
         block_k=block_k,
         n_real_q=n,
         nk_blocks=nk_blocks,
+        quantized=quantized,
     )
     qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, j, lens: (b_, h_, 0, 0))
 
@@ -181,12 +210,25 @@ def flash_decode_attention(
         return (b_, h_, jnp.minimum(j, _last_live_block(lens[b_], block_k)), 0)
 
     kspec = pl.BlockSpec((1, 1, block_k, d), k_idx)
+    in_specs = [qspec, kspec, kspec]
+    operands = [qp, kp, vp]
+    if quantized:
+        sspec = pl.BlockSpec(
+            (1, 1, block_k),
+            lambda b_, h_, j, lens: (
+                b_, h_, jnp.minimum(j, _last_live_block(lens[b_], block_k)),
+            ),
+        )
+        ksp = _pad_to(k_scale.astype(jnp.float32), 2, block_k)
+        vsp = _pad_to(v_scale.astype(jnp.float32), 2, block_k)
+        in_specs = [qspec, kspec, sspec, kspec, sspec]
+        operands = [qp, kp, ksp, vp, vsp]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, h, nk_blocks),
-            in_specs=[qspec, kspec, kspec],
+            in_specs=in_specs,
             out_specs=qspec,
             scratch_shapes=[
                 pltpu.VMEM((bq, 1), jnp.float32),
@@ -199,7 +241,7 @@ def flash_decode_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interp,
-    )(lengths, qp, kp, vp)
+    )(lengths, *operands)
     return out[:, :, :n, :]
 
 
@@ -263,6 +305,8 @@ def paged_flash_decode_attention(
     *,
     sm_scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Flash decode directly over the paged pool: grid step (b, h, j) DMAs
     physical page `page_table[b, j]`, and steps past the row's last live
@@ -273,7 +317,9 @@ def paged_flash_decode_attention(
     q: [B, H, n, D]; k_pages/v_pages: [P, H, page_size, D]; lengths: [B]
     live positions including the current chunk; page_table: [B, n_pages].
     Tile size == page_size (TPU wants page_size a multiple of 8 and D of
-    128 off interpret mode). fp32 accumulation; decode-only, no VJP.
+    128 off interpret mode). `k_scale`/`v_scale` ([P, H, page_size] fp32)
+    mark an int8 pool — scale pages ride the SAME page-table indirection
+    and dequant happens in kernel. fp32 accumulation; decode-only, no VJP.
     """
     b, h, n, d = q.shape
     p_total, hk, page_size, dk = k_pages.shape
@@ -282,6 +328,12 @@ def paged_flash_decode_attention(
     )
     n_pages = page_table.shape[1]
     assert page_table.shape == (b, n_pages), (page_table.shape, b)
+    quantized = k_scale is not None
+    assert (k_scale is None) == (v_scale is None), "pass both scales or neither"
+    if quantized:
+        assert k_scale.shape == v_scale.shape == (p_total, h, page_size), (
+            k_scale.shape, (p_total, h, page_size),
+        )
     scale = d**-0.5 if sm_scale is None else sm_scale
     interp = _use_interpret() if interpret is None else interpret
 
@@ -296,6 +348,7 @@ def paged_flash_decode_attention(
         block_k=page_size,
         n_real_q=n,
         nk_blocks=n_pages,
+        quantized=quantized,
     )
     qspec = pl.BlockSpec(
         (1, 1, bq, d), lambda b_, h_, j, lens, pt: (b_, h_, 0, 0)
@@ -307,12 +360,25 @@ def paged_flash_decode_attention(
         return (pt[b_, jc], h_, 0, 0)
 
     kvspec = pl.BlockSpec((1, 1, page_size, d), kv_idx)
+    in_specs = [qspec, kvspec, kvspec]
+    operands = [qp, k_pages, v_pages]
+    if quantized:
+        def sv_idx(b_, h_, j, lens, pt):
+            jc = jnp.minimum(j, _last_live_block(lens[b_], page_size))
+            return (pt[b_, jc], h_, 0)
+
+        svspec = pl.BlockSpec((1, 1, page_size), sv_idx)
+        in_specs = [qspec, kvspec, svspec, kvspec, svspec]
+        operands = [
+            qp, k_pages, k_scale.astype(jnp.float32),
+            v_pages, v_scale.astype(jnp.float32),
+        ]
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(b, h, n_pages),
-            in_specs=[qspec, kvspec, kvspec],
+            in_specs=in_specs,
             out_specs=qspec,
             scratch_shapes=[
                 pltpu.VMEM((bq, 1), jnp.float32),
@@ -325,7 +391,7 @@ def paged_flash_decode_attention(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interp,
-    )(lengths, page_table, qp, k_pages, v_pages)
+    )(lengths, page_table, *operands)
     return out[:, :, :n, :]
 
 
@@ -339,19 +405,36 @@ def paged_decode_attention(
     *,
     impl: Optional[str] = None,
     sm_scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Flash-path dispatch for the paged cache — see the section comment
     above for the "gather" (bit-exact) vs "kernel" (bandwidth-optimal)
     trade. `vlen` is the virtual contiguous length the gather path crops
-    to (the slotted cache's max_len, so tile boundaries match exactly)."""
+    to (the slotted cache's max_len, so tile boundaries match exactly).
+    int8 pools pass their [P, H, page_size] scale pools: the gather path
+    gathers int8 pages + scales and hands BOTH to the contiguous kernel
+    (in-kernel dequant, identical math to the slotted quantized path),
+    keeping the slotted-vs-paged parity contract on the quantized cache."""
     impl = PAGED_DECODE_IMPL if impl is None else impl
     if impl == "gather":
         k = paged_gather(k_pages, page_table, vlen)
         v = paged_gather(v_pages, page_table, vlen)
-        return flash_decode_attention(q, k, v, lengths, sm_scale=sm_scale)
+        kw = {}
+        if k_scale is not None:
+            kw = {
+                "k_scale": paged_gather(
+                    k_scale[..., None], page_table, vlen
+                )[..., 0],
+                "v_scale": paged_gather(
+                    v_scale[..., None], page_table, vlen
+                )[..., 0],
+            }
+        return flash_decode_attention(q, k, v, lengths, sm_scale=sm_scale, **kw)
     assert impl == "kernel", f"unknown paged decode impl {impl!r}"
     return paged_flash_decode_attention(
-        q, k_pages, v_pages, lengths, page_table, sm_scale=sm_scale
+        q, k_pages, v_pages, lengths, page_table, sm_scale=sm_scale,
+        k_scale=k_scale, v_scale=v_scale,
     )
 
 
@@ -376,12 +459,17 @@ def sharded_flash_decode_attention(
     *,
     head_axis: str = "tp",
     sm_scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
 ):
     """`flash_decode_attention` split over `head_axis` of `mesh` via
     shard_map (`parallel/mesh.py`'s compat wrapper keeps it running on
     jax 0.4.37). Heads that don't divide the axis fall back to the
     unsharded kernel — same drop-to-replicated posture as
-    `serving_partition`'s divisibility rule."""
+    `serving_partition`'s divisibility rule. int8 caches hand their
+    [B, H, S] scale leaves along — per-head scales split with the heads
+    (reduction-free), so the sharded quantized kernel stays bit-identical
+    to the unsharded quantized one."""
     from dalle_pytorch_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
 
@@ -390,13 +478,93 @@ def sharded_flash_decode_attention(
     # unsharded rather than raising at trace time inside the chunk program
     axis_n = dict(mesh.shape).get(head_axis, 1)
     if axis_n == 1 or h % axis_n != 0:
-        return flash_decode_attention(q, k, v, lengths, sm_scale=sm_scale)
+        return flash_decode_attention(
+            q, k, v, lengths, sm_scale=sm_scale,
+            k_scale=k_scale, v_scale=v_scale,
+        )
     spec = P(None, head_axis, None, None)
+    args = (q, k, v, lengths)
+    in_specs = (spec, spec, spec, P())
+    if k_scale is not None:
+        sspec = P(None, head_axis, None)
+        args += (k_scale, v_scale)
+        in_specs += (sspec, sspec)
+
+        def call(q_, k_, v_, lens_, ks_, vs_):
+            return flash_decode_attention(
+                q_, k_, v_, lens_, sm_scale=sm_scale,
+                k_scale=ks_, v_scale=vs_,
+            )
+    else:
+        call = functools.partial(flash_decode_attention, sm_scale=sm_scale)
     fn = shard_map(
-        functools.partial(flash_decode_attention, sm_scale=sm_scale),
+        call,
         mesh=mesh,
-        in_specs=(spec, spec, spec, P()),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
     )
-    return fn(q, k, v, lengths)
+    return fn(*args)
+
+
+def sharded_paged_decode_attention(
+    mesh,
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_table: jnp.ndarray,
+    vlen: int,
+    *,
+    head_axis: str = "tp",
+    impl: Optional[str] = None,
+    sm_scale: Optional[float] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+):
+    """`paged_decode_attention` split over `head_axis` of `mesh`: the page
+    pool shards at its HEAD axis (axis 1 of [P, H, page_size, D]) — pages
+    stay whole per device because the host page table addresses physical
+    pages globally — and the table + lengths replicate, so every device
+    dereferences the same logical->physical mapping over its own head
+    shard. Both impls ("gather" and the per-page-DMA "kernel") run the
+    unmodified single-device code per shard; the head concat is exact, so
+    sharded paged decode is bit-identical to single-device paged decode.
+    Never split the PAGE axis: a page-split pool silently reads other
+    rows' pages through the global table (tracelint TL008 flags it)."""
+    from dalle_pytorch_tpu.parallel.mesh import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    h = q.shape[1]
+    axis_n = dict(mesh.shape).get(head_axis, 1)
+    if axis_n == 1 or h % axis_n != 0:
+        return paged_decode_attention(
+            q, k_pages, v_pages, lengths, page_table, vlen,
+            impl=impl, sm_scale=sm_scale, k_scale=k_scale, v_scale=v_scale,
+        )
+    spec = P(None, head_axis, None, None)
+    args = (q, k_pages, v_pages, lengths, page_table)
+    in_specs = (spec, spec, spec, P(), P())
+    if k_scale is not None:
+        sspec = P(None, head_axis, None)
+        args += (k_scale, v_scale)
+        in_specs += (sspec, sspec)
+
+        def call(q_, kp_, vp_, lens_, pt_, ks_, vs_):
+            return paged_decode_attention(
+                q_, kp_, vp_, lens_, pt_, vlen, impl=impl,
+                sm_scale=sm_scale, k_scale=ks_, v_scale=vs_,
+            )
+    else:
+        def call(q_, kp_, vp_, lens_, pt_):
+            return paged_decode_attention(
+                q_, kp_, vp_, lens_, pt_, vlen, impl=impl, sm_scale=sm_scale
+            )
+    fn = shard_map(
+        call,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(*args)

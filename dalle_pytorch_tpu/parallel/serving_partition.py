@@ -19,6 +19,9 @@ Sharding scheme (the natural splits of the decode data path):
       paged pool [P, H, page_size, dh] (scan executor adds a leading
       depth axis, which stays unsharded so one scan step touches exactly
       one layer's shards).
+  KV scales k/v_scale [..., B|P, H, L]    -> heads over `tp`
+      int8-cache per-(position, head) fp32 scales ride with the heads
+      they scale; the page axis (paged pool) stays whole, like k/v.
   pending logits      [S, V]              -> vocab over `tp`
       Matches the logits head's (fsdp, tp) column split, so the head's
       output lands already distributed.
@@ -78,6 +81,13 @@ def decode_state_spec(path, leaf, model_axis: str = SERVING_MODEL_AXIS) -> P:
         # heads sit at rank-3 in both layouts
         assert rank in (4, 5), f"unexpected cache leaf {key} rank {rank}"
         return P(*([None] * (rank - 3)), model_axis)
+    if key in ("k_scale", "v_scale"):
+        # int8-cache per-(position, head) fp32 scales: [B, H, L] slotted /
+        # [P, H, page_size] paged (scan adds depth) — heads at rank-2, so
+        # the scales split WITH the heads they scale and the head-split
+        # shard_map kernel reads its shard's scales locally
+        assert rank in (3, 4), f"unexpected scale leaf {key} rank {rank}"
+        return P(*([None] * (rank - 2)), model_axis)
     if key in _RING_KEYS or key in _ROW_SCALAR_KEYS:
         return P()
     if key == "row":

@@ -527,6 +527,7 @@ class ContinuousEngine(GenerationEngine):
         cfg=None,
         resume_enabled: bool = False,
         preview_enabled: bool = False,
+        kv_dtype=None,
     ):
         assert float(cond_scale) == 1.0, (
             "ContinuousEngine does not support classifier-free guidance yet "
@@ -534,6 +535,11 @@ class ContinuousEngine(GenerationEngine):
             "the micro-batch GenerationEngine for cond_scale != 1"
         )
         assert int(chunk_tokens) >= 1
+        # int8 KV cache (--kv_dtype int8): clone the model so every slot-op
+        # builder (they key the jit cache on the model) sees the quantized
+        # cache layout; None keeps the bit-identical default path
+        if kv_dtype is not None and getattr(model, "kv_dtype", None) is None:
+            model = model.clone(kv_dtype=str(kv_dtype))
         super().__init__(
             model=model,
             variables=variables,
@@ -582,6 +588,13 @@ class ContinuousEngine(GenerationEngine):
             "batched prefill dispatches (each admits up to prefill_batch "
             "rows in one fixed-shape program)",
         )
+        self._m_kv_bytes_slot = self.registry.gauge(
+            "dalle_serving_kv_bytes_per_slot",
+            "HBM bytes of KV cache (K/V + quantization scales) backing one "
+            "decode slot — pool-sizing honesty: pages alone undercount the "
+            "capacity win when --kv_dtype int8 shrinks each page",
+        )
+        self._m_kv_bytes_slot.set(self.kv_bytes_per_slot())
         self._decode_pixels_jit = None
         self._preview_jit = None
         self._preview_fill = None
@@ -600,6 +613,32 @@ class ContinuousEngine(GenerationEngine):
         from dalle_pytorch_tpu.models.dalle import init_slot_state
 
         return init_slot_state(self.model, self.max_batch)
+
+    def _kv_cache_bytes(self) -> int:
+        """Total bytes of the K/V leaves (values + quantization scales)
+        in the live decode state."""
+        import jax
+
+        total = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            self._state["cache"]
+        )[0]:
+            key = ""
+            for p in reversed(path):
+                k = getattr(p, "key", None)
+                if k is not None:
+                    key = str(k)
+                    break
+            if key in ("k", "v", "k_scale", "v_scale"):
+                total += int(leaf.size) * int(np.dtype(leaf.dtype).itemsize)
+        return total
+
+    def kv_bytes_per_slot(self) -> int:
+        """K/V (+ scale) bytes backing ONE decode slot. int8 pages cut
+        this ~2x vs fp32 (the per-position fp32 scale adds 4 bytes per
+        dim_head values), which is the slots-per-HBM-byte win the
+        `dalle_serving_kv_bytes_per_slot` gauge makes visible."""
+        return self._kv_cache_bytes() // self.max_batch
 
     def _replace_state(self, op, fault_tag: Optional[str] = None) -> None:
         """Run one state-transforming dispatch. The slot ops DONATE the
@@ -1252,6 +1291,7 @@ class PagedContinuousEngine(ContinuousEngine):
         prefix_entries: int = 64,
         resume_enabled: bool = False,
         preview_enabled: bool = False,
+        kv_dtype=None,
     ):
         self.page_size = int(page_size)
         assert self.page_size >= 1
@@ -1282,6 +1322,7 @@ class PagedContinuousEngine(ContinuousEngine):
             cfg=cfg,
             resume_enabled=resume_enabled,
             preview_enabled=preview_enabled,
+            kv_dtype=kv_dtype,
         )
         assert self.kv.can_ever_admit(1), (
             f"kv_pages={self.kv_pages} cannot hold a single row "
@@ -1380,13 +1421,30 @@ class PagedContinuousEngine(ContinuousEngine):
         should reject it outright rather than queue it forever."""
         return self.kv.can_ever_admit(len(specs))
 
+    def kv_page_bytes(self) -> int:
+        """Bytes of ONE physical page across all layers (K + V + any
+        quantization scales) — what a `dalle_serving_blocks_*` page is
+        actually worth in HBM at the engine's kv dtype."""
+        return self._kv_cache_bytes() // self.kv_pages
+
+    def kv_bytes_per_slot(self) -> int:
+        """Worst-case bytes one row can pin: its full page complement.
+        (The pool is shared — prefix hits pin less — but sizing honesty
+        wants the bound, not the average.)"""
+        return self.kv_page_bytes() * self.kv.pages_per_row
+
     def kv_detail(self) -> dict:
         """Block-pool + prefix-cache snapshot for /healthz."""
         cache = self.kv.cache
+        kv_dt = getattr(self.model, "kv_dtype", None)
         return {
             "layout": "paged",
             "page_size": self.page_size,
             "pages_per_row": self.kv.pages_per_row,
+            "dtype": str(kv_dt) if kv_dt is not None else str(
+                np.dtype(self.model.dtype).name
+            ),
+            "bytes_per_page": self.kv_page_bytes(),
             "blocks_total": self.kv.pool.n_pages - 1,
             "blocks_active": self.kv.blocks_active,
             "blocks_free": self.kv.blocks_free,
@@ -1399,6 +1457,36 @@ class PagedContinuousEngine(ContinuousEngine):
         }
 
     # ------------------------------------------------------------ slot ops
+    # The three paged model ops run behind subclass seams (like
+    # `_prefill_op`/`_chunk_op`/`_release_op` on the slotted engine) so
+    # the sharded paged engine can pin out_shardings on the whole ladder.
+
+    def _paged_prefill_op(self, s, texts, slots, seeds, temps, keep,
+                          page_rows, partial_dst):
+        from dalle_pytorch_tpu.models.dalle import prefill_into_slots_paged
+
+        return prefill_into_slots_paged(
+            self.model, self.variables, s, texts, slots, seeds, temps,
+            keep, page_rows, partial_dst, self.page_size,
+        )
+
+    def _admit_hit_op(self, s, slot, sidecar, seed, temperature, keep_k,
+                      partial_src, partial_dst):
+        from dalle_pytorch_tpu.models.dalle import admit_cached_prefix
+
+        return admit_cached_prefix(
+            self.model, s, slot, sidecar, seed, temperature, keep_k,
+            partial_src, partial_dst, self.page_size,
+        )
+
+    def _paged_resume_op(self, s, texts, img_tokens, img_pos, slots,
+                         seeds, temps, keep, page_rows):
+        from dalle_pytorch_tpu.models.dalle import resume_into_slots_paged
+
+        return resume_into_slots_paged(
+            self.model, self.variables, s, texts, img_tokens, img_pos,
+            slots, seeds, temps, keep, page_rows, self.page_size,
+        )
 
     def protect_admission_wave(self, assignments) -> set:
         """Pin every full-prompt hit entry of one budgeted admission wave
@@ -1507,11 +1595,10 @@ class PagedContinuousEngine(ContinuousEngine):
             with self._lock:
                 self._replace_state(
                     lambda s, slot=slot, spec=spec, entry=entry,
-                    partial_src=partial_src, pdst=pdst: admit_cached_prefix(
-                        self.model, s, slot, entry.sidecar,
+                    partial_src=partial_src, pdst=pdst: self._admit_hit_op(
+                        s, slot, entry.sidecar,
                         int(spec.seed) & 0x7FFFFFFF, spec.temperature,
                         self._keep_k(spec.top_k), partial_src, pdst,
-                        self.page_size,
                     ),
                     fault_tag="admit_hit",
                 )
@@ -1583,9 +1670,9 @@ class PagedContinuousEngine(ContinuousEngine):
             sidecars = {}
 
             def op(s):
-                new_s, sidecar = prefill_into_slots_paged(
-                    self.model, self.variables, s, texts, slots, seeds,
-                    temps, keep, page_rows, partial_dst, self.page_size,
+                new_s, sidecar = self._paged_prefill_op(
+                    s, texts, slots, seeds, temps, keep, page_rows,
+                    partial_dst,
                 )
                 sidecars["wave"] = sidecar
                 return new_s
@@ -1672,10 +1759,9 @@ class PagedContinuousEngine(ContinuousEngine):
             with self._lock:
                 # on failure _replace_state rebuilds state AND (via
                 # _fresh_state) the kv manager, discarding the mappings
-                self._replace_state(lambda s: resume_into_slots_paged(
-                    self.model, self.variables, s, texts, img_tokens,
-                    img_pos, slots, seeds, temps, keep, page_rows,
-                    self.page_size,
+                self._replace_state(lambda s: self._paged_resume_op(
+                    s, texts, img_tokens, img_pos, slots, seeds, temps,
+                    keep, page_rows,
                 ), fault_tag="resume")
                 if _warmup:
                     self._capture_cost(
@@ -1836,6 +1922,7 @@ def engine_from_checkpoint(
     mesh=None,
     resume_enabled: Optional[bool] = None,
     preview_enabled: Optional[bool] = None,
+    kv_dtype: Optional[str] = None,
 ):
     """Build a serving engine from a single-file DALLE checkpoint.
 
@@ -1846,17 +1933,18 @@ def engine_from_checkpoint(
     `PagedContinuousEngine` (`page_size` tokens per page, `kv_pages` pool
     size or None for the slotted-equivalent worst case, `prefix_entries`
     cached prompts). `mesh` (a `parse_mesh_shape` string/dict, or a ready
-    jax Mesh) selects the mesh-sharded `ShardedContinuousEngine` —
-    slot layout only (the paged pool's mesh split is the ROADMAP
-    follow-on). The loading
+    jax Mesh) selects the mesh-sharded `ShardedContinuousEngine`
+    (`kv_layout="paged"` upgrades it to `ShardedPagedContinuousEngine`:
+    the paged pool head-splits over `tp`, page tables stay host-side).
+    `kv_dtype="int8"` stores KV pages quantized with per-(position, head)
+    scales; `None`/"model" keeps the model dtype. The loading
     sequence (VAE reconstruction, tokenizer, ring-attention downgrade for
     decode) was lifted from `generate.py`, which now calls this instead —
     CLI and server share one code path by construction.
     """
     assert mode in ("micro", "continuous"), f"unknown engine mode {mode!r}"
-    assert mesh is None or (mode == "continuous" and kv_layout == "slot"), (
-        "--mesh needs the continuous engine with the slot kv layout "
-        "(sharding the paged pool is the ROADMAP item 1 follow-on)"
+    assert mesh is None or mode == "continuous", (
+        "--mesh needs the continuous engine (slot or paged kv layout)"
     )
     from pathlib import Path
 
@@ -1891,6 +1979,10 @@ def engine_from_checkpoint(
         cfg, num_image_tokens=vae.num_tokens, image_fmap_size=fmap,
         vocab_size=max(tokenizer.vocab_size, 1),
     )
+    if kv_dtype not in (None, "model"):
+        # quantized KV store: every engine (and the micro path) reads the
+        # model field, so one clone here covers all modes uniformly
+        model = model.clone(kv_dtype=str(kv_dtype))
 
     clip = clip_params = None
     if clip_path:
@@ -1922,26 +2014,31 @@ def engine_from_checkpoint(
             if kv_layout == "paged"
             else {}
         )
-        if mesh is None:
-            # decode-state resume (mid-decode migration) defaults ON for
-            # serving boots; the sharded engine keeps it off (pinning the
-            # resume program's out_shardings is the follow-on)
-            paged_kw["resume_enabled"] = (
-                True if resume_enabled is None else bool(resume_enabled)
-            )
+        # decode-state resume (mid-decode migration) defaults ON for
+        # serving boots — the sharded engines pin the resume program's
+        # out_shardings, so mesh boots keep it too
+        paged_kw["resume_enabled"] = (
+            True if resume_enabled is None else bool(resume_enabled)
+        )
         if mesh is not None:
             from dalle_pytorch_tpu.serving.sharded import (
-                ShardedContinuousEngine,
+                ShardedContinuousEngine, ShardedPagedContinuousEngine,
             )
 
-            cls = ShardedContinuousEngine
+            cls = (
+                ShardedPagedContinuousEngine
+                if kv_layout == "paged"
+                else ShardedContinuousEngine
+            )
             try:
                 from jax.sharding import Mesh
 
                 is_mesh = isinstance(mesh, Mesh)
             except Exception:  # pragma: no cover - jax always importable here
                 is_mesh = False
-            paged_kw = dict(mesh=mesh) if is_mesh else dict(mesh_shape=mesh)
+            paged_kw.update(
+                dict(mesh=mesh) if is_mesh else dict(mesh_shape=mesh)
+            )
         # progressive-preview decode (streaming) defaults ON for serving
         # boots on every continuous engine — the preview program rides
         # the replicated VAE, so the sharded engine warms it too

@@ -14,7 +14,7 @@ TPUv4", PAPERS.md):
     `parallel/serving_partition.py`: KV cache split over attention heads
     on the `tp` axis, pending-logits rows vocab-split, per-row control
     scalars replicated;
-  * the four steady-state programs (batched prefill, chunk, release,
+  * the steady-state programs (batched prefill, resume, chunk, release,
     pixel decode) are the SAME program bodies the single-device engine
     runs (`models/dalle.py` builders) — re-jitted here with explicit
     `out_shardings` pinned to the canonical state shardings, so the
@@ -41,8 +41,17 @@ contract extends across the mesh — a >=2-device CPU mesh
 to the single-device engine for the same specs/seeds
 (tests/test_sharded.py).
 
-Paged + mesh (sharding the page pool over heads) is the ROADMAP item 1
-follow-on; this engine is the slot layout.
+`ShardedPagedContinuousEngine` extends the same placement to the paged
+layout: the physical page POOL head-splits over `tp` (each shard holds
+its heads' slice of every page), while page tables, refcounts, and the
+prefix-cache index stay host-side numpy — page bookkeeping is
+device-count-independent, so the paged admission/eviction logic runs
+verbatim. The whole paged ladder (prefill + sidecar, cached-prefix
+admit, resume, chunk, release) is pinned with `out_shardings` like the
+slotted programs. The page axis itself must NEVER shard: a page is the
+unit of host-side allocation, and splitting it would put half of each
+page's tokens on the wrong device (tracelint TL008 flags specs that
+try).
 """
 
 from __future__ import annotations
@@ -51,7 +60,10 @@ from typing import Optional, Union
 
 import numpy as np
 
-from dalle_pytorch_tpu.serving.engine import ContinuousEngine
+from dalle_pytorch_tpu.serving.engine import (
+    ContinuousEngine,
+    PagedContinuousEngine,
+)
 
 #: the 4-axis `make_mesh` vocabulary, re-declared so `parse_mesh_shape`
 #: stays importable without paying a jax init (`parallel/mesh.py` imports
@@ -123,38 +135,20 @@ def build_serving_mesh(shape: Union[str, dict, None] = None, devices=None):
     return make_mesh(devices=devices[:fixed], **kw)
 
 
-class ShardedContinuousEngine(ContinuousEngine):
-    """Continuous batching with params + slot KV cache sharded over a
-    device mesh. Same serving surface as `ContinuousEngine` (the batcher,
-    server, tracing, and vitals layers don't know the difference); same
-    decode numerics (bit-identical tokens — the test-pinned contract).
+class _MeshServingMixin:
+    """Mesh plumbing shared by the slotted and paged sharded engines:
+    placement at load, state placement, the pinned-program cache, the
+    (layout-independent) release program, and the per-shard
+    observability block. Each concrete engine supplies its own pinned
+    admission/chunk programs — the bodies differ per layout but the jit
+    wrapper discipline (donate the state, pin out_shardings to the
+    canonical state shardings) is identical."""
 
-    `mesh` is a ready `jax.sharding.Mesh`, or pass `mesh_shape` (a
-    `parse_mesh_shape` string/dict) to build one over the visible
-    devices. `model_axis` names the axis heads/vocab shard over
-    (default "tp").
-    """
-
-    def __init__(
-        self,
-        model,
-        variables,
-        vae=None,
-        vae_params=None,
-        max_batch: int = 8,
-        chunk_tokens: int = 4,
-        prefill_batch: int = 4,
-        cond_scale: float = 1.0,
-        clip=None,
-        clip_params=None,
-        tokenizer=None,
-        registry=None,
-        cfg=None,
-        mesh=None,
-        mesh_shape: Union[str, dict, None] = None,
-        model_axis: str = "tp",  # serving_partition.SERVING_MODEL_AXIS
-        preview_enabled: bool = False,
-    ):
+    def _init_mesh(self, model, variables, vae_params, mesh, mesh_shape,
+                   model_axis):
+        """Resolve the mesh, clone the model's decode-kernel mesh handle,
+        and place params/VAE — returns the (possibly cloned/placed)
+        triple for the engine __init__ to forward to its base class."""
         import jax
 
         from dalle_pytorch_tpu.parallel.serving_partition import (
@@ -190,28 +184,16 @@ class ShardedContinuousEngine(ContinuousEngine):
             vae_params = jax.device_put(
                 vae_params, replicated_shardings(vae_params, mesh)
             )
-        super().__init__(
-            model=model,
-            variables=variables,
-            vae=vae,
-            vae_params=vae_params,
-            max_batch=max_batch,
-            chunk_tokens=chunk_tokens,
-            prefill_batch=prefill_batch,
-            cond_scale=cond_scale,
-            clip=clip,
-            clip_params=clip_params,
-            tokenizer=tokenizer,
-            registry=registry,
-            cfg=cfg,
-            preview_enabled=preview_enabled,
-        )
+        return model, variables, vae_params
 
     # ---------------------------------------------------------- placement
 
     def _fresh_state(self):
-        """Clean slot state placed under the serving_partition shardings
-        (KV heads over the model axis, control scalars replicated)."""
+        """Clean decode state placed under the serving_partition
+        shardings (KV heads over the model axis — slot lanes and the
+        paged pool alike —, control scalars replicated). The paged base
+        rebuilds its host-side page tables inside super()._fresh_state();
+        they are plain numpy and never placed."""
         import jax
 
         from dalle_pytorch_tpu.parallel.serving_partition import (
@@ -232,47 +214,17 @@ class ShardedContinuousEngine(ContinuousEngine):
             self._sharded_programs[name] = fn
         return fn
 
+    def _replicated_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
     # ----------------------------------------------------------- slot ops
     # The program BODIES are models/dalle.py's — only the jit wrapper
     # differs: out_shardings pinned to the canonical state shardings so
     # the donated state's sharding is a fixed point from dispatch one
     # (unpinned, GSPMD may hand back a drifted sharding that re-keys the
     # jit cache on the next dispatch — a silent warm-path recompile).
-
-    def _prefill_op(self, s, texts, slots, seeds, temps, keep):
-        import jax
-        import jax.numpy as jnp
-
-        from dalle_pytorch_tpu.models.dalle import _prefill_slots_builder
-
-        fn = self._sharded_program(
-            "prefill",
-            lambda: jax.jit(
-                _prefill_slots_builder(self.model, (self.prefill_batch,)),
-                donate_argnums=(1,),
-                out_shardings=self._state_shardings,
-            ),
-        )
-        return fn(
-            self.variables, s, jnp.asarray(texts, jnp.int32),
-            jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
-            jnp.asarray(temps, jnp.float32), jnp.asarray(keep, jnp.int32),
-        )
-
-    def _chunk_op(self, s):
-        import jax
-
-        from dalle_pytorch_tpu.models.dalle import _chunk_builder
-
-        fn = self._sharded_program(
-            "chunk",
-            lambda: jax.jit(
-                _chunk_builder(self.model, (self.chunk_tokens,)),
-                donate_argnums=(1,),
-                out_shardings=self._state_shardings,
-            ),
-        )
-        return fn(self.variables, s)
 
     def _release_op(self, s, mask):
         import jax
@@ -333,3 +285,292 @@ class ShardedContinuousEngine(ContinuousEngine):
         out = super().state_dump()
         out["mesh"] = self.mesh_detail()
         return out
+
+
+class ShardedContinuousEngine(_MeshServingMixin, ContinuousEngine):
+    """Continuous batching with params + slot KV cache sharded over a
+    device mesh. Same serving surface as `ContinuousEngine` (the batcher,
+    server, tracing, and vitals layers don't know the difference); same
+    decode numerics (bit-identical tokens — the test-pinned contract).
+
+    `mesh` is a ready `jax.sharding.Mesh`, or pass `mesh_shape` (a
+    `parse_mesh_shape` string/dict) to build one over the visible
+    devices. `model_axis` names the axis heads/vocab shard over
+    (default "tp").
+    """
+
+    def __init__(
+        self,
+        model,
+        variables,
+        vae=None,
+        vae_params=None,
+        max_batch: int = 8,
+        chunk_tokens: int = 4,
+        prefill_batch: int = 4,
+        cond_scale: float = 1.0,
+        clip=None,
+        clip_params=None,
+        tokenizer=None,
+        registry=None,
+        cfg=None,
+        mesh=None,
+        mesh_shape: Union[str, dict, None] = None,
+        model_axis: str = "tp",  # serving_partition.SERVING_MODEL_AXIS
+        resume_enabled: bool = False,
+        preview_enabled: bool = False,
+        kv_dtype=None,
+    ):
+        model, variables, vae_params = self._init_mesh(
+            model, variables, vae_params, mesh, mesh_shape, model_axis
+        )
+        super().__init__(
+            model=model,
+            variables=variables,
+            vae=vae,
+            vae_params=vae_params,
+            max_batch=max_batch,
+            chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch,
+            cond_scale=cond_scale,
+            clip=clip,
+            clip_params=clip_params,
+            tokenizer=tokenizer,
+            registry=registry,
+            cfg=cfg,
+            resume_enabled=resume_enabled,
+            preview_enabled=preview_enabled,
+            kv_dtype=kv_dtype,
+        )
+
+    # ----------------------------------------------------------- slot ops
+
+    def _prefill_op(self, s, texts, slots, seeds, temps, keep):
+        import jax
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.dalle import _prefill_slots_builder
+
+        fn = self._sharded_program(
+            "prefill",
+            lambda: jax.jit(
+                _prefill_slots_builder(self.model, (self.prefill_batch,)),
+                donate_argnums=(1,),
+                out_shardings=self._state_shardings,
+            ),
+        )
+        return fn(
+            self.variables, s, jnp.asarray(texts, jnp.int32),
+            jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
+            jnp.asarray(temps, jnp.float32), jnp.asarray(keep, jnp.int32),
+        )
+
+    def _resume_op(self, s, texts, img_tokens, img_pos, slots, seeds,
+                   temps, keep):
+        import jax
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.dalle import _resume_slots_builder
+
+        fn = self._sharded_program(
+            "resume",
+            lambda: jax.jit(
+                _resume_slots_builder(self.model, (self.prefill_batch,)),
+                donate_argnums=(1,),
+                out_shardings=self._state_shardings,
+            ),
+        )
+        return fn(
+            self.variables, s, jnp.asarray(texts, jnp.int32),
+            jnp.asarray(img_tokens, jnp.int32),
+            jnp.asarray(img_pos, jnp.int32),
+            jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
+            jnp.asarray(temps, jnp.float32), jnp.asarray(keep, jnp.int32),
+        )
+
+    def _chunk_op(self, s):
+        import jax
+
+        from dalle_pytorch_tpu.models.dalle import _chunk_builder
+
+        fn = self._sharded_program(
+            "chunk",
+            lambda: jax.jit(
+                _chunk_builder(self.model, (self.chunk_tokens,)),
+                donate_argnums=(1,),
+                out_shardings=self._state_shardings,
+            ),
+        )
+        return fn(self.variables, s)
+
+
+class ShardedPagedContinuousEngine(_MeshServingMixin, PagedContinuousEngine):
+    """Paged continuous batching over a device mesh: the physical page
+    pool head-splits over the model axis (each shard holds its heads'
+    slice of EVERY page), page tables / refcounts / the prefix-cache
+    index stay host-side numpy and run verbatim. The whole paged program
+    ladder — batched prefill (+ sidecar), cached-prefix admit, resume,
+    chunk, release — is re-jitted with out_shardings pinned to the
+    canonical state shardings, so the warm server's zero-recompile
+    contract holds exactly as on the slotted sharded engine.
+
+    The page axis NEVER shards (a page is the host allocator's unit;
+    `parallel/serving_partition.py` keeps it whole and tracelint TL008
+    flags shard_map specs that split it)."""
+
+    def __init__(
+        self,
+        model,
+        variables,
+        vae=None,
+        vae_params=None,
+        max_batch: int = 8,
+        chunk_tokens: int = 4,
+        prefill_batch: int = 4,
+        cond_scale: float = 1.0,
+        clip=None,
+        clip_params=None,
+        tokenizer=None,
+        registry=None,
+        cfg=None,
+        page_size: int = 32,
+        kv_pages: Optional[int] = None,
+        prefix_entries: int = 64,
+        mesh=None,
+        mesh_shape: Union[str, dict, None] = None,
+        model_axis: str = "tp",  # serving_partition.SERVING_MODEL_AXIS
+        resume_enabled: bool = False,
+        preview_enabled: bool = False,
+        kv_dtype=None,
+    ):
+        model, variables, vae_params = self._init_mesh(
+            model, variables, vae_params, mesh, mesh_shape, model_axis
+        )
+        super().__init__(
+            model=model,
+            variables=variables,
+            vae=vae,
+            vae_params=vae_params,
+            max_batch=max_batch,
+            chunk_tokens=chunk_tokens,
+            prefill_batch=prefill_batch,
+            cond_scale=cond_scale,
+            clip=clip,
+            clip_params=clip_params,
+            tokenizer=tokenizer,
+            registry=registry,
+            cfg=cfg,
+            page_size=page_size,
+            kv_pages=kv_pages,
+            prefix_entries=prefix_entries,
+            resume_enabled=resume_enabled,
+            preview_enabled=preview_enabled,
+            kv_dtype=kv_dtype,
+        )
+
+    # ----------------------------------------------------------- slot ops
+    # Pinned versions of the paged seams. The prefill program returns
+    # (state, sidecar): the state pins to the canonical shardings, the
+    # sidecar (pending logits + shift rings, consumed host-side by the
+    # prefix-cache registration) replicates — a pytree-prefix
+    # out_shardings covers both.
+
+    def _paged_prefill_op(self, s, texts, slots, seeds, temps, keep,
+                          page_rows, partial_dst):
+        import jax
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.dalle import (
+            _prefill_slots_paged_builder,
+        )
+
+        n_text_pages = int(np.asarray(page_rows).shape[1])
+        fn = self._sharded_program(
+            "prefill",
+            lambda: jax.jit(
+                _prefill_slots_paged_builder(
+                    self.model,
+                    (self.prefill_batch, self.page_size, n_text_pages),
+                ),
+                donate_argnums=(1,),
+                out_shardings=(
+                    self._state_shardings, self._replicated_sharding(),
+                ),
+            ),
+        )
+        return fn(
+            self.variables, s, jnp.asarray(texts, jnp.int32),
+            jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
+            jnp.asarray(temps, jnp.float32), jnp.asarray(keep, jnp.int32),
+            jnp.asarray(page_rows, jnp.int32),
+            jnp.asarray(partial_dst, jnp.int32),
+        )
+
+    def _admit_hit_op(self, s, slot, sidecar, seed, temperature, keep_k,
+                      partial_src, partial_dst):
+        import jax
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.dalle import _admit_prefix_builder
+
+        fn = self._sharded_program(
+            "admit_hit",
+            lambda: jax.jit(
+                _admit_prefix_builder(self.model, (self.page_size,)),
+                donate_argnums=(0,),
+                out_shardings=self._state_shardings,
+            ),
+        )
+        return fn(
+            s, jnp.int32(slot), sidecar, jnp.int32(seed),
+            jnp.float32(temperature), jnp.int32(keep_k),
+            jnp.int32(partial_src), jnp.int32(partial_dst),
+        )
+
+    def _paged_resume_op(self, s, texts, img_tokens, img_pos, slots,
+                         seeds, temps, keep, page_rows):
+        import jax
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.dalle import (
+            _resume_slots_paged_builder,
+        )
+
+        n_pages_row = int(np.asarray(page_rows).shape[1])
+        fn = self._sharded_program(
+            "resume",
+            lambda: jax.jit(
+                _resume_slots_paged_builder(
+                    self.model,
+                    (self.prefill_batch, self.page_size, n_pages_row),
+                ),
+                donate_argnums=(1,),
+                out_shardings=self._state_shardings,
+            ),
+        )
+        return fn(
+            self.variables, s, jnp.asarray(texts, jnp.int32),
+            jnp.asarray(img_tokens, jnp.int32),
+            jnp.asarray(img_pos, jnp.int32),
+            jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
+            jnp.asarray(temps, jnp.float32), jnp.asarray(keep, jnp.int32),
+            jnp.asarray(page_rows, jnp.int32),
+        )
+
+    def _chunk_op(self, s):
+        import jax
+        import jax.numpy as jnp
+
+        from dalle_pytorch_tpu.models.dalle import _chunk_paged_builder
+
+        fn = self._sharded_program(
+            "chunk",
+            lambda: jax.jit(
+                _chunk_paged_builder(self.model, (self.chunk_tokens,)),
+                donate_argnums=(1,),
+                out_shardings=self._state_shardings,
+            ),
+        )
+        return fn(
+            self.variables, s, jnp.asarray(self.kv.table, jnp.int32)
+        )

@@ -98,13 +98,23 @@ def parse_args(argv=None):
                    "(0 disables prefix caching; LRU eviction)")
     p.add_argument("--mesh", type=str, default=None, metavar="AXES",
                    help="serve one engine SHARDED over a device mesh "
-                   "(continuous engine, slot layout): axis=size pairs "
-                   "over dp/fsdp/tp/sp, e.g. 'dp=1,tp=4'; one size may "
-                   "be -1 to absorb the remaining devices. Params shard "
-                   "per parallel/partition.py, the slot KV cache over "
-                   "attention heads (parallel/serving_partition.py). "
-                   "CPU smoke test: XLA_FLAGS="
+                   "(continuous engine, slot OR paged layout): axis=size "
+                   "pairs over dp/fsdp/tp/sp, e.g. 'dp=1,tp=4'; one size "
+                   "may be -1 to absorb the remaining devices. Params "
+                   "shard per parallel/partition.py, the KV cache (slot "
+                   "lanes or the paged page pool) over attention heads "
+                   "(parallel/serving_partition.py); page tables stay "
+                   "host-side. CPU smoke test: XLA_FLAGS="
                    "--xla_force_host_platform_device_count=8")
+    p.add_argument("--kv_dtype", choices=("model", "int8"), default="model",
+                   help="KV-cache storage dtype (continuous engine). "
+                   "model: the model compute dtype (bit-identical "
+                   "default); int8: pages/lanes stored quantized with "
+                   "per-(position, head) fp32 scales, dequantized inside "
+                   "the decode kernels — roughly 2x decode rows per HBM "
+                   "byte (exactly 2D/(D+4) at head dim D) at a small "
+                   "quantization error (bench_serving.py reports the "
+                   "CLIP-score delta beside the speedup)")
     p.add_argument("--max_queue", type=int, default=64,
                    help="queue bound in rows; beyond it requests get 503")
     p.add_argument("--request_timeout_s", type=float, default=120.0)
@@ -291,11 +301,10 @@ def parse_args(argv=None):
         p.error(f"bad --tenant_weights: {exc}")
     if args.mesh is not None:
         # fail at parse time, not after the checkpoint loads: both the
-        # engine/layout combination and the mesh string itself
-        if args.engine != "continuous" or args.kv_layout != "slot":
-            p.error("--mesh needs --engine continuous with --kv_layout "
-                    "slot (sharding the paged pool is the ROADMAP "
-                    "follow-on)")
+        # engine mode and the mesh string itself (slot AND paged layouts
+        # both shard — the paged pool head-splits, tables stay host-side)
+        if args.engine != "continuous":
+            p.error("--mesh needs --engine continuous")
         from dalle_pytorch_tpu.serving.sharded import parse_mesh_shape
 
         try:
@@ -408,6 +417,7 @@ def main(argv=None):
             kv_pages=args.kv_pages,
             prefix_entries=args.prefix_entries,
             mesh=args.mesh,
+            kv_dtype=args.kv_dtype,
             resume_enabled=not args.no_resume,
             # --preview_every 0 drops the preview fill+decode program
             # from the warmup ladder entirely (micro engines never

@@ -1,0 +1,57 @@
+"""TL008 negative fixture (paged-pool clause): head-axis pool splits,
+partial-wrapped kernels with whole page axes, and non-paged callables
+that are free to shard their leading axis — all silent."""
+
+from functools import partial
+
+from dalle_pytorch_tpu.ops.pallas_decode import (
+    paged_decode_attention,
+    paged_flash_decode_attention,
+)
+from dalle_pytorch_tpu.parallel.mesh import make_mesh
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+mesh = make_mesh(dp=2, tp=4)
+
+
+def body(q, k, v):
+    return q + k + v
+
+
+# pools split on the HEAD axis (position 1) — the sanctioned layout
+ok_head_split = shard_map(
+    paged_flash_decode_attention,
+    mesh=mesh,
+    in_specs=(
+        P(None, "tp", None),
+        P(None, "tp", None, None),
+        P(None, "tp", None, None),
+    ),
+    out_specs=P(None, "tp", None),
+)
+
+ok_partial = shard_map(
+    partial(paged_decode_attention, page_size=64),
+    mesh=mesh,
+    in_specs=(
+        P(None, "tp", None),
+        P(None, "tp", None, None),
+        P(None, "tp", None, None),
+    ),
+    out_specs=P(None, "tp", None),
+)
+
+# a non-paged callable may shard whatever leading axis it likes
+ok_other_fn = shard_map(
+    body,
+    mesh=mesh,
+    in_specs=(P("tp", None), P("tp", None), P("tp", None)),
+    out_specs=P("tp", None),
+)
+
+# in_specs built elsewhere (not a literal tuple): silent by design
+SPECS = (P(None, "tp", None), P(None, "tp", None, None), P(None, "tp", None, None))
+ok_indirect = shard_map(
+    paged_decode_attention, mesh=mesh, in_specs=SPECS, out_specs=P(None, "tp", None),
+)

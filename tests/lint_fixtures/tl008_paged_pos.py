@@ -1,0 +1,39 @@
+"""TL008 positive fixture (paged-pool clause): `shard_map` wrapping a
+paged decode kernel whose pool specs (in_specs positions 1/2) lead with
+a mesh axis — splitting the PAGE axis, the host allocator's addressing
+unit. Axis names are all valid for the factory mesh, so ONLY the
+page-axis findings fire here."""
+
+from functools import partial
+
+from dalle_pytorch_tpu.ops.pallas_decode import (
+    paged_decode_attention,
+    paged_flash_decode_attention,
+)
+from dalle_pytorch_tpu.parallel.mesh import make_mesh
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+mesh = make_mesh(dp=2, tp=4)
+
+bad_direct = shard_map(
+    paged_flash_decode_attention,
+    mesh=mesh,
+    in_specs=(
+        P(None, "tp", None),               # q: head split, fine
+        P("tp", None, None, None),         # k_pages: PAGE axis split
+        P(("dp", "tp"), None, None, None),  # v_pages: page axis in a group
+    ),
+    out_specs=P(None, "tp", None),
+)
+
+bad_partial = shard_map(
+    partial(paged_decode_attention, page_size=64),
+    mesh=mesh,
+    in_specs=(
+        P(None, "tp", None),
+        P("tp", None, None, None),  # k_pages: page axis again
+        P(None, "tp", None, None),  # v_pages: head split, fine
+    ),
+    out_specs=P(None, "tp", None),
+)

@@ -48,6 +48,7 @@ class TestRuleCorpus:
             ("tl006_pos.py", "TL006", 4),
             ("tl007_pos.py", "TL007", 3),
             ("tl008_pos.py", "TL008", 3),
+            ("tl008_paged_pos.py", "TL008", 3),
             ("tl009_pos.py", "TL009", 3),
             ("serving/tl010_pos.py", "TL010", 3),
             ("serving/tl011_pos.py", "TL011", 3),
@@ -77,6 +78,7 @@ class TestRuleCorpus:
             "tl006_neg.py",
             "tl007_neg.py",
             "tl008_neg.py",
+            "tl008_paged_neg.py",
             "tl009_neg.py",
             "serving/tl010_neg.py",
             "serving/tl011_neg.py",

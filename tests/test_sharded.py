@@ -29,9 +29,14 @@ from dalle_pytorch_tpu.parallel.serving_partition import (
     decode_state_shardings,
     serving_variables_shardings,
 )
-from dalle_pytorch_tpu.serving.engine import ContinuousEngine, SampleSpec
+from dalle_pytorch_tpu.serving.engine import (
+    ContinuousEngine,
+    PagedContinuousEngine,
+    SampleSpec,
+)
 from dalle_pytorch_tpu.serving.sharded import (
     ShardedContinuousEngine,
+    ShardedPagedContinuousEngine,
     build_serving_mesh,
     parse_mesh_shape,
 )
@@ -307,6 +312,143 @@ class TestShardedParity:
             server.shutdown(drain=False)
 
 
+# ---------------------------------------------------- paged sharded engine
+
+
+@pytest.fixture(scope="module", params=[None, "int8"], ids=["bf16", "int8"])
+def paged_pair(request):
+    """(single-device paged, sharded paged tp=2) over ONE set of weights,
+    both resume-enabled, parametrized over the KV dtype: the parity and
+    resume contracts must hold for the int8 pool too — and they hold
+    BITWISE, because both engines run the identical quantize/dequant code
+    on the identical values; the mesh only splits the head axis."""
+    model = _model()
+    params = _params(model)
+    kw = dict(
+        model=model, variables=params, max_batch=4, chunk_tokens=8,
+        prefill_batch=2, page_size=4, resume_enabled=True,
+        kv_dtype=request.param,
+    )
+    cont = PagedContinuousEngine(registry=MetricsRegistry(), **kw)
+    shard = ShardedPagedContinuousEngine(
+        registry=MetricsRegistry(), mesh=build_serving_mesh({"tp": 2}), **kw,
+    )
+    return cont, shard
+
+
+class TestShardedPagedEngine:
+    def test_pool_heads_sharded_pages_whole(self, paged_pair):
+        """The physical page pool splits over heads (each device holds
+        its heads' slice of EVERY page) — the page axis stays whole so
+        the host page table keeps addressing pages globally. int8 scale
+        sidecars follow their payload's head split."""
+        _, shard = paged_pair
+        attn = shard._state["cache"]["layer_0"]["attn"]
+        assert attn["k"].sharding.spec == P(None, "tp")
+        assert len({s.device for s in attn["k"].addressable_shards}) == 2
+        if "k_scale" in attn:
+            assert attn["k_scale"].sharding.spec == P(None, "tp")
+            assert attn["v_scale"].sharding.spec == P(None, "tp")
+        assert shard._state["img_pos"].sharding.spec == P()
+
+    def test_bit_identical_tokens_incl_midflight_admission(self, paged_pair):
+        """The paged acceptance pin: same specs/seeds through the
+        single-device and tp=2 paged engines — heterogeneous sampling
+        params plus a mid-flight admission (a prefix-cache HIT, all rows
+        share a prompt) — produce bit-identical tokens."""
+        cont, shard = paged_pair
+        first = [spec(7, 1.0, 0.9), spec(11, 0.7, 0.95), spec(13, 1.3, 0.8)]
+        late = spec(17, 0.9, 0.85)
+        results = []
+        for e in (cont, shard):
+            for i, s in enumerate(first):
+                e.prefill_slot(i, s)
+            e.step_chunk()  # rows mid-flight...
+            e.prefill_slot(3, late)  # ...when the late row is admitted
+            _drain(e)
+            results.append(e.harvest([0, 1, 2, 3]))
+            e.release([0, 1, 2, 3])
+        assert np.array_equal(results[0], results[1])
+
+    def test_resume_at_position_bit_identical_and_leak_free(self, paged_pair):
+        """Preempt at a chunk boundary, release the pages, resume the
+        prefix on the SHARDED engine via the pinned resume program —
+        final tokens equal the single-device engine's uninterrupted
+        decode, and the page pool leaks nothing."""
+        cont, shard = paged_pair
+        specs = [spec(21, 0.8, 0.9), spec(23, 1.1, 0.85)]
+        for i, s in enumerate(specs):
+            cont.prefill_slot(i, s)
+        _drain(cont)
+        ref = cont.harvest([0, 1])
+        cont.release([0, 1])
+
+        for i, s in enumerate(specs):
+            shard.prefill_slot(i, s)
+        pos, _ = shard.step_chunk()  # one chunk: mid-decode
+        prefix = shard.snapshot_rows([0, 1])
+        cut = [int(pos[i]) for i in (0, 1)]
+        assert all(0 < c < IMG_SEQ for c in cut)
+        shard.release([0, 1])  # preemption returns the pages
+
+        resumed = [
+            (i, SampleSpec(
+                s.text_ids, seed=s.seed, temperature=s.temperature,
+                top_k=s.top_k, resume_tokens=prefix[i, :cut[i]].copy(),
+                resume_pos=cut[i],
+            ))
+            for i, s in enumerate(specs)
+        ]
+        shard.resume_slots(resumed)
+        _drain(shard)
+        got = shard.harvest([0, 1])
+        shard.release([0, 1])
+        np.testing.assert_array_equal(got, ref)
+        assert shard.kv.leak_check() == []
+
+
+@pytest.mark.slow  # fresh resume-enabled sharded engine = its own compiles
+class TestShardedSlottedResume:
+    def test_resume_at_position_bit_identical(self, engines):
+        """Slot-layout sharded resume: preempt mid-decode, resume at
+        position on a fresh resume-enabled tp=2 engine — tokens equal
+        the single-device uninterrupted decode. (The fast tier pins
+        sharded at-position resume on the PAGED engine; this slotted
+        variant rides the slow tier to protect the tier-1 budget.)"""
+        cont, _ = engines
+        specs = [spec(31, 0.9, 0.9), spec(33, 1.2, 0.85)]
+        for i, s in enumerate(specs):
+            cont.prefill_slot(i, s)
+        _drain(cont)
+        ref = cont.harvest([0, 1])
+        cont.release([0, 1])
+
+        shard = ShardedContinuousEngine(
+            model=cont.model, variables=cont.variables, max_batch=4,
+            chunk_tokens=8, registry=MetricsRegistry(),
+            mesh=build_serving_mesh({"tp": 2}), resume_enabled=True,
+        )
+        for i, s in enumerate(specs):
+            shard.prefill_slot(i, s)
+        pos, _ = shard.step_chunk()
+        prefix = shard.snapshot_rows([0, 1])
+        cut = [int(pos[i]) for i in (0, 1)]
+        assert all(0 < c < IMG_SEQ for c in cut)
+        shard.release([0, 1])
+        shard.resume_slots([
+            (i, SampleSpec(
+                s.text_ids, seed=s.seed, temperature=s.temperature,
+                top_k=s.top_k, resume_tokens=prefix[i, :cut[i]].copy(),
+                resume_pos=cut[i],
+            ))
+            for i, s in enumerate(specs)
+        ])
+        _drain(shard)
+        got = shard.harvest([0, 1])
+        shard.release([0, 1])
+        np.testing.assert_array_equal(got, ref)
+
+
 # ------------------------------------------------------------ slow tier
 
 
@@ -368,3 +510,54 @@ class TestShardedWarmServer:
             _drain(e)
             results.append(e.harvest([0]))
         assert np.array_equal(results[0], results[1])
+
+
+@pytest.mark.slow  # full warmup of the sharded PAGED program ladder
+class TestShardedPagedWarmServer:
+    def test_warm_sharded_paged_cycle_compiles_nothing(self):
+        """Post-warmup sharded PAGED serve cycle — admit(miss) -> chunk
+        -> mid-flight admit(hit) -> harvest -> pixels -> release ->
+        preempt -> resume — compiles ZERO programs: every program in the
+        paged ladder is re-jitted with out_shardings pinned, so the
+        donated state's sharding is a fixed point of every dispatch."""
+        from dalle_pytorch_tpu.models.dvae import DiscreteVAE
+        from dalle_pytorch_tpu.utils.compile_guard import assert_no_recompiles
+
+        model = _model(num_image_tokens=64)
+        params = _params(model)
+        vae = DiscreteVAE(
+            image_size=4 * FMAP, num_layers=2, num_tokens=64,
+            codebook_dim=32, hidden_dim=16,
+        )
+        vae_params = jax.jit(vae.init)(
+            jax.random.PRNGKey(1), jnp.zeros((1, 4 * FMAP, 4 * FMAP, 3))
+        )["params"]
+        engine = ShardedPagedContinuousEngine(
+            model=model, variables=params, vae=vae, vae_params=vae_params,
+            max_batch=4, chunk_tokens=8, prefill_batch=2, page_size=4,
+            resume_enabled=True, registry=MetricsRegistry(),
+            mesh=build_serving_mesh({"tp": 2}),
+        )
+        engine.warmup()
+        with assert_no_recompiles():
+            engine.prefill_slots([(0, spec(3)), (1, spec(4))])
+            engine.step_chunk()
+            engine.prefill_slot(2, spec(5))  # mid-flight prefix HIT
+            _drain(engine)
+            toks = engine.harvest([0, 1, 2])
+            engine.decode_pixels(toks)
+            engine.release([0, 1, 2])
+            assert engine.last_admission_stats["prefix_hits"] >= 1
+            # preempt -> resume inside the same warm window
+            engine.prefill_slot(3, spec(9))
+            pos, _ = engine.step_chunk()
+            prefix = engine.snapshot_rows([3])
+            cut = int(pos[3])
+            engine.release([3])
+            engine.resume_slots([(3, SampleSpec(
+                spec(9).text_ids, seed=9,
+                resume_tokens=prefix[0, :cut].copy(), resume_pos=cut,
+            ))])
+            _drain(engine)
+            engine.release([3])
+        assert engine.kv.leak_check() == []
