@@ -10,6 +10,8 @@ Usage (CLI is also installed as `dalle-tpu-lint`):
     python -m dalle_pytorch_tpu.analysis --rules TL013,TL014  # alias
     python -m dalle_pytorch_tpu.analysis --exclude-rules TL016
     python -m dalle_pytorch_tpu.analysis --watch              # incremental
+    python -m dalle_pytorch_tpu.analysis --changed            # vs HEAD
+    python -m dalle_pytorch_tpu.analysis --changed main       # vs a ref
     python -m dalle_pytorch_tpu.analysis --write-baseline     # grandfather
 
 Exit codes are a severity bitmask: 0 clean, bit 0 (1) new error-tier
@@ -67,6 +69,44 @@ def iter_python_files(paths: Sequence[Path]) -> List[Tuple[Path, str]]:
         else:
             raise FileNotFoundError(f"no such file or directory: {p}")
     return files
+
+
+def changed_python_files(ref: str = "HEAD") -> List[Path]:
+    """Python files changed vs `ref` (committed, staged, or unstaged)
+    plus untracked ones — the `--changed` pre-commit surface. Paths come
+    back repo-root-anchored so the lint works from any subdirectory.
+    Raises RuntimeError when git is unavailable, the cwd is not a work
+    tree, or `ref` does not resolve."""
+    import subprocess
+
+    def git(*argv: str) -> str:
+        try:
+            proc = subprocess.run(
+                ["git", *argv], capture_output=True, text=True
+            )
+        except OSError as exc:
+            raise RuntimeError(f"git unavailable: {exc}")
+        if proc.returncode != 0:
+            detail = proc.stderr.strip().splitlines()
+            raise RuntimeError(
+                detail[-1] if detail else f"git {argv[0]} failed"
+            )
+        return proc.stdout
+
+    top = Path(git("rev-parse", "--show-toplevel").strip())
+    names = set(
+        git(
+            "diff", "--name-only", "--diff-filter=d", ref, "--", "*.py"
+        ).splitlines()
+    )
+    names.update(
+        git(
+            "ls-files", "--others", "--exclude-standard", "--", "*.py"
+        ).splitlines()
+    )
+    return sorted(
+        top / n for n in names if n and (top / n).is_file()
+    )
 
 
 def _display_path(path: Path) -> str:
@@ -333,6 +373,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="mtime poll interval for --watch (default 0.5s)",
     )
     parser.add_argument(
+        "--changed", nargs="?", const="HEAD", default=None, metavar="REF",
+        help="lint only python files changed vs REF (default HEAD) plus "
+        "untracked ones — the pre-commit surface; exits 0 when nothing "
+        "changed",
+    )
+    parser.add_argument(
         "--baseline", type=Path, default=None,
         help=f"baseline file (default: {DEFAULT_BASELINE} when linting the package)",
     )
@@ -355,6 +401,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     paths = args.paths or [PACKAGE_DIR]
+    if args.changed is not None:
+        if args.paths:
+            print(
+                "tracelint: --changed and explicit paths don't compose",
+                file=sys.stderr,
+            )
+            return 2
+        try:
+            paths = changed_python_files(args.changed)
+        except RuntimeError as exc:
+            print(f"tracelint: --changed: {exc}", file=sys.stderr)
+            return 2
+        if not paths:
+            print(
+                f"tracelint: no python files changed vs {args.changed}"
+            )
+            return 0
     known = {r.code for r in ALL_RULES} | {"TL000"}
     select = None
     if args.select:

@@ -108,6 +108,34 @@ TL016  blocking call under a lock in `serving/` or `obs/`:
        or an engine dispatch inside a `with <lock>:` body — the
        head-of-line-blocking shape the batcher's dispatch-lock timing
        deliberately avoids (it releases the lock around dispatch).
+TL017  mesh-aware jit program without pinned `out_shardings`: a ladder
+       program registered through the serving engines' `_sharded_program`
+       cache, or a donating jit that declares `in_shardings`, must pin
+       its output shardings — unpinned, GSPMD picks the output layout
+       per dispatch, so the donated state's sharding drifts and re-keys
+       the jit cache (the silent warm-path recompile PR 8 eliminated by
+       hand; shardctx.py summaries make it machine-checked).
+TL018  donated jit argument whose declared input sharding matches NO
+       declared output sharding: XLA only reuses a donated buffer for an
+       output with the identical layout, so the donation silently
+       becomes an allocate+copy every dispatch.
+TL019  implicit hot-path reshard: a value placed under one sharding is
+       passed, inside a `# tracelint: hotloop`-reachable function, to a
+       jit program or shard_map whose declared in sharding for that
+       position differs — GSPMD inserts a resharding collective in
+       front of EVERY dispatch. Package-scope (the program may be
+       summarized in another file; summaries propagate one hop through
+       positional-identity wrappers, mirroring the jaxctx frontier).
+TL020  divisibility assumed: a `NamedSharding` built from a literal
+       axis-naming PartitionSpec with no `partition.py:_divisible`
+       fallback (or explicit `%` check) in the enclosing scope — a
+       non-dividing axis must drop to replicated (the 2-head toy model
+       on an 8-way mesh), not assume it divides.
+TL021  hot-loop sharded gather: a host read (`jax.device_get`,
+       `np.asarray`/`np.array`, float/int/bool) of a value placed under
+       a mesh-splitting sharding inside a hotloop-reachable function
+       gathers the FULL array across the mesh every chunk — host-read
+       leaves belong replicated (serving_partition's row-scalar rule).
 TL009  a `Trace.begin(...)` span whose matching `end()` is unreachable
        on the exception path: begin and end in the SAME function, every
        `end` in straight-line code — an exception between them leaks the
@@ -732,16 +760,20 @@ class ScanConstUploadRule(Rule):
         return None
 
 
-#: the 4-axis `make_mesh` vocabulary (parallel/mesh.py MESH_AXES) — kept
-#: in lockstep by tests/test_analysis.py; re-declared here because the
-#: linter must never pay a jax import (analysis/core.py docstring)
-_MAKE_MESH_AXES = ("dp", "fsdp", "tp", "sp")
-#: known mesh factories -> the axis vocabulary of the mesh they build
-_MESH_FACTORY_AXES = {
-    "make_mesh": _MAKE_MESH_AXES,
-    "build_serving_mesh": _MAKE_MESH_AXES,
-    "make_pp_mesh": ("pp",),
-}
+# the mesh-axis vocabulary tables and resolution helpers moved to
+# shardctx.py (the sharding-dataflow engine TL017-TL021 run on) so TL008
+# and the sharding summaries can never drift apart; re-exported here
+# because tests/test_analysis.py pins the vocabulary through this module
+from dalle_pytorch_tpu.analysis.shardctx import (  # noqa: E402
+    _MAKE_MESH_AXES,
+    _MESH_FACTORY_AXES,
+    iter_hot_calls,
+    literal_mesh_axes,
+    mesh_axis_bindings,
+    package_summaries,
+    shard_index,
+    specs_differ,
+)
 
 #: paged decode kernels whose operand order is (q, k_pages, v_pages, ...):
 #: when `shard_map` wraps one (directly or through `functools.partial`),
@@ -824,47 +856,14 @@ class MeshAxisRule(Rule):
                     f"unit; shard the head axis (position 1) instead",
                 )
 
+    # mesh resolution lives in shardctx.py (shared with TL017-TL021's
+    # sharding summaries); these wrappers keep the rule's seam names
     @staticmethod
     def _literal_axes(call: ast.Call) -> Optional[Set[str]]:
-        """Axis vocabulary of a mesh-constructing call: a literal
-        `Mesh(devs, ("a", "b"))` / `Mesh(..., axis_names=(...))`, or one
-        of the repo's known factories. None = unresolvable (silent)."""
-        fname = terminal_name(call.func)
-        if fname in _MESH_FACTORY_AXES:
-            return set(_MESH_FACTORY_AXES[fname])
-        if fname != "Mesh":
-            return None
-        cands = []
-        if len(call.args) >= 2:
-            cands.append(call.args[1])
-        cands.extend(
-            kw.value for kw in call.keywords if kw.arg == "axis_names"
-        )
-        for cand in cands:
-            if isinstance(cand, (ast.Tuple, ast.List)) and cand.elts and all(
-                isinstance(e, ast.Constant) and isinstance(e.value, str)
-                for e in cand.elts
-            ):
-                return {e.value for e in cand.elts}
-        return None
+        return literal_mesh_axes(call)
 
     def _mesh_bindings(self, tree: ast.Module) -> Dict[str, Set[str]]:
-        """name -> union of axis vocabularies it was ever bound to (a
-        name rebound to different meshes unions rather than guesses —
-        conservative toward silence)."""
-        axes_of: Dict[str, Set[str]] = {}
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Assign) or not isinstance(
-                node.value, ast.Call
-            ):
-                continue
-            axes = self._literal_axes(node.value)
-            if axes is None:
-                continue
-            for t in node.targets:
-                for n in _assign_targets(t):
-                    axes_of.setdefault(n.id, set()).update(axes)
-        return axes_of
+        return mesh_axis_bindings(tree)
 
     def _resolve_mesh(self, expr, axes_of) -> Optional[Set[str]]:
         if isinstance(expr, ast.Name):
@@ -1731,6 +1730,277 @@ class BlockingUnderLockRule(Rule):
             yield from scan(stmt, frozenset())
 
 
+# --------------------------------------------------------------------------
+# TL017-TL021: sharding & donation dataflow (analysis/shardctx.py).
+# The zero-compile serving contract rests on sharding invariants no test
+# sees until they break at scale: every ladder program's out_shardings
+# must be a fixed point of the donated decode state, donation must never
+# silently degrade to allocate+copy, and no hot-path dispatch may
+# introduce an implicit reshard. These rules read the per-file ShardIndex
+# (mesh bindings, placements, program summaries, the hotloop frontier)
+# and compare SpecRefs with three-valued `specs_differ` — UNKNOWN is
+# always clean, per the pack's false-negative bias.
+
+
+class OutShardingsPinRule(Rule):
+    code = "TL017"
+    name = "unpinned-ladder-sharding"
+    description = (
+        "mesh-aware jit program without pinned out_shardings: a program "
+        "registered through the `_sharded_program` ladder cache, or a "
+        "donating jit that declares in_shardings, must pin out_shardings "
+        "— unpinned, GSPMD may hand back a drifted output sharding that "
+        "re-keys the jit cache on the next dispatch (a silent warm-path "
+        "recompile) or re-lays-out the donated state every cycle"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        idx = shard_index(ctx)
+        for prog in idx.programs:
+            if prog.kind != "jit" or prog.has_out:
+                continue
+            if prog.registered:
+                yield ctx.finding(
+                    self.code, prog.node,
+                    f"ladder program {prog.name!r} is registered via "
+                    "_sharded_program without out_shardings= — pin it to "
+                    "the canonical state shardings so the donated "
+                    "state's sharding is a fixed point from dispatch one "
+                    "(the warm server's zero-recompile contract)",
+                )
+            elif prog.has_in and prog.donated:
+                yield ctx.finding(
+                    self.code, prog.node,
+                    f"jit program {prog.name!r} declares in_shardings "
+                    "and donates argument(s) "
+                    f"{sorted(prog.donated)} but pins no out_shardings "
+                    "— GSPMD chooses the output layout per dispatch, so "
+                    "the donated buffer's sharding can drift and re-key "
+                    "the jit cache (warm-path recompile)",
+                )
+
+
+class DonationShardingMismatchRule(Rule):
+    code = "TL018"
+    name = "donation-sharding-mismatch"
+    description = (
+        "donated jit argument whose declared input sharding matches NO "
+        "declared output sharding: XLA can only reuse the donated buffer "
+        "for an output with the identical layout, so the donation "
+        "silently degrades to an allocate+copy on every dispatch"
+    )
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        idx = shard_index(ctx)
+        for prog in idx.programs:
+            if prog.kind != "jit" or not prog.donated:
+                continue
+            if not prog.has_in or not prog.has_out:
+                continue
+            outs = prog.out_spec_candidates()
+            if not outs:
+                continue
+            for k in prog.donated:
+                in_ref = prog.in_spec_at(k)
+                if in_ref is None:
+                    continue
+                verdicts = [specs_differ(in_ref, o) for o in outs]
+                if verdicts and all(v is True for v in verdicts):
+                    yield ctx.finding(
+                        self.code, prog.node,
+                        f"program {prog.name!r} donates argument {k} "
+                        f"placed as {in_ref.render()}, but every "
+                        "declared output sharding differs "
+                        f"({', '.join(o.render() for o in outs)}) — the "
+                        "donated buffer cannot be reused, so donation "
+                        "becomes an allocate+copy each dispatch",
+                    )
+
+
+class ImplicitReshardRule(Rule):
+    code = "TL019"
+    name = "hotloop-implicit-reshard"
+    description = (
+        "a value placed under one sharding is passed, on a `# tracelint: "
+        "hotloop`-reachable path, to a jit program or shard_map whose "
+        "declared in sharding for that position differs — GSPMD inserts "
+        "a resharding collective in front of EVERY dispatch (an implicit "
+        "all-to-all per token). Package-scope: the program may be "
+        "summarized in another file."
+    )
+    package_scope = True
+
+    def check_package(self, contexts, package) -> Iterator[Finding]:
+        summaries = package_summaries(contexts)
+        for ctx in contexts:
+            idx = shard_index(ctx)
+            if not idx.hot:
+                continue
+            placements_of: Dict[int, Dict] = {}
+            for func, call in iter_hot_calls(idx):
+                name = terminal_name(call.func)
+                entry = summaries.get(name or "")
+                if entry is None:
+                    continue
+                prog, _owner = entry
+                if not prog.has_in:
+                    continue
+                if id(func) not in placements_of:
+                    placements_of[id(func)] = idx.local_placements(func)
+                placements = placements_of[id(func)]
+                for i, arg in enumerate(call.args):
+                    sym = dotted_name(arg)
+                    if sym is None or sym not in placements:
+                        continue
+                    have = placements[sym]
+                    want = prog.in_spec_at(i)
+                    if specs_differ(have, want) is True:
+                        yield ctx.finding(
+                            self.code, call,
+                            f"hot-path dispatch reshards `{sym}`: placed "
+                            f"as {have.render()} but {prog.kind} program "
+                            f"{prog.name!r} declares "
+                            f"{want.render()} for argument {i} — GSPMD "
+                            "inserts a resharding collective on every "
+                            "dispatch; place the value under the "
+                            "program's sharding once, outside the loop",
+                        )
+
+
+class DivisibilityFallbackRule(Rule):
+    code = "TL020"
+    name = "divisibility-assumed"
+    description = (
+        "NamedSharding built from a literal axis-naming PartitionSpec "
+        "with no `partition.py:_divisible` fallback (or explicit `%` "
+        "divisibility check) anywhere in the enclosing function — an "
+        "axis that does not divide the dimension must drop to replicated "
+        "(the 2-head toy model on an 8-way mesh), not assume it divides"
+    )
+
+    @staticmethod
+    def _guarded(scope_nodes) -> bool:
+        """Does the scope call `_divisible` (any dotted terminal) or
+        compute a `%` anywhere (divisibility assert/cadence guard)?"""
+        for node in scope_nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and terminal_name(
+                    sub.func
+                ) == "_divisible":
+                    return True
+                if isinstance(sub, ast.BinOp) and isinstance(
+                    sub.op, ast.Mod
+                ):
+                    return True
+        return False
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        from dalle_pytorch_tpu.analysis.shardctx import spec_ref_of
+
+        # enclosing def chain per NamedSharding call (module body when
+        # the call sits at top level)
+        stack: List[ast.AST] = []
+        hits: List[Tuple[ast.Call, Tuple[ast.AST, ...]]] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, ast.Call) and terminal_name(
+                node.func
+            ) == "NamedSharding":
+                hits.append((node, tuple(stack)))
+            is_func = isinstance(node, _ALL_FUNCS)
+            if is_func:
+                stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_func:
+                stack.pop()
+
+        visit(ctx.tree)
+        for call, chain in hits:
+            ref = spec_ref_of(call)
+            if ref is None or ref.kind != "literal":
+                continue
+            axes = ref.named_axes()
+            if not axes:
+                continue
+            scope = chain if chain else (ctx.tree,)
+            if self._guarded(scope):
+                continue
+            yield ctx.finding(
+                self.code, call,
+                f"NamedSharding names axis(es) {sorted(axes)} with no "
+                "`_divisible` fallback (or `%` divisibility check) in "
+                "the enclosing scope — a non-dividing dimension should "
+                "drop to replicated, not error or shard unevenly; route "
+                "the spec through partition.py:_divisible",
+            )
+
+
+#: host-read builtins whose argument leaves the device wholesale
+_HOST_READ_BUILTINS = {"float", "int", "bool"}
+
+
+class ShardedHostReadRule(Rule):
+    code = "TL021"
+    name = "hotloop-sharded-gather"
+    description = (
+        "host read (`jax.device_get`, `np.asarray`/`np.array`, "
+        "float/int/bool) of a value placed under a mesh-splitting "
+        "sharding inside a `# tracelint: hotloop`-reachable function — "
+        "the read gathers the FULL array across the mesh every chunk; "
+        "read a replicated leaf, or snapshot at chunk boundaries only"
+    )
+
+    @staticmethod
+    def _read_target(call: ast.Call) -> Optional[ast.AST]:
+        fname = terminal_name(call.func)
+        if fname == "device_get" and call.args:
+            return call.args[0]
+        if _is_np_call(call, ("asarray", "array")) and call.args:
+            return call.args[0]
+        if (
+            isinstance(call.func, ast.Name)
+            and call.func.id in _HOST_READ_BUILTINS
+            and len(call.args) == 1
+        ):
+            return call.args[0]
+        return None
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        idx = shard_index(ctx)
+        if not idx.hot:
+            return
+        placements_of: Dict[int, Dict] = {}
+        for func, call in iter_hot_calls(idx):
+            target = self._read_target(call)
+            if target is None:
+                continue
+            # unwrap one indexing layer: np.asarray(state.row[rows]) is
+            # still a host read of the sharded leaf
+            if isinstance(target, ast.Subscript):
+                target = target.value
+            sym = dotted_name(target)
+            if sym is None:
+                continue
+            if id(func) not in placements_of:
+                placements_of[id(func)] = idx.local_placements(func)
+            ref = placements_of[id(func)].get(sym)
+            if ref is None or ref.kind != "literal":
+                continue
+            axes = ref.named_axes()
+            if not axes:
+                continue
+            yield ctx.finding(
+                self.code, call,
+                f"hot-loop host read of `{sym}`, placed under "
+                f"{ref.render()} (split over {sorted(axes)}) — this "
+                "gathers the full array across the mesh on every "
+                "iteration; keep host-read leaves replicated (the "
+                "serving_partition row-scalar rule) or read at chunk "
+                "boundaries only",
+            )
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -1748,4 +2018,9 @@ ALL_RULES: Tuple[Rule, ...] = (
     IterateWhileMutatedRule(),
     LockOrderRule(),
     BlockingUnderLockRule(),
+    OutShardingsPinRule(),
+    DonationShardingMismatchRule(),
+    ImplicitReshardRule(),
+    DivisibilityFallbackRule(),
+    ShardedHostReadRule(),
 )

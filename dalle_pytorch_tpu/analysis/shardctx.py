@@ -1,0 +1,634 @@
+"""Sharding-awareness for tracelint: where data LIVES — which mesh a
+program runs over, which spec each placed value carries, which jit
+programs pin which shardings, and which host functions sit on the
+latency-critical (`# tracelint: hotloop`) frontier.
+
+`jaxctx.py` answers "does this run under tracing"; this module answers
+the orthogonal question the mesh-sharded serving stack depends on:
+"under WHICH sharding". Everything is a HEURISTIC over the AST, per-file
+plus one hop, with the pack's usual false-negative bias — when a mesh, a
+spec, or a program cannot be resolved, the consumer rules stay silent.
+
+Resolved constructs (the idioms this codebase actually uses):
+
+  mesh constructions
+    * literal `Mesh(devs, ("a", "b"))` / `Mesh(..., axis_names=(...))`
+    * the repo's factories: `make_mesh` / `build_serving_mesh` (the
+      4-axis dp/fsdp/tp/sp vocabulary) and `make_pp_mesh` (("pp",)) —
+      the same table TL008 resolves against (the vocabulary constants
+      live HERE; rules.py re-exports them for the lockstep test)
+    * `self.mesh = build_serving_mesh(...)`-style attribute binds
+
+  placements (symbol -> SpecRef)
+    * `x = jax.device_put(v, NamedSharding(mesh, P("tp")))` — literal
+    * `x = jax.device_put(v, self._state_shardings)` — symbolic
+    * `s = NamedSharding(mesh, P(...))` spec handles, reused by name
+    * `self.attr = ...` forms of all of the above (class-level registry)
+
+  program summaries (one per `jax.jit`/`pjit`/`shard_map` call)
+    * donated positional indices (jaxctx `_donate_from_jit_call`)
+    * `in_shardings`/`out_shardings` (jit) and `in_specs`/`out_specs`
+      (shard_map) parsed to SpecRefs — positionally when a tuple/list
+      literal, broadcast when a single expression
+    * mesh identity: the normalized mesh expression (`self.mesh`,
+      `mesh`) read off the first NamedSharding/shard_map mesh operand
+    * the registration name when the call sits inside this repo's
+      `*._sharded_program("name", ...)` pinned-program cache idiom, else
+      the name it is assigned to, else the wrapped callable's name
+    * ONE-HOP propagation: a def whose body just returns a summarized
+      program applied to its own parameters in positional order exports
+      that summary under its own name — call sites in other files see
+      through the wrapper, mirroring the jaxctx frontier (one hop, no
+      fixpoint)
+
+  hot frontier
+    * functions marked `# tracelint: hotloop`, plus (one hop) same-file
+      defs whose EVERY call site sits inside a marked function — the
+      "hotloop-reachable path" TL019/TL021 police
+
+SpecRef comparison semantics (`specs_differ`) are deliberately
+three-valued: two literal specs compare by value (trailing-None
+normalized, the jax equivalence), two identical symbols compare equal,
+and every mixed or unresolved pairing is UNKNOWN — consumer rules treat
+UNKNOWN as clean. A lint must earn trust before it earns strictness.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from dalle_pytorch_tpu.analysis.jaxctx import (
+    FunctionNode,
+    _assign_targets,
+    _donate_from_jit_call,
+    dotted_name,
+    terminal_name,
+)
+
+_ALL_FUNCS = FunctionNode + (ast.Lambda,)
+_JIT_NAMES = {"jit", "pjit"}
+
+#: the 4-axis `make_mesh` vocabulary (parallel/mesh.py MESH_AXES) — kept
+#: in lockstep by tests/test_analysis.py; re-declared here because the
+#: linter must never pay a jax import (analysis/core.py docstring)
+_MAKE_MESH_AXES = ("dp", "fsdp", "tp", "sp")
+#: known mesh factories -> the axis vocabulary of the mesh they build
+_MESH_FACTORY_AXES = {
+    "make_mesh": _MAKE_MESH_AXES,
+    "build_serving_mesh": _MAKE_MESH_AXES,
+    "make_pp_mesh": ("pp",),
+}
+
+
+def walk_shallow(func: ast.AST) -> Iterator[ast.AST]:
+    """Pre-order walk of a function body WITHOUT descending into nested
+    function defs (they get their own analysis pass)."""
+
+    def rec(node: ast.AST) -> Iterator[ast.AST]:
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if not isinstance(child, _ALL_FUNCS):
+                yield from rec(child)
+
+    return rec(func)
+
+
+# ----------------------------------------------------------------- SpecRef
+
+
+@dataclass(frozen=True)
+class SpecRef:
+    """A resolved-or-symbolic sharding reference.
+
+    kind "literal": `axes` holds the PartitionSpec entries — per-dim
+    axis name (str), None, or a tuple of axis names — with trailing
+    Nones stripped (jax's `P("tp")` == `P("tp", None)` equivalence).
+    kind "symbol": `symbol` holds the normalized source expression
+    (`self._state_shardings`) — equal symbols are the SAME handle, so
+    comparisons against an identical symbol resolve; everything else
+    about a symbol is opaque.
+    """
+
+    kind: str  # "literal" | "symbol"
+    axes: Tuple = ()
+    symbol: str = ""
+
+    @property
+    def replicated(self) -> bool:
+        return self.kind == "literal" and not self.named_axes()
+
+    def named_axes(self) -> Set[str]:
+        out: Set[str] = set()
+        for entry in self.axes:
+            if isinstance(entry, str):
+                out.add(entry)
+            elif isinstance(entry, tuple):
+                out.update(entry)
+        return out
+
+    def render(self) -> str:
+        if self.kind == "symbol":
+            return self.symbol
+        inner = ", ".join(
+            repr(e) if not isinstance(e, tuple) else repr(tuple(e))
+            for e in self.axes
+        )
+        return f"P({inner})"
+
+
+def _spec_entries(call: ast.Call) -> Optional[Tuple]:
+    """`P("tp")` / `PartitionSpec(None, ("dp", "fsdp"))` -> entry tuple,
+    or None when any entry is not a literal."""
+    entries: List = []
+    if call.keywords:
+        return None
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and (
+            arg.value is None or isinstance(arg.value, str)
+        ):
+            entries.append(arg.value)
+        elif isinstance(arg, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in arg.elts
+        ):
+            entries.append(tuple(e.value for e in arg.elts))
+        else:
+            return None
+    while entries and entries[-1] is None:
+        entries.pop()
+    return tuple(entries)
+
+
+def spec_ref_of(expr: Optional[ast.AST]) -> Optional[SpecRef]:
+    """Best-effort SpecRef for an expression standing where a sharding
+    (or a bare PartitionSpec) is expected. None = unresolvable."""
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Call):
+        fname = terminal_name(expr.func)
+        if fname in ("P", "PartitionSpec"):
+            entries = _spec_entries(expr)
+            return None if entries is None else SpecRef("literal", entries)
+        if fname == "NamedSharding":
+            spec_expr = (
+                expr.args[1]
+                if len(expr.args) >= 2
+                else next(
+                    (kw.value for kw in expr.keywords if kw.arg == "spec"),
+                    None,
+                )
+            )
+            return spec_ref_of(spec_expr)
+        if fname == "_replicated_sharding" and not expr.args:
+            # the mixin's NamedSharding(self.mesh, P()) helper
+            return SpecRef("literal", ())
+        return None
+    dotted = dotted_name(expr)
+    if dotted is not None:
+        return SpecRef("symbol", symbol=dotted)
+    return None
+
+
+def specs_differ(a: Optional[SpecRef], b: Optional[SpecRef]) -> Optional[bool]:
+    """Three-valued spec comparison: True = provably different, False =
+    provably the same placement, None = unknown (consumers stay silent)."""
+    if a is None or b is None:
+        return None
+    if a.kind == "literal" and b.kind == "literal":
+        return a.axes != b.axes
+    if a.kind == "symbol" and b.kind == "symbol":
+        # identical handles are the same placement; DIFFERENT symbols may
+        # still alias the same shardings — unknown, not a finding
+        return False if a.symbol == b.symbol else None
+    return None
+
+
+def mesh_expr_name(expr: Optional[ast.AST]) -> Optional[str]:
+    """Normalized identity of a mesh operand (`self.mesh`, `mesh`)."""
+    if expr is None:
+        return None
+    return dotted_name(expr)
+
+
+# ----------------------------------------------------------- mesh resolve
+
+
+def literal_mesh_axes(call: ast.Call) -> Optional[Set[str]]:
+    """Axis vocabulary of a mesh-constructing call: a literal
+    `Mesh(devs, ("a", "b"))` / `Mesh(..., axis_names=(...))`, or one of
+    the repo's known factories. None = unresolvable (silent)."""
+    fname = terminal_name(call.func)
+    if fname in _MESH_FACTORY_AXES:
+        return set(_MESH_FACTORY_AXES[fname])
+    if fname != "Mesh":
+        return None
+    cands = []
+    if len(call.args) >= 2:
+        cands.append(call.args[1])
+    cands.extend(kw.value for kw in call.keywords if kw.arg == "axis_names")
+    for cand in cands:
+        if isinstance(cand, (ast.Tuple, ast.List)) and cand.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in cand.elts
+        ):
+            return {e.value for e in cand.elts}
+    return None
+
+
+def mesh_axis_bindings(tree: ast.Module) -> Dict[str, Set[str]]:
+    """symbol (`mesh`, `self.mesh`) -> union of axis vocabularies it was
+    ever bound to (a name rebound to different meshes unions rather than
+    guesses — conservative toward silence)."""
+    axes_of: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+            node.value, ast.Call
+        ):
+            continue
+        axes = literal_mesh_axes(node.value)
+        if axes is None:
+            continue
+        for t in node.targets:
+            for n in _assign_targets(t):
+                axes_of.setdefault(n.id, set()).update(axes)
+            dotted = dotted_name(t)
+            if dotted is not None and "." in dotted:
+                axes_of.setdefault(dotted, set()).update(axes)
+    return axes_of
+
+
+# ------------------------------------------------------- program summaries
+
+
+def _sharding_list(expr: Optional[ast.AST]):
+    """An `in_shardings=`/`out_shardings=`/`in_specs=` operand -> either
+    a tuple of per-position Optional[SpecRef] (tuple/list literal) or a
+    single broadcast Optional[SpecRef]."""
+    if expr is None:
+        return None
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return tuple(spec_ref_of(e) for e in expr.elts)
+    return spec_ref_of(expr)
+
+
+def _first_mesh_operand(call: ast.Call) -> Optional[str]:
+    """Mesh identity of a jit/shard_map call: the `mesh=` kwarg
+    (shard_map) or the mesh operand of the first NamedSharding among its
+    sharding kwargs."""
+    for kw in call.keywords:
+        if kw.arg == "mesh":
+            return mesh_expr_name(kw.value)
+    for kw in call.keywords:
+        if kw.arg not in ("in_shardings", "out_shardings"):
+            continue
+        for node in ast.walk(kw.value):
+            if isinstance(node, ast.Call) and terminal_name(
+                node.func
+            ) == "NamedSharding":
+                mesh = (
+                    node.args[0]
+                    if node.args
+                    else next(
+                        (k.value for k in node.keywords if k.arg == "mesh"),
+                        None,
+                    )
+                )
+                name = mesh_expr_name(mesh)
+                if name is not None:
+                    return name
+    return None
+
+
+@dataclass
+class ProgramSummary:
+    """One jitted (or shard_map-wrapped) program's sharding contract."""
+
+    name: str
+    node: ast.Call  # the jit/pjit/shard_map call
+    kind: str  # "jit" | "shard_map"
+    donated: Tuple[int, ...] = ()
+    #: tuple of per-position Optional[SpecRef], a single broadcast
+    #: SpecRef, or None when the kwarg is absent
+    in_shardings: object = None
+    out_shardings: object = None
+    has_in: bool = False
+    has_out: bool = False
+    mesh: Optional[str] = None
+    #: registered through `*._sharded_program("name", ...)` — the
+    #: serving engines' pinned-program cache, i.e. a LADDER program
+    registered: bool = False
+
+    def in_spec_at(self, pos: int) -> Optional[SpecRef]:
+        if isinstance(self.in_shardings, tuple):
+            if 0 <= pos < len(self.in_shardings):
+                return self.in_shardings[pos]
+            return None
+        return self.in_shardings  # broadcast or None
+
+    def out_spec_candidates(self) -> Optional[List[SpecRef]]:
+        """The resolvable output placements (flattened one level). None
+        when out_shardings is absent or nothing resolved."""
+        if not self.has_out:
+            return None
+        refs = (
+            list(self.out_shardings)
+            if isinstance(self.out_shardings, tuple)
+            else [self.out_shardings]
+        )
+        resolved = [r for r in refs if r is not None]
+        return resolved or None
+
+
+class ShardIndex:
+    """Per-file sharding index, built once and memoized on the
+    FileContext (`_shard_index`, mirroring `_jax_index`)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        tree = ctx.tree
+        self.mesh_axes: Dict[str, Set[str]] = mesh_axis_bindings(tree)
+        #: symbol -> SpecRef of the sharding it was placed under /
+        #: bound to: `x = jax.device_put(v, S)`, `s = NamedSharding(...)`,
+        #: and the `self.attr = ...` forms
+        self.placements: Dict[str, SpecRef] = {}
+        self.programs: List[ProgramSummary] = []
+        #: name -> summary (first binding wins; rebinding a program name
+        #: to a second program would make lookups guesses)
+        self.by_name: Dict[str, ProgramSummary] = {}
+        #: hot frontier: `# tracelint: hotloop`-marked defs plus one-hop
+        #: same-file defs called ONLY from marked defs
+        self.hot: List[ast.AST] = []
+        self._collect_placements(tree)
+        self._collect_programs(tree)
+        self._propagate_wrappers(tree)
+        self._collect_hot(tree)
+
+    # ------------------------------------------------------------ builders
+
+    @staticmethod
+    def _placement_ref(value: ast.AST) -> Optional[SpecRef]:
+        if not isinstance(value, ast.Call):
+            return None
+        fname = terminal_name(value.func)
+        if fname == "device_put":
+            sharding = (
+                value.args[1]
+                if len(value.args) >= 2
+                else next(
+                    (
+                        kw.value
+                        for kw in value.keywords
+                        if kw.arg in ("device", "sharding")
+                    ),
+                    None,
+                )
+            )
+            return spec_ref_of(sharding)
+        if fname == "NamedSharding":
+            return spec_ref_of(value)
+        return None
+
+    def _collect_placements(self, tree: ast.Module) -> None:
+        """File-level registry: dotted symbols (`self._cache`) from
+        anywhere, plain names from module level only — a plain local in
+        one function must not leak a placement into another function's
+        analysis."""
+        module_level = set(id(s) for s in tree.body)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            ref = self._placement_ref(node.value)
+            if ref is None:
+                continue
+            for t in node.targets:
+                dotted = dotted_name(t)
+                if dotted is None:
+                    continue
+                if "." in dotted or id(node) in module_level:
+                    self.placements[dotted] = ref
+
+    def _collect_programs(self, tree: ast.Module) -> None:
+        """Recursive visit carrying the enclosing `_sharded_program`
+        registration name and assignment target, so each jit/shard_map
+        call lands in a summary under its best available name."""
+
+        def reg_name(call: ast.Call) -> Optional[str]:
+            if (
+                isinstance(call.func, ast.Attribute)
+                and call.func.attr == "_sharded_program"
+                and call.args
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)
+            ):
+                return call.args[0].value
+            return None
+
+        def visit(node: ast.AST, registrar: Optional[str],
+                  assigned: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_reg, child_asn = registrar, assigned
+                if isinstance(child, ast.Assign):
+                    names = [
+                        dotted_name(t)
+                        for t in child.targets
+                        if dotted_name(t) is not None
+                    ]
+                    child_asn = names[0] if names else None
+                if isinstance(child, ast.Call):
+                    name = reg_name(child)
+                    if name is not None:
+                        child_reg = name
+                    fname = terminal_name(child.func)
+                    if fname in _JIT_NAMES:
+                        self._summarize(child, "jit", child_reg, child_asn)
+                    elif fname == "shard_map":
+                        self._summarize(child, "shard_map", child_reg,
+                                        child_asn)
+                visit(child, child_reg, child_asn)
+
+        visit(tree, None, None)
+
+    def _summarize(self, call: ast.Call, kind: str,
+                   registrar: Optional[str], assigned: Optional[str]) -> None:
+        wrapped = call.args[0] if call.args else None
+        func = wrapped if isinstance(wrapped, _ALL_FUNCS) else None
+        name = (
+            registrar
+            or assigned
+            or (terminal_name(wrapped) if wrapped is not None else None)
+            or "<anonymous>"
+        )
+        if kind == "jit":
+            in_kw = next(
+                (kw.value for kw in call.keywords
+                 if kw.arg == "in_shardings"), None
+            )
+            out_kw = next(
+                (kw.value for kw in call.keywords
+                 if kw.arg == "out_shardings"), None
+            )
+            donated = _donate_from_jit_call(call, func)
+        else:
+            in_kw = next(
+                (kw.value for kw in call.keywords if kw.arg == "in_specs"),
+                None,
+            )
+            out_kw = next(
+                (kw.value for kw in call.keywords if kw.arg == "out_specs"),
+                None,
+            )
+            donated = ()
+        summary = ProgramSummary(
+            name=name,
+            node=call,
+            kind=kind,
+            donated=tuple(donated),
+            in_shardings=_sharding_list(in_kw),
+            out_shardings=_sharding_list(out_kw),
+            has_in=in_kw is not None,
+            has_out=out_kw is not None,
+            mesh=_first_mesh_operand(call),
+            registered=registrar is not None,
+        )
+        self.programs.append(summary)
+        if name != "<anonymous>" and name not in self.by_name:
+            self.by_name[name] = summary
+
+    def _propagate_wrappers(self, tree: ast.Module) -> None:
+        """One-hop summary propagation: `def f(a, b): return prog(a, b)`
+        exports prog's summary under f's name — call sites (in this or
+        other files, via the package union) see through the wrapper.
+        Positional-identity only: a wrapper that reorders or wraps its
+        arguments would shift every spec position, so it stays opaque."""
+        for node in ast.walk(tree):
+            if not isinstance(node, FunctionNode):
+                continue
+            if node.name in self.by_name:
+                continue
+            body = [
+                s for s in node.body
+                if not isinstance(s, ast.Expr)
+                or not isinstance(s.value, ast.Constant)
+            ]
+            if len(body) != 1 or not isinstance(body[0], ast.Return):
+                continue
+            ret = body[0].value
+            if not isinstance(ret, ast.Call) or ret.keywords:
+                continue
+            callee = terminal_name(ret.func)
+            summary = self.by_name.get(callee or "")
+            if summary is None:
+                continue
+            params = [
+                p.arg
+                for p in node.args.posonlyargs + node.args.args
+                if p.arg != "self"
+            ]
+            passed = [
+                a.id if isinstance(a, ast.Name) else None for a in ret.args
+            ]
+            if passed and passed == params[: len(passed)]:
+                self.by_name[node.name] = summary
+
+    def _collect_hot(self, tree: ast.Module) -> None:
+        marked = [
+            f
+            for f in ast.walk(tree)
+            if isinstance(f, FunctionNode) and self.ctx.is_hotloop(f)
+        ]
+        self.hot = list(marked)
+        if not marked:
+            return
+        # one hop: a same-file def whose EVERY call site is inside a
+        # marked function is hotloop-reachable itself (no fixpoint —
+        # mirrors the jaxctx frontier depth argument)
+        defs: Dict[str, ast.AST] = {
+            f.name: f for f in ast.walk(tree) if isinstance(f, FunctionNode)
+        }
+        enclosing: Dict[int, Optional[ast.AST]] = {}
+
+        def visit(node: ast.AST, owner: Optional[ast.AST]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.Call):
+                    enclosing[id(child)] = owner
+                visit(
+                    child,
+                    child if isinstance(child, _ALL_FUNCS) else owner,
+                )
+
+        visit(tree, None)
+        sites: Dict[str, List[ast.Call]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = terminal_name(node.func)
+                if name in defs:
+                    sites.setdefault(name, []).append(node)
+        marked_set = set(id(f) for f in marked)
+        for name, calls in sites.items():
+            func = defs[name]
+            if id(func) in marked_set:
+                continue
+            owners = [enclosing.get(id(c)) for c in calls]
+            if owners and all(
+                o is not None and id(o) in marked_set for o in owners
+            ):
+                self.hot.append(func)
+
+    # ------------------------------------------------------------- queries
+
+    def local_placements(self, func: ast.AST) -> Dict[str, SpecRef]:
+        """The file-level placement map extended with `func`-local
+        `x = jax.device_put(v, S)` binds and one aliasing pass
+        (`y = x` where x is placed)."""
+        out = dict(self.placements)
+        for node in walk_shallow(func):
+            if isinstance(node, ast.Assign):
+                ref = self._placement_ref(node.value)
+                if ref is None:
+                    alias = dotted_name(node.value)
+                    if alias is not None and alias in out:
+                        ref = out[alias]
+                if ref is None:
+                    continue
+                for t in node.targets:
+                    dotted = dotted_name(t)
+                    if dotted is not None:
+                        out[dotted] = ref
+        return out
+
+
+def shard_index(ctx) -> ShardIndex:
+    """One sharding index per file, memoized on the FileContext (the
+    watch-mode AST cache keeps it warm across incremental runs)."""
+    idx = getattr(ctx, "_shard_index", None)
+    if idx is None:
+        idx = ShardIndex(ctx)
+        ctx._shard_index = idx
+    return idx
+
+
+def package_summaries(
+    contexts: Sequence,
+) -> Dict[str, Tuple[ProgramSummary, object]]:
+    """Union of every file's named program summaries: name ->
+    (summary, owning FileContext). First binding wins on collisions —
+    a name meaning two different programs in two files is ambiguous, and
+    ambiguity must not become findings."""
+    out: Dict[str, Tuple[ProgramSummary, object]] = {}
+    for ctx in contexts:
+        idx = shard_index(ctx)
+        for name, summary in idx.by_name.items():
+            out.setdefault(name, (summary, ctx))
+    return out
+
+
+def iter_hot_calls(
+    idx: ShardIndex,
+) -> Iterator[Tuple[ast.AST, ast.Call]]:
+    """(hot function, call inside it) pairs, skipping nested defs."""
+    for func in idx.hot:
+        for node in walk_shallow(func):
+            if isinstance(node, ast.Call):
+                yield func, node

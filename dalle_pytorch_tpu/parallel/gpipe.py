@@ -45,9 +45,18 @@ def make_pp_mesh(pp: int, devices=None) -> Mesh:
 
 def stage_params_sharding(mesh: Mesh, params):
     """Shardings placing depth-stacked [P*L, ...] leaves over the pp axis
-    (leading axis split across stages)."""
+    (leading axis split across stages). Routed through the same
+    divisibility fallback as every other placement (tracelint TL020): a
+    leaf whose leading dim does not divide by pp replicates instead of
+    sharding unevenly — unreachable for the [P, L, ...] stacks
+    `gpipe_apply` reshapes, but callers can hand arbitrary pytrees."""
+    from dalle_pytorch_tpu.parallel.partition import _divisible
+
     return jax.tree.map(
-        lambda _: NamedSharding(mesh, P("pp")), params
+        lambda leaf: NamedSharding(
+            mesh, _divisible(P("pp"), leaf.shape, mesh)
+        ),
+        params,
     )
 
 

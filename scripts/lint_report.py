@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""One-line JSON tracelint report for dashboards and CI log scraping.
+
+Runs the full rule pack (or --select'ed codes) over the package (or
+explicit paths) and prints a SINGLE json line:
+
+    {"files": 74, "findings": 0, "suppressed": 10, "baselined": 0,
+     "rc": 0, "per_rule": {"TL001": 0, ..., "TL021": 0},
+     "suppressed_per_rule": {"TL002": 9, ...}}
+
+`per_rule` carries EVERY registered rule code (zeros included) so a
+rule silently dropping out of the pack shows up as a missing key in
+diffs, not as an indistinguishable zero. Exit code is the usual
+tracelint severity bitmask (0 clean, 1 errors, 4 warning-tier, 5 both).
+
+    python scripts/lint_report.py
+    python scripts/lint_report.py --select TL017,TL018,TL019,TL020,TL021
+    python scripts/lint_report.py dalle_pytorch_tpu/serving/
+"""
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from dalle_pytorch_tpu.analysis.lint import (  # noqa: E402
+    PACKAGE_DIR,
+    exit_code,
+    lint_paths,
+)
+from dalle_pytorch_tpu.analysis.rules import ALL_RULES  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("paths", nargs="*", type=Path)
+    parser.add_argument(
+        "--select", default=None, metavar="TLxxx[,TLxxx...]",
+        help="restrict to these rule codes",
+    )
+    args = parser.parse_args(argv)
+
+    select = None
+    if args.select:
+        select = {c.strip() for c in args.select.split(",") if c.strip()}
+        known = {r.code for r in ALL_RULES} | {"TL000"}
+        unknown = select - known
+        if unknown:
+            print(f"unknown rule code(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    try:
+        result = lint_paths(args.paths or [PACKAGE_DIR], select=select)
+    except FileNotFoundError as exc:
+        print(f"lint_report: {exc}", file=sys.stderr)
+        return 2
+
+    codes = sorted(
+        r.code for r in ALL_RULES if select is None or r.code in select
+    )
+    per_rule = {code: 0 for code in codes}
+    for f in result.findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    suppressed_per_rule: dict = {}
+    for f, _sup in result.suppressed:
+        suppressed_per_rule[f.rule] = suppressed_per_rule.get(f.rule, 0) + 1
+
+    rc = exit_code(result)
+    print(json.dumps({
+        "files": result.files_checked,
+        "findings": len(result.findings),
+        "suppressed": len(result.suppressed),
+        "baselined": len(result.baselined),
+        "rc": rc,
+        "per_rule": per_rule,
+        "suppressed_per_rule": dict(sorted(suppressed_per_rule.items())),
+    }, sort_keys=False))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

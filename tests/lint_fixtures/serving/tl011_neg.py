@@ -32,5 +32,10 @@ class ShardedLike:
     def _chunk_op(self, s):
         # the sharded-engine memo: the jit is an argument of a
         # ladder-named call
-        fn = self._sharded_program("chunk", lambda: jax.jit(lambda v: v))
+        fn = self._sharded_program(
+            "chunk",
+            lambda: jax.jit(
+                lambda v: v, out_shardings=self._state_shardings
+            ),
+        )
         return fn(s)

@@ -22,5 +22,8 @@ sharded = shard_map(
     out_specs=P("data", "tensor"),  # "tensor" is nobody's axis
 )
 
+# divisibility asserted so TL020 stays out: this fixture pins TL008 only
+assert ROWS % 8 == 0  # noqa: F821
+
 # the classic rename drift: "model" misspelled survives until trace time
 sharding = NamedSharding(mesh, P("modle"))

@@ -1,0 +1,50 @@
+"""TL017 negatives: pinned ladder programs and out-of-scope jits."""
+
+import jax
+
+
+class ShardedEngine:
+    def _chunk_op(self, s):
+        fn = self._sharded_program(
+            "chunk",
+            lambda: jax.jit(  # pinned: the donated state's fixed point
+                self._chunk_builder(),
+                donate_argnums=(1,),
+                out_shardings=self._state_shardings,
+            ),
+        )
+        return fn(self.variables, s)
+
+    def _prefill_op(self, s, texts):
+        fn = self._sharded_program(
+            "prefill",
+            lambda: jax.jit(  # pytree-prefix pin (state, sidecar)
+                self._prefill_builder(),
+                donate_argnums=(1,),
+                out_shardings=(
+                    self._state_shardings, self._replicated_sharding(),
+                ),
+            ),
+        )
+        return fn(self.variables, s, texts)
+
+
+def plain_single_device(fn):
+    # no mesh awareness at all: the single-device engines donate without
+    # in/out shardings and stay out of scope
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+def pinned_with_in(fn, state_shardings):
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(state_shardings,),
+        out_shardings=state_shardings,
+    )
+
+
+def in_without_donation(fn, sharding):
+    # nothing donated: no buffer whose layout can drift out from under
+    # the caller, out of scope
+    return jax.jit(fn, in_shardings=(sharding,))

@@ -1,0 +1,38 @@
+"""TL017 positives: mesh-aware jit programs without pinned out_shardings.
+
+Never executed — parsed by tests/test_shardlint.py only.
+"""
+
+import jax
+
+
+class ShardedEngine:
+    def _chunk_op(self, s):
+        fn = self._sharded_program(
+            "chunk",
+            lambda: jax.jit(  # TL017: ladder program, no out_shardings pin
+                self._chunk_builder(),
+                donate_argnums=(1,),
+            ),
+        )
+        return fn(self.variables, s)
+
+    def _release_op(self, s, mask):
+        fn = self._sharded_program(
+            "release",
+            lambda: jax.jit(  # TL017: ladder program, no out_shardings pin
+                self._release_builder(),
+                donate_argnums=(0,),
+            ),
+        )
+        return fn(s, mask)
+
+
+def make_step(fn, state_shardings):
+    # TL017: declares where inputs live and donates, but lets GSPMD pick
+    # the output layout per dispatch
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(state_shardings,),
+    )

@@ -1,0 +1,54 @@
+"""TL018 negatives: fixed-point donations and unresolvable specs."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def fixed_point(fn):
+    # in == out: the donated buffer is reused in place
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(P(None, "tp"),),
+        out_shardings=P(None, "tp"),
+    )
+
+
+def same_symbol(fn, state_shardings):
+    # both sides are the same name: trivially the same placement
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(state_shardings,),
+        out_shardings=state_shardings,
+    )
+
+
+def symbol_vs_literal(fn, state_shardings):
+    # one side is opaque: UNKNOWN, the lint stays silent
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(state_shardings,),
+        out_shardings=P("dp"),
+    )
+
+
+def one_output_absorbs(fn):
+    # some output slot matches the donated input: the buffer has a home
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(P("dp"),),
+        out_shardings=(P("tp"), P("dp")),
+    )
+
+
+def trailing_none_equivalent(fn):
+    # P("tp", None) and P("tp") are the same placement
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(P("tp", None),),
+        out_shardings=P("tp"),
+    )

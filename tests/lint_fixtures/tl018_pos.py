@@ -1,0 +1,38 @@
+"""TL018 positives: donated inputs whose pinned output sharding differs.
+
+Never executed — parsed by tests/test_shardlint.py only.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def resharded_state(fn):
+    # TL018: state comes in split over tp, leaves replicated — the donated
+    # buffer cannot be reused and XLA inserts a collective every step
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(P(None, "tp"),),
+        out_shardings=P(),
+    )
+
+
+def second_arg_migrates(fn):
+    # TL018: arg 1 is donated under dp but every output lands on tp
+    return jax.jit(
+        fn,
+        donate_argnums=(1,),
+        in_shardings=(P(), P("dp")),
+        out_shardings=(P(), P("tp")),
+    )
+
+
+def no_output_matches(fn):
+    # TL018: neither output slot can absorb the tp-sharded donation
+    return jax.jit(
+        fn,
+        donate_argnums=(0,),
+        in_shardings=(P("tp"),),
+        out_shardings=(P(), P(None, "tp")),
+    )

@@ -1,0 +1,53 @@
+"""TL019 positives: hot-path values placed under one spec, consumed under
+another.
+
+Never executed — parsed by tests/test_shardlint.py only.
+"""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from dalle_pytorch_tpu.parallel.mesh import make_mesh, shard_map
+
+
+def _impl(x):
+    return x
+
+
+def _k(rows):
+    return rows
+
+
+mesh = make_mesh()
+
+run_tp = jax.jit(
+    _impl,
+    in_shardings=(P(None, "tp"),),
+    out_shardings=P(None, "tp"),
+)
+
+kernel = shard_map(_k, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"))
+
+STATE = jax.device_put(init(), P(None, "tp"))  # noqa: F821
+
+
+# tracelint: hotloop
+def step(batch):
+    x = jax.device_put(batch, P("dp"))
+    return run_tp(x)  # TL019: placed dp, program wants (None, tp)
+
+
+# tracelint: hotloop
+def scatter(rows):
+    y = jax.device_put(rows, P(None, "tp"))
+    return kernel(y)  # TL019: placed (None, tp), shard_map wants dp
+
+
+def _drain():
+    return kernel(STATE)  # TL019: module placement (None, tp) vs dp
+
+
+# tracelint: hotloop
+def hot_outer():
+    while more():  # noqa: F821
+        _drain()

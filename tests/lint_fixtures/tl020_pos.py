@@ -1,0 +1,26 @@
+"""TL020 positives: named-axis placements with no divisibility fallback.
+
+Never executed — parsed by tests/test_shardlint.py only.
+"""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+GLOBAL_MESH = build_mesh()  # noqa: F821
+
+
+def params_shardings(mesh, params):
+    # TL020: assumes every leading dim divides the tp axis size
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, P(None, "tp")),
+        params,
+    )
+
+
+def place_batch(mesh, x):
+    # TL020: dp-sized batches only; a ragged tail batch fails to commit
+    return jax.device_put(x, NamedSharding(mesh, P("dp")))
+
+
+# TL020: module-level placement, same assumption
+SHARDING = NamedSharding(GLOBAL_MESH, P("fsdp"))

@@ -1,0 +1,32 @@
+"""TL021 negatives: replicated leaves, cold paths, and unknown placements."""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+STATE = jax.device_put(build(), P(None, "tp"))  # noqa: F821
+REPLICATED = jax.device_put(ready(), P())  # noqa: F821
+OPAQUE = jax.device_put(thing(), host_shardings)  # noqa: F821
+
+
+# tracelint: hotloop
+def replicated_read():
+    # every device holds the full value: the read is shard-local
+    return np.asarray(REPLICATED)
+
+
+# tracelint: hotloop
+def unknown_placement():
+    # symbolic sharding: UNKNOWN, the lint stays silent
+    return np.asarray(OPAQUE)
+
+
+def cold_snapshot():
+    # not hotloop-reachable: a one-off debug gather is fine
+    return np.asarray(STATE)
+
+
+# tracelint: hotloop
+def unplaced(batch):
+    # no recorded placement for `batch`: nothing to flag
+    return np.asarray(batch)

@@ -1,0 +1,30 @@
+"""TL021 positives: host reads of mesh-sharded leaves inside hot loops.
+
+Never executed — parsed by tests/test_shardlint.py only.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+STATE = jax.device_put(build(), P(None, "tp"))  # noqa: F821
+COUNTS = jax.device_put(zeros(), P("dp"))  # noqa: F821
+
+
+# tracelint: hotloop
+def snapshot():
+    # TL021: materializes the tp-sharded state on host every call
+    return np.asarray(STATE)
+
+
+# tracelint: hotloop
+def histogram():
+    local = COUNTS
+    # TL021: np.array gathers the dp-sharded counters
+    return np.array(local)
+
+
+# tracelint: hotloop
+def first_logit():
+    # TL021: scalar read forces a cross-device gather of the tp shards
+    return float(STATE[0])

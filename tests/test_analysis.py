@@ -293,6 +293,7 @@ class TestRuleCorpus:
             from dalle_pytorch_tpu.parallel.mesh import make_mesh
 
             m = make_mesh(tp=2)
+            assert DIM % 2 == 0  # divisibility asserted: keeps TL020 out
             bad = NamedSharding(m, P("model"))
             also_bad = NamedSharding(
                 Mesh(np.asarray(jax.devices()), ("x",)), P("y")

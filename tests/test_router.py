@@ -193,6 +193,19 @@ class FakeClock:
         self.t += float(s)
 
 
+def _wait_for(cond, deadline_s=10.0, interval_s=0.005):
+    """Deadline-poll a predicate instead of sleeping a fixed interval —
+    the drain tests need "requests are in flight NOW", and a flat
+    sleep(0.1) is both flaky under CPU contention (threads not yet
+    dispatched) and slack on fast machines."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval_s)
+    return cond()
+
+
 def _fleet(n=2, clock=None, **kw):
     stubs = [StubReplica(f"r{i}") for i in range(n)]
     kw.setdefault("probe_interval_s", 0.5)
@@ -739,7 +752,11 @@ class TestDrainUnderLoad:
             ]
             for t in threads:
                 t.start()
-            time.sleep(0.1)  # requests are in flight on both replicas
+            # requests are in flight on both replicas: each stub counts
+            # the hit on arrival, then holds the request for delay_s
+            assert _wait_for(
+                lambda: stubs[0].hits >= 1 and stubs[1].hits >= 1
+            ), "requests never reached both replicas"
             detail = router.drain("r0", wait_s=5.0)
             assert detail["mode"] == "drained"
             assert detail["outstanding_rows"] == 0
@@ -1048,7 +1065,13 @@ class TestChaosRealReplicas:
             ]
             for t in threads:
                 t.start()
-            time.sleep(0.1)
+            # drain only once the burst is actually being served: rows
+            # outstanding somewhere, or (fast machines) already finished
+            assert _wait_for(
+                lambda: len(statuses) > 0
+                or sum(r.outstanding_rows for r in router.replicas) > 0,
+                deadline_s=30.0,
+            ), "burst never reached the fleet"
             detail = router.drain("r1", wait_s=30.0, propagate=True)
             assert detail["mode"] == "drained"
             for t in threads:

@@ -1,0 +1,497 @@
+"""Shardlint (TL017–TL021): rule corpus, summary resolution, gates.
+
+Layout mirrors tests/test_analysis.py — every rule has a positive
+fixture that must fire EXACTLY (count and code) and a negative fixture
+that must stay silent; shardctx's resolution machinery (mesh factories,
+spec comparison, program summaries, wrapper propagation, the hot
+frontier) is unit-tested directly on source strings; and the two
+acceptance gates at the bottom pin the PR's contract: the shipped
+package is clean under TL017–TL021, and unpinning a single
+out_shardings= in serving/sharded.py is caught by TL017.
+"""
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from dalle_pytorch_tpu.analysis.baseline import load_baseline, write_baseline
+from dalle_pytorch_tpu.analysis.core import FileContext
+from dalle_pytorch_tpu.analysis.lint import (
+    PACKAGE_DIR,
+    changed_python_files,
+    lint_paths,
+    main,
+)
+from dalle_pytorch_tpu.analysis.shardctx import (
+    SpecRef,
+    literal_mesh_axes,
+    mesh_axis_bindings,
+    package_summaries,
+    shard_index,
+    spec_ref_of,
+    specs_differ,
+)
+
+FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
+SHARD_CODES = {"TL017", "TL018", "TL019", "TL020", "TL021"}
+
+
+def codes(result):
+    return [f.rule for f in result.findings]
+
+
+def ctx_of(source, name="mod.py"):
+    src = textwrap.dedent(source)
+    return FileContext(Path(name), name, src, stable_path=name)
+
+
+def index_of(source):
+    return shard_index(ctx_of(source))
+
+
+def parse_expr(source):
+    import ast
+
+    return ast.parse(textwrap.dedent(source), mode="eval").body
+
+
+# -------------------------------------------------------------- rule corpus
+
+
+class TestShardRuleCorpus:
+    """Positive fixtures fire exactly (count AND code — a fixture that
+    trips a second rule is a fixture bug); negatives stay silent."""
+
+    @pytest.mark.parametrize(
+        "fixture, code, expected",
+        [
+            ("tl017_pos.py", "TL017", 3),
+            ("tl018_pos.py", "TL018", 3),
+            ("tl019_pos.py", "TL019", 3),
+            ("tl020_pos.py", "TL020", 3),
+            ("tl021_pos.py", "TL021", 3),
+        ],
+    )
+    def test_positive_fixture_caught(self, fixture, code, expected):
+        result = lint_paths([FIXTURES / fixture])
+        got = codes(result)
+        assert got.count(code) == expected, got
+        assert all(c == code for c in got), got
+
+    @pytest.mark.parametrize(
+        "fixture",
+        [
+            "tl017_neg.py",
+            "tl018_neg.py",
+            "tl019_neg.py",
+            "tl020_neg.py",
+            "tl021_neg.py",
+        ],
+    )
+    def test_negative_fixture_clean(self, fixture):
+        result = lint_paths([FIXTURES / fixture])
+        assert result.clean, "\n".join(f.render() for f in result.findings)
+
+    def test_shard_rules_are_error_tier(self):
+        """All five are zero-compile-contract violations: error tier, so
+        `rc & 1` CI gates block on them."""
+        for fixture in sorted(FIXTURES.glob("tl01[789]_pos.py")) + sorted(
+            FIXTURES.glob("tl02[01]_pos.py")
+        ):
+            result = lint_paths([fixture])
+            assert result.findings and all(
+                f.severity == "error" for f in result.findings
+            ), fixture.name
+
+
+# ------------------------------------------------------- spec resolution
+
+
+class TestSpecResolution:
+    def test_literal_spec_trailing_nones_normalized(self):
+        a = spec_ref_of(parse_expr('P("tp", None)'))
+        b = spec_ref_of(parse_expr('P("tp")'))
+        assert a == b == SpecRef("literal", ("tp",))
+
+    def test_named_sharding_unwraps_to_spec(self):
+        ref = spec_ref_of(parse_expr('NamedSharding(mesh, P(None, "tp"))'))
+        assert ref == SpecRef("literal", (None, "tp"))
+        assert ref.named_axes() == {"tp"}
+        assert not ref.replicated
+
+    def test_axis_tuple_entries(self):
+        ref = spec_ref_of(parse_expr('P(("dp", "fsdp"), "tp")'))
+        assert ref.named_axes() == {"dp", "fsdp", "tp"}
+
+    def test_replicated_and_symbol_refs(self):
+        assert spec_ref_of(parse_expr("P()")).replicated
+        assert spec_ref_of(parse_expr("self._replicated_sharding()")).replicated
+        sym = spec_ref_of(parse_expr("self._state_shardings"))
+        assert sym == SpecRef("symbol", symbol="self._state_shardings")
+
+    def test_unresolvable_specs(self):
+        assert spec_ref_of(parse_expr("P(axis)")) is None
+        assert spec_ref_of(parse_expr("make_spec()")) is None
+        assert spec_ref_of(None) is None
+
+    def test_specs_differ_is_three_valued(self):
+        tp = SpecRef("literal", ("tp",))
+        dp = SpecRef("literal", ("dp",))
+        sym = SpecRef("symbol", symbol="s")
+        other = SpecRef("symbol", symbol="t")
+        assert specs_differ(tp, dp) is True
+        assert specs_differ(tp, SpecRef("literal", ("tp",))) is False
+        assert specs_differ(sym, SpecRef("symbol", symbol="s")) is False
+        # different symbols may alias the same shardings: UNKNOWN
+        assert specs_differ(sym, other) is None
+        assert specs_differ(tp, sym) is None
+        assert specs_differ(None, tp) is None
+
+
+class TestMeshResolution:
+    def test_literal_mesh_and_factories(self):
+        assert literal_mesh_axes(
+            parse_expr('Mesh(devs, ("dp", "tp"))')
+        ) == {"dp", "tp"}
+        assert literal_mesh_axes(
+            parse_expr('Mesh(devs, axis_names=("pp",))')
+        ) == {"pp"}
+        assert literal_mesh_axes(parse_expr("make_mesh()")) == {
+            "dp", "fsdp", "tp", "sp",
+        }
+        assert literal_mesh_axes(parse_expr("make_pp_mesh(4)")) == {"pp"}
+        assert literal_mesh_axes(parse_expr("Mesh(devs, names)")) is None
+        assert literal_mesh_axes(parse_expr("weird_factory()")) is None
+
+    def test_bindings_cover_attributes_and_rebinds(self):
+        ctx = ctx_of(
+            """
+            mesh = make_pp_mesh(2)
+            mesh = Mesh(devs, ("dp",))
+
+            class S:
+                def __init__(self):
+                    self.mesh = build_serving_mesh(1, 1)
+            """
+        )
+        axes = mesh_axis_bindings(ctx.tree)
+        # rebinding unions rather than guessing which bind is live
+        assert axes["mesh"] == {"pp", "dp"}
+        assert axes["self.mesh"] == {"dp", "fsdp", "tp", "sp"}
+
+
+# ---------------------------------------------------- program summaries
+
+
+class TestProgramSummaries:
+    def test_registered_ladder_program(self):
+        idx = index_of(
+            """
+            import jax
+
+            class E:
+                def _op(self, s):
+                    fn = self._sharded_program(
+                        "chunk",
+                        lambda: jax.jit(
+                            self._builder(),
+                            donate_argnums=(1,),
+                            out_shardings=self._state_shardings,
+                        ),
+                    )
+                    return fn(self.variables, s)
+            """
+        )
+        prog = idx.by_name["chunk"]
+        assert prog.registered and prog.kind == "jit"
+        assert prog.donated == (1,)
+        assert prog.has_out and not prog.has_in
+        # the fixed-point pin resolves symbolically
+        cands = prog.out_spec_candidates()
+        assert [c.symbol for c in cands] == ["self._state_shardings"]
+
+    def test_unpinned_program_has_no_out(self):
+        idx = index_of(
+            """
+            import jax
+            step = jax.jit(impl, donate_argnums=(0,))
+            """
+        )
+        prog = idx.by_name["step"]
+        assert not prog.has_out
+        assert prog.out_spec_candidates() is None
+
+    def test_in_spec_positions_and_broadcast(self):
+        idx = index_of(
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            a = jax.jit(f, in_shardings=(P("dp"), P()), out_shardings=P())
+            b = jax.jit(g, in_shardings=P("tp"), out_shardings=P("tp"))
+            """
+        )
+        a, b = idx.by_name["a"], idx.by_name["b"]
+        assert a.in_spec_at(0) == SpecRef("literal", ("dp",))
+        assert a.in_spec_at(1).replicated
+        assert a.in_spec_at(7) is None  # out of range, not broadcast
+        # a single (non-tuple) expression broadcasts over every position
+        assert b.in_spec_at(0) == b.in_spec_at(3) == SpecRef(
+            "literal", ("tp",)
+        )
+
+    def test_shard_map_specs_and_mesh_identity(self):
+        idx = index_of(
+            """
+            from jax.sharding import PartitionSpec as P
+            k = shard_map(f, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"))
+            """
+        )
+        prog = idx.by_name["k"]
+        assert prog.kind == "shard_map" and prog.mesh == "mesh"
+        assert prog.in_spec_at(0) == SpecRef("literal", ("dp",))
+
+    def test_wrapper_propagation_is_positional_identity_only(self):
+        idx = index_of(
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            prog = jax.jit(impl, in_shardings=(P("dp"),), out_shardings=P("dp"))
+
+            def run(x):
+                return prog(x)
+
+            def shuffled(x, y):
+                return prog(y, x)
+            """
+        )
+        # the identity wrapper exports prog's summary under its own name
+        assert idx.by_name["run"] is idx.by_name["prog"]
+        # reordering args would shift spec positions: stays opaque
+        assert "shuffled" not in idx.by_name
+
+    def test_first_binding_wins_on_name_collisions(self):
+        idx = index_of(
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            p = jax.jit(f, in_shardings=(P("dp"),), out_shardings=P("dp"))
+            p = jax.jit(g, in_shardings=(P("tp"),), out_shardings=P("tp"))
+            """
+        )
+        assert idx.by_name["p"].in_spec_at(0) == SpecRef("literal", ("dp",))
+        assert len(idx.programs) == 2
+
+    def test_package_summaries_cross_file_union(self):
+        a = ctx_of(
+            """
+            import jax
+            from jax.sharding import PartitionSpec as P
+            run = jax.jit(f, in_shardings=(P("dp"),), out_shardings=P("dp"))
+            """,
+            name="a.py",
+        )
+        b = ctx_of("x = 1\n", name="b.py")
+        union = package_summaries([a, b])
+        summary, owner = union["run"]
+        assert summary.in_spec_at(0) == SpecRef("literal", ("dp",))
+        assert owner is a
+
+
+class TestHotFrontier:
+    SRC = """
+        # tracelint: hotloop
+        def hot():
+            helper()
+            shared()
+
+        def helper():
+            return 1
+
+        def cold():
+            shared()
+
+        def shared():
+            return 2
+        """
+
+    def test_one_hop_requires_every_call_site_hot(self):
+        idx = index_of(self.SRC)
+        names = {f.name for f in idx.hot}
+        # helper is called ONLY from hot() -> hotloop-reachable;
+        # shared() is also called from cold() -> stays out
+        assert names == {"hot", "helper"}
+
+
+# ------------------------------------------- suppression + baseline drift
+
+
+class TestSuppressionAndBaseline:
+    SRC = (
+        "import jax\n"
+        "step = jax.jit(  # tracelint: disable=TL017 -- output layout is "
+        "probed once at startup\n"
+        "    impl,\n"
+        "    donate_argnums=(0,),\n"
+        "    in_shardings=(state_sh,),\n"
+        ")\n"
+    )
+
+    def test_suppression_with_reason_is_honored(self, tmp_path):
+        f = tmp_path / "sup.py"
+        f.write_text(self.SRC)
+        result = lint_paths([f])
+        assert result.clean
+        assert [s.reason for _, s in result.suppressed] == [
+            "output layout is probed once at startup"
+        ]
+
+    def test_baseline_survives_line_drift(self, tmp_path):
+        """Grandfathered shardlint findings stay grandfathered when code
+        moves above them (fingerprints key on content, not lines)."""
+        f = tmp_path / "drift.py"
+        f.write_text((FIXTURES / "tl018_pos.py").read_text())
+        bl = tmp_path / "bl.json"
+        first = lint_paths([f])
+        assert codes(first) == ["TL018"] * 3
+        write_baseline(bl, first.findings)
+
+        f.write_text("'''moved'''\nX = 1\n\n" + f.read_text())
+        again = lint_paths([f], baseline_fingerprints=load_baseline(bl))
+        assert again.clean
+        assert len(again.baselined) == 3
+
+
+# ------------------------------------------------------------- the gates
+
+
+def test_package_shardlint_gate():
+    """Acceptance criterion: the shipped package has ZERO TL017–TL021
+    findings (the broader all-rules gate lives in test_analysis.py)."""
+    result = lint_paths([PACKAGE_DIR], select=set(SHARD_CODES))
+    assert result.clean, "package findings:\n" + "\n".join(
+        f.render() for f in result.findings
+    )
+
+
+def test_seeded_mutation_unpinned_ladder_is_caught(tmp_path):
+    """Regression for the PR's seeded mutation: deleting a single
+    `out_shardings=self._state_shardings` pin from serving/sharded.py
+    must produce a TL017 finding (and the unmutated file stays clean)."""
+    src = (PACKAGE_DIR / "serving" / "sharded.py").read_text()
+    pin = "out_shardings=self._state_shardings,\n"
+    assert pin in src, "sharded.py lost its ladder-pin idiom"
+
+    pristine = tmp_path / "sharded_pristine.py"
+    pristine.write_text(src)
+    assert lint_paths([pristine], select={"TL017"}).clean
+
+    mutated = tmp_path / "sharded_mutated.py"
+    mutated.write_text(src.replace(pin, "", 1))
+    result = lint_paths([mutated], select={"TL017"})
+    assert codes(result) == ["TL017"], codes(result)
+
+
+# ---------------------------------------------------------------- --watch
+
+
+def test_tl019_stays_correct_through_watch_cache(tmp_path):
+    """TL019 is package-scope: its findings are never finding-cached, so
+    an edit that introduces a cross-file sharding mismatch must surface
+    on the NEXT incremental run even though the unchanged producer file
+    reuses its cached AST/ShardIndex."""
+    from dalle_pytorch_tpu.analysis.watch import LintCache
+
+    producer = tmp_path / "programs.py"
+    producer.write_text(textwrap.dedent(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        run_tp = jax.jit(
+            impl, in_shardings=(P(None, "tp"),), out_shardings=P(None, "tp")
+        )
+        """
+    ))
+    consumer = tmp_path / "loop.py"
+    ok = textwrap.dedent(
+        """
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from programs import run_tp
+
+        # tracelint: hotloop
+        def step(batch):
+            x = jax.device_put(batch, P(None, "tp"))
+            return run_tp(x)
+        """
+    )
+    consumer.write_text(ok)
+
+    cache = LintCache()
+    first = lint_paths([tmp_path], cache=cache)
+    assert first.clean
+
+    consumer.write_text(ok.replace('P(None, "tp"))', 'P("dp"))', 1))
+    second = lint_paths([tmp_path], cache=cache)
+    assert codes(second) == ["TL019"]
+    # only the edited file re-parsed; the producer's index came warm
+    assert second.cache["reparsed"] == 1
+
+    consumer.write_text(ok)
+    third = lint_paths([tmp_path], cache=cache)
+    assert third.clean
+
+
+# ------------------------------------------------------------- --changed
+
+
+class TestChangedMode:
+    def _repo(self, tmp_path, monkeypatch):
+        import subprocess
+
+        monkeypatch.chdir(tmp_path)
+        for cmd in (
+            ["git", "init", "-q"],
+            ["git", "config", "user.email", "t@t"],
+            ["git", "config", "user.name", "t"],
+        ):
+            subprocess.run(cmd, check=True, capture_output=True)
+        (tmp_path / "clean.py").write_text("X = 1\n")
+        subprocess.run(
+            ["git", "add", "-A"], check=True, capture_output=True
+        )
+        subprocess.run(
+            ["git", "commit", "-qm", "seed"], check=True, capture_output=True
+        )
+        return tmp_path
+
+    def test_changed_lints_only_touched_files(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        repo = self._repo(tmp_path, monkeypatch)
+        (repo / "clean.py").write_text("X = 2\n")  # modified, stays clean
+        (repo / "fresh.py").write_text("import ipdb\n")  # untracked TL006
+        assert changed_python_files("HEAD") == sorted(
+            [repo / "clean.py", repo / "fresh.py"]
+        )
+        assert main(["--changed"]) == 1
+        out = capsys.readouterr().out
+        assert "fresh.py" in out and "TL006" in out
+
+    def test_changed_with_nothing_touched_exits_zero(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        self._repo(tmp_path, monkeypatch)
+        assert main(["--changed"]) == 0
+        assert "no python files changed" in capsys.readouterr().out
+
+    def test_changed_rejects_bad_ref_and_explicit_paths(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        repo = self._repo(tmp_path, monkeypatch)
+        assert main(["--changed", "no-such-ref"]) == 2
+        assert "no-such-ref" in capsys.readouterr().err
+        assert main([str(repo / "clean.py"), "--changed"]) == 2
+        assert "don't compose" in capsys.readouterr().err
