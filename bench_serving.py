@@ -117,8 +117,16 @@ METRIC = "serving_rps_top_concurrency"
 UNIT = "req/s"
 
 
-def build_toy():
-    """Shared toy model/VAE weights so both engines serve identical work."""
+def build_toy(sparse=False):
+    """Shared toy model/VAE weights so both engines serve identical work.
+
+    `sparse=True` (the --decode_sparsity policy bench) gives the toy a
+    pattern to exploit: alternating full/axial_row layers, the flash
+    attention impl (sparse decode rides the flash kernel), and a KV tile
+    width small enough relative to the toy's cache (SERVE_SPARSE_BLOCK,
+    default 16) that the axial layer's dead tiles actually skip — the
+    production default DECODE_SPARSE_BLOCK=128 would be one tile on a
+    toy-sized cache and skip nothing."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -142,11 +150,20 @@ def build_toy():
         jax.random.PRNGKey(1), jnp.zeros((1, 4 * fmap, 4 * fmap, 3))
     )["params"]
 
+    sparse_kw = {}
+    if sparse:
+        sparse_kw = dict(
+            attn_types=("full", "axial_row"),
+            attn_impl="flash",
+            decode_sparse_block=int(
+                os.environ.get("SERVE_SPARSE_BLOCK", "16")
+            ),
+        )
     model = DALLE(
         dim=dim, depth=depth, heads=2, dim_head=dim // 2,
         num_image_tokens=64, image_fmap_size=fmap,
         num_text_tokens=256, text_seq_len=text_seq,
-        shift_tokens=False, rotary_emb=True,
+        shift_tokens=False, rotary_emb=True, **sparse_kw,
     )
     text = jnp.zeros((1, text_seq), jnp.int32)
     tokens = jnp.zeros((1, fmap * fmap), jnp.int32)
@@ -444,18 +461,19 @@ def _sustained_rps(batcher, text_ids, seconds=2.5, clients=16,
     return len(done) / max(time.monotonic() - t0, 1e-9)
 
 
-def _kv_quality_block(model, micro, cont, n=4):
-    """CLIP-score parity of a quantized KV cache, reported BESIDE the
+def _kv_quality_block(model, micro, cont, n=4, label="quantized"):
+    """CLIP-score parity of a degraded decode path, reported BESIDE the
     speed numbers: the same (prompt, seed) rows generate through the
     bf16 micro engine (the reference — a bf16 continuous engine is
     bit-identical to it by the composition-invariance contract) and the
-    `--kv_dtype` continuous engine, and one toy CLIP (fixed init) scores
+    continuous engine under test, and one toy CLIP (fixed init) scores
     both image sets against their prompts. `clip_delta_mean` is
-    quantized minus reference — ~0 means int8 paid no quality for its
-    ~2x capacity. Runs AFTER the measured window on already-warm
-    programs; the token-agreement fraction is reported too (int8 decode
-    is a different numerical path, so tokens MAY diverge — the CLIP
-    delta is the acceptance metric, not token identity)."""
+    `label` minus reference — ~0 means the variant paid no quality for
+    its win (int8: ~2x capacity; policy sparsity: skipped KV tiles).
+    Runs AFTER the measured window on already-warm programs; the
+    token-agreement fraction is reported too (both variants are
+    different numerical paths, so tokens MAY diverge — the CLIP delta
+    is the acceptance metric, not token identity)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -505,13 +523,14 @@ def _kv_quality_block(model, micro, cont, n=4):
             float((np.asarray(ref_toks)[:n] == q_toks[:n]).mean()), 4
         ),
         "clip_mean_ref": round(float(ref_s.mean()), 5),
-        "clip_mean_quantized": round(float(q_s.mean()), 5),
+        f"clip_mean_{label}": round(float(q_s.mean()), 5),
         "clip_delta_mean": round(float((q_s - ref_s).mean()), 5),
     }
 
 
 def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
-                   trace_export=False, kv_dtype="model"):
+                   trace_export=False, kv_dtype="model",
+                   decode_sparsity="causal"):
     import jax
     import numpy as np
 
@@ -522,6 +541,7 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
     from dalle_pytorch_tpu.training.metrics import MetricsRegistry
 
     kv_dt = None if kv_dtype in (None, "model") else str(kv_dtype)
+    sparse = decode_sparsity not in (None, "causal")
 
     # open-loop defaults use a LARGER toy than the closed-loop sweep
     # (dim 128 / depth 3 / 8x8 grid = 64 image tokens): on the tiny model
@@ -540,7 +560,7 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
     duration_s = float(os.environ.get("SERVE_OPEN_SECONDS", "10"))
     max_batch = max(shapes)
 
-    model, params, vae, vae_params, text_ids = build_toy()
+    model, params, vae, vae_params, text_ids = build_toy(sparse=sparse)
 
     micro = GenerationEngine(
         model=model, variables=params, vae=vae, vae_params=vae_params,
@@ -559,6 +579,7 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
         max_batch=max_batch, chunk_tokens=chunk_tokens,
         prefill_batch=prefill_batch, registry=MetricsRegistry(),
         kv_dtype=kv_dt,
+        decode_sparsity="policy" if sparse else "causal",
     )
     if kv_layout == "paged":
         kv_pages_env = os.environ.get("SERVE_KV_PAGES")
@@ -687,6 +708,13 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
     pf_disp0 = cont.registry.get(
         "dalle_serving_prefill_dispatches_total"
     ).value
+    # sparsity tile accounting, windowed like the prefill counters
+    tiles_read0 = cont.registry.get(
+        "dalle_serving_kv_tiles_read_total"
+    ).value
+    tiles_skip0 = cont.registry.get(
+        "dalle_serving_kv_tiles_skipped_total"
+    ).value
     cont_stages0 = _stage_snapshot(cont.registry)
     # vitals sampled over the MEASURED window only: the ring starts empty
     # here (after calibration), stops before the JSON line renders
@@ -782,12 +810,45 @@ def main_open_loop(prompt_reuse=0.0, kv_layout="slot", mesh=None,
             # can evict against a capped pool before the schedule replays
             "evictions": int(cache.evictions - evictions0),
         }
-    if kv_dt is not None:
-        # quality beside speed: the quantized cache's CLIP-score cost on
-        # the SAME (prompt, seed) rows, scored against the bf16 micro
-        # engine's output (bit-identical to a bf16 continuous engine by
-        # the composition-invariance contract)
-        cont_line["quality"] = _kv_quality_block(model, micro, cont)
+    if sparse:
+        # per-line tile accounting over the measured window: skipped > 0
+        # is the policy actually buying DMA/compute (vs length skip
+        # alone), read gives the denominator for the skip fraction
+        tiles_read = int(
+            cont.registry.get("dalle_serving_kv_tiles_read_total").value
+            - tiles_read0
+        )
+        tiles_skip = int(
+            cont.registry.get("dalle_serving_kv_tiles_skipped_total").value
+            - tiles_skip0
+        )
+        cont_line["decode_sparsity"] = "policy"
+        cont_line["kv_tiles_read"] = tiles_read
+        cont_line["kv_tiles_skipped"] = tiles_skip
+        total = tiles_read + tiles_skip
+        cont_line["kv_tile_skip_fraction"] = (
+            round(tiles_skip / total, 4) if total else None
+        )
+        sp_detail = cont.sparsity_detail() or {}
+        cont_line["sparsity"] = {
+            k: sp_detail[k]
+            for k in (
+                "block", "n_blocks", "patterned_layers",
+                "static_dead_tile_frac",
+            )
+            if k in sp_detail
+        }
+    if kv_dt is not None or sparse:
+        # quality beside speed: the degraded decode path's CLIP-score
+        # cost on the SAME (prompt, seed) rows, scored against the bf16
+        # micro engine's output (bit-identical to a bf16 continuous
+        # engine by the composition-invariance contract; the micro
+        # engine decodes patterned layers through the dense masked path,
+        # so for sparse runs it doubles as the exact-mask oracle)
+        cont_line["quality"] = _kv_quality_block(
+            model, micro, cont,
+            label="sparse" if kv_dt is None else "quantized",
+        )
     if micro_stats["rps"]:
         cont_line["rps_ratio_vs_micro"] = round(
             cont_stats["rps"] / micro_stats["rps"], 3
@@ -2080,6 +2141,17 @@ def main():
         "rows — beside kv_bytes_per_slot",
     )
     p.add_argument(
+        "--decode_sparsity", choices=("causal", "policy"),
+        default=os.environ.get("SERVE_DECODE_SPARSITY", "causal"),
+        help="open-loop: continuous-engine decode-attention sparsity; "
+        "policy builds the toy with alternating full/axial layers and "
+        "routes masked rows through the block-sparse flash kernel "
+        "(serving/sparsity.py bitmaps, SERVE_SPARSE_BLOCK tile width) — "
+        "the JSON line gains kv_tiles_read/kv_tiles_skipped/"
+        "kv_tile_skip_fraction and the toy-CLIP `quality` block vs the "
+        "dense-masked reference",
+    )
+    p.add_argument(
         "--priority_mix", type=float,
         default=(
             float(os.environ["SERVE_PRIORITY_MIX"])
@@ -2164,6 +2236,7 @@ def main():
             prompt_reuse=args.prompt_reuse, kv_layout=args.kv_layout,
             mesh=args.mesh, trace_export=args.trace_export,
             kv_dtype=args.kv_dtype,
+            decode_sparsity=args.decode_sparsity,
         )
     else:
         main_closed_loop()

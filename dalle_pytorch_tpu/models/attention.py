@@ -44,6 +44,7 @@ from dalle_pytorch_tpu.ops.pallas_attention import (
     lib_flash_attention,
 )
 from dalle_pytorch_tpu.ops.pallas_decode import (
+    block_sparse_flash_decode_attention,
     flash_decode_attention,
     paged_decode_attention,
     paged_gather,
@@ -76,6 +77,23 @@ AUTO_FLASH_MIN_SEQ = 1024
 # the average K/V reads and cuts them ~3x for a freshly-admitted
 # continuous-batching slot still at its text prefix.
 AUTO_FLASH_DECODE_MIN_LEN = 512
+
+# KV tile width for POLICY-sparse flash decode (the per-row block bitmap in
+# ops/pallas_decode.py:block_sparse_flash_decode_attention). MEASURED
+# (scripts/flash_crossover.py --sparse sweep, BASELINE.md §block-sparse):
+# the skip fraction a policy can express falls with tile width (an axial
+# row policy at the flagship cache keeps 48% of 64-wide tiles live but 60%
+# of 128-wide and 79% of 256-wide — every tile a single live position
+# touches is read whole), while the per-tile grid charge grows as tiles
+# shrink: on the v5e roofline a 32-wide sweep is SLOWER than plain
+# length-skip flash at 128. 128 is the knee: near-minimal modeled step
+# time (25.4 us vs 24.7 at 256) while capturing ~72% of the reachable
+# byte savings, and it matches `flash_decode_attention`'s default block_k
+# — so the all-ones bitmap keeps BIT-IDENTITY with the dense-causal flash
+# path (same tile boundaries, same accumulation order), the serving
+# stack's parity pin. Overridable per model (decode_sparse_block=); must
+# divide into whole pages on the paged "kernel" impl (page_size | block).
+DECODE_SPARSE_BLOCK = 128
 
 
 def _cache_write(buf: jnp.ndarray, val: jnp.ndarray, index) -> jnp.ndarray:
@@ -152,6 +170,13 @@ class Attention(nn.Module):
     # (ShardedContinuousEngine clones the model with its model_axis).
     decode_mesh: Any = None
     decode_heads_axis: str = "tp"
+    # KV tile width the decode-time block bitmap is expressed at (None =
+    # DECODE_SPARSE_BLOCK). Static model config: the serving engine clones
+    # the model with it when --decode_sparsity=policy, and the policy's
+    # host-side bitmap derivation must use the SAME width (the bitmap
+    # itself stays traced data — only this boundary is baked into the
+    # compiled program).
+    decode_sparse_block: Optional[int] = None
     dtype: Any = jnp.float32
 
     def _use_flash(self, n: int, key_mask) -> bool:
@@ -175,15 +200,21 @@ class Attention(nn.Module):
             return False
         return n >= AUTO_FLASH_MIN_SEQ
 
-    def _use_flash_decode(self, max_len: int, has_pattern: bool) -> bool:
+    def _use_flash_decode(
+        self, max_len: int, has_pattern: bool, sparse: bool = False
+    ) -> bool:
         """Cached-path dispatch: flash-decode reads only each row's live KV
         blocks (ops/pallas_decode.py); dense reads the whole cache. Pattern
         masks (static or traced) fall back to dense — a per-step row-sliced
-        mask cannot drive the kernel's block skip. `attn_impl="flash"`
-        forces the kernel; "auto" switches on cache length;
-        "dense"/"lib_flash"/"ring" stay dense (the library kernel has no
-        decode analog, and ring is a training-time layout)."""
-        if has_pattern:
+        mask cannot drive the kernel's block skip — UNLESS the cache
+        carries a policy block bitmap (`sparse`): then the pattern's
+        block-level shadow IS the skip structure, and masked rows route
+        through the block-sparse flash kernel instead of reading the whole
+        cache dense. `attn_impl="flash"` forces the kernel; "auto"
+        switches on cache length; "dense"/"lib_flash"/"ring" stay dense
+        (the library kernel has no decode analog, and ring is a
+        training-time layout)."""
+        if has_pattern and not sparse:
             return False
         if self.attn_impl == "flash":
             return True
@@ -311,11 +342,31 @@ class Attention(nn.Module):
                     cks = _scale_write(cache["k_scale"], k_sc, index)
                     cvs = _scale_write(cache["v_scale"], v_sc, index)
                 max_len = ck.shape[2]
+            # policy block bitmap ([B, nb] int32, nb = ceil(max_len /
+            # decode_sparse_block), nonzero = KV tile may be read): traced
+            # DATA riding the cache pytree (models/dalle.py threads it from
+            # the serving engine's host-side policy), so flipping or
+            # re-deriving the policy NEVER recompiles the chunk program.
+            # When present, it supersedes the pattern masks below — the
+            # engine derived it FROM those patterns (conservative
+            # block-level shadow, text prefix always live), and it unlocks
+            # the flash path for pattern-masked rows.
+            bitmap = cache.get("block_bitmap")
+            sparse = bitmap is not None
+            sparse_block = (
+                DECODE_SPARSE_BLOCK
+                if self.decode_sparse_block is None
+                else self.decode_sparse_block
+            )
+            # mirror the kernel's block_k clamp so bitmap widths agree on
+            # tiny caches (tests run seq_len << DECODE_SPARSE_BLOCK)
+            sparse_block = max(min(sparse_block, max_len), 1)
             if self._use_flash_decode(
                 max_len,
                 has_pattern=(
                     self.static_mask is not None or mask_array is not None
                 ),
+                sparse=sparse,
             ):
                 # per-row live length = cache index + this chunk; the kernel
                 # applies the same causal-over-prefix mask the dense branch
@@ -323,21 +374,32 @@ class Attention(nn.Module):
                 # (scalar index = lockstep decode: every row at one length)
                 lengths = jnp.broadcast_to(index + n, (b,)).astype(jnp.int32)
                 scales = {"k_scale": cks, "v_scale": cvs} if quant else {}
+                sparse_kw = (
+                    {"block_bitmap": bitmap, "sparse_block": sparse_block}
+                    if sparse else {}
+                )
                 if paged:
                     if self.decode_mesh is not None:
                         out = sharded_paged_decode_attention(
                             self.decode_mesh, q, ck, cv, lengths, pt,
                             max_len, head_axis=self.decode_heads_axis,
-                            **scales,
+                            **scales, **sparse_kw,
                         )
                     else:
                         out = paged_decode_attention(
-                            q, ck, cv, lengths, pt, max_len, **scales
+                            q, ck, cv, lengths, pt, max_len,
+                            **scales, **sparse_kw,
                         )
                 elif self.decode_mesh is not None:
                     out = sharded_flash_decode_attention(
                         self.decode_mesh, q, ck, cv, lengths,
-                        head_axis=self.decode_heads_axis, **scales,
+                        head_axis=self.decode_heads_axis,
+                        **scales, **sparse_kw,
+                    )
+                elif sparse:
+                    out = block_sparse_flash_decode_attention(
+                        q, ck, cv, lengths, bitmap,
+                        block_k=sparse_block, **scales,
                     )
                 else:
                     out = flash_decode_attention(q, ck, cv, lengths, **scales)
@@ -399,12 +461,20 @@ class Attention(nn.Module):
                         None, None
                     ]
 
-                if self.static_mask is not None:
-                    mask = mask & mask_rows_at(
-                        jnp.asarray(np.asarray(self.static_mask))
-                    )
-                if mask_array is not None:
-                    mask = mask & mask_rows_at(mask_array)
+                if sparse:
+                    # the bitmap supersedes the pattern masks on the dense
+                    # fallback too (small caches / attn_impl="dense"), so
+                    # BOTH decode paths compute the identical block-level
+                    # policy — the sparse-vs-dense oracle the tests pin
+                    kv_live = jnp.repeat(bitmap != 0, sparse_block, axis=1)
+                    mask = mask & kv_live[:, :max_len][:, None, None, :]
+                else:
+                    if self.static_mask is not None:
+                        mask = mask & mask_rows_at(
+                            jnp.asarray(np.asarray(self.static_mask))
+                        )
+                    if mask_array is not None:
+                        mask = mask & mask_rows_at(mask_array)
                 out = dense_attention(q, gk, gv, mask=mask, stable=self.stable)
             new_cache = {"k": ck, "v": cv, "index": index + n}
             if quant:
@@ -412,6 +482,10 @@ class Attention(nn.Module):
                 new_cache["v_scale"] = cvs
             if paged:
                 new_cache["page_table"] = pt
+            if sparse:
+                # structural round-trip: nn.scan requires carry-in/carry-out
+                # pytrees to match, so the bitmap leaf rides back out
+                new_cache["block_bitmap"] = bitmap
         else:
             if rotary is not None:
                 rot = jnp.expand_dims(rotary[:n], (0, 1))

@@ -115,6 +115,12 @@ class DALLE(nn.Module):
     # axis the engine's KV-cache shardings use
     decode_mesh: Any = None
     decode_heads_axis: str = "tp"
+    # decode-time policy-sparse KV tile width (None = DECODE_SPARSE_BLOCK,
+    # models/attention.py): the serving engine clones the model with it
+    # under --decode_sparsity=policy so kernel tile boundaries and the
+    # host-derived block bitmaps agree; the bitmaps themselves ride the
+    # cache pytree as traced data (policy flips never recompile)
+    decode_sparse_block: Optional[int] = None
     # KV-cache storage dtype for the serving/decode caches: None keeps
     # K/V at `dtype` (bit-identical legacy behavior); "int8" stores
     # quantized pages with per-(position, head) fp32 scales, dequantized
@@ -172,6 +178,7 @@ class DALLE(nn.Module):
             sp_mesh=self.sp_mesh,
             decode_mesh=self.decode_mesh,
             decode_heads_axis=self.decode_heads_axis,
+            decode_sparse_block=self.decode_sparse_block,
             executor=self.executor,
             dtype=self.dtype,
         )
@@ -922,6 +929,7 @@ def prefill_into_slots(
     seeds,
     temperatures,
     keep_ks,
+    block_bitmap=None,
 ):
     """Admit up to R prompts into their cache slots in ONE donated dispatch.
 
@@ -943,28 +951,53 @@ def prefill_into_slots(
     `state` is DONATED: its buffers are invalid after the call — always
     replace your reference with the return value (as the slot ops below
     all do). This keeps exactly one slot cache alive instead of two.
+
+    `block_bitmap` ([depth, R, nb] int32) arms decode-sparsity for the
+    prefill forward too: masked layers route through the block-sparse
+    flash kernel instead of the dense pattern path (text-prefix tiles are
+    always live, and text rows under the shipped policies are exactly
+    causal). Selects the "sparse"-keyed compiled program.
     """
     texts = jnp.asarray(texts, jnp.int32)
     prefill_batch = int(texts.shape[0])
-    return _jit_sample(
-        _prefill_slots_builder, model, (prefill_batch,),
+    args = (
         variables, state, texts,
         jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
         jnp.asarray(temperatures, jnp.float32), jnp.asarray(keep_ks, jnp.int32),
     )
+    if block_bitmap is None:
+        return _jit_sample(
+            _prefill_slots_builder, model, (prefill_batch,), *args
+        )
+    return _jit_sample(
+        _prefill_slots_builder, model, (prefill_batch, "sparse"),
+        *args, jnp.asarray(block_bitmap, jnp.int32),
+    )
 
 
 def _prefill_slots_builder(model, key):
-    (prefill_batch,) = key
+    prefill_batch = key[0]
+    sparse = "sparse" in key
     batch_axis = 1 if model.executor == "scan" else 0
 
-    def fn(variables, state, texts, slots, seeds, temperatures, keep_ks):
+    def fn(variables, state, texts, slots, seeds, temperatures, keep_ks,
+           *sparse_args):
+        cache0 = init_decode_cache(model, prefill_batch)
+        if sparse:
+            (block_bitmap,) = sparse_args
+            cache0 = _with_block_bitmap(
+                cache0, block_bitmap, model.executor, model.depth
+            )
         rows, cache_r = model.apply(
             variables,
             texts,
-            init_decode_cache(model, prefill_batch),
+            cache0,
             method=DALLE.decode_prefill,
         )
+        if sparse:
+            # the persistent slot cache carries no bitmap leaves — strip
+            # the round-tripped ones before the structural scatter below
+            cache_r = _without_block_bitmap(cache_r, model.executor)
 
         def write(path, s_leaf, p_leaf):
             # `index` leaves are not scattered: the chunk step stamps every
@@ -1128,7 +1161,9 @@ def _release_builder(model, key):
 _release_builder._donate_argnums = (0,)  # state
 
 
-def decode_image_chunk(model: DALLE, variables, state: dict, chunk: int):
+def decode_image_chunk(
+    model: DALLE, variables, state: dict, chunk: int, block_bitmap=None
+):
     """Advance every live slot by up to `chunk` tokens (one jitted program
     per (model, chunk)).
 
@@ -1142,18 +1177,31 @@ def decode_image_chunk(model: DALLE, variables, state: dict, chunk: int):
 
     `state` is DONATED (see `prefill_into_slots`) — replace your reference
     with the return value.
+
+    `block_bitmap` ([depth, max_batch, nb] int32) arms decode-time policy
+    sparsity: injected into every layer's attention cache for the scan
+    (models/attention.py routes masked rows through the block-sparse
+    flash kernel) and stripped from the result. Traced data — re-deriving
+    it every chunk never recompiles; its presence selects a separate
+    compiled program (the "sparse" static-key marker), warmed like any
+    other rung.
     """
+    if block_bitmap is None:
+        return _jit_sample(
+            _chunk_builder, model, (int(chunk),), variables, state
+        )
     return _jit_sample(
-        _chunk_builder, model, (int(chunk),), variables, state
+        _chunk_builder, model, (int(chunk), "sparse"),
+        variables, state, jnp.asarray(block_bitmap, jnp.int32),
     )
 
 
 def _chunk_builder(model, key):
-    (chunk,) = key
-    return _make_chunk_fn(model, chunk, paged=False)
+    chunk = key[0]
+    return _make_chunk_fn(model, chunk, paged=False, sparse="sparse" in key)
 
 
-def _make_chunk_fn(model, chunk, paged):
+def _make_chunk_fn(model, chunk, paged, sparse=False):
     """One chunk program body, shared by the slotted and paged layouts so
     the decode semantics (sampling, liveness gating, position threading)
     cannot drift between them — only the cache plumbing differs: the paged
@@ -1212,11 +1260,18 @@ def _make_chunk_fn(model, chunk, paged):
         return jax.lax.scan(step, carry, None, length=chunk)[0]
 
     if paged:
-        def fn(variables, state, page_table):
+        def fn(variables, state, page_table, *sparse_args):
             cache0 = _with_page_table(
                 state["cache"], page_table, model.executor, model.depth
             )
+            if sparse:
+                (block_bitmap,) = sparse_args
+                cache0 = _with_block_bitmap(
+                    cache0, block_bitmap, model.executor, model.depth
+                )
             cache, row, img_tokens, img_pos = run(variables, state, cache0)
+            if sparse:
+                cache = _without_block_bitmap(cache, model.executor)
             return {
                 **state,
                 "cache": _without_page_table(cache, model.executor),
@@ -1225,10 +1280,16 @@ def _make_chunk_fn(model, chunk, paged):
                 "img_pos": img_pos,
             }
     else:
-        def fn(variables, state):
-            cache, row, img_tokens, img_pos = run(
-                variables, state, state["cache"]
-            )
+        def fn(variables, state, *sparse_args):
+            cache0 = state["cache"]
+            if sparse:
+                (block_bitmap,) = sparse_args
+                cache0 = _with_block_bitmap(
+                    cache0, block_bitmap, model.executor, model.depth
+                )
+            cache, row, img_tokens, img_pos = run(variables, state, cache0)
+            if sparse:
+                cache = _without_block_bitmap(cache, model.executor)
             return {
                 **state,
                 "cache": cache,
@@ -1270,6 +1331,47 @@ def _with_page_table(cache, page_table, executor, depth):
         return {**cache, "attn": {**cache["attn"], "page_table": ptd}}
     return {
         name: {**layer, "attn": {**layer["attn"], "page_table": pt}}
+        for name, layer in cache.items()
+    }
+
+
+def _with_block_bitmap(cache, bitmaps, executor, depth):
+    """Inject the per-layer decode-sparsity bitmaps [depth, B, nb] into
+    every layer's attention cache (same smuggling idiom as
+    `_with_page_table`; the scan executor slices its depth-stacked leaf
+    per layer). nb = ceil(max_len / decode_sparse_block); nonzero =
+    KV tile may be read. TRACED data — the serving policy re-derives the
+    table every chunk from each row's position without recompiling."""
+    bm = jnp.asarray(bitmaps, jnp.int32)
+    if executor == "scan":
+        return {**cache, "attn": {**cache["attn"], "block_bitmap": bm}}
+    return {
+        name: {
+            **layer,
+            "attn": {
+                **layer["attn"],
+                "block_bitmap": bm[int(name.split("_")[-1])],
+            },
+        }
+        for name, layer in cache.items()
+    }
+
+
+def _without_block_bitmap(cache, executor):
+    """Strip the bitmap leaves (attention round-trips them for nn.scan
+    carry-structure parity) so the persistent donated state keeps its
+    bitmap-free shape — the policy table is host state, like the page
+    table."""
+    if executor == "scan":
+        attn = {k: v for k, v in cache["attn"].items() if k != "block_bitmap"}
+        return {**cache, "attn": attn}
+    return {
+        name: {
+            **layer,
+            "attn": {
+                k: v for k, v in layer["attn"].items() if k != "block_bitmap"
+            },
+        }
         for name, layer in cache.items()
     }
 
@@ -1368,6 +1470,7 @@ def prefill_into_slots_paged(
     page_rows,
     partial_dst,
     page_size: int,
+    block_bitmap=None,
 ):
     """Paged-layout batched admission: the same batch-R text prefill as
     `prefill_into_slots`, scattered into PAGES instead of slot lanes.
@@ -1392,18 +1495,27 @@ def prefill_into_slots_paged(
     prefill_batch = int(texts.shape[0])
     page_rows = jnp.asarray(page_rows, jnp.int32)
     n_text_pages = int(page_rows.shape[1])
-    return _jit_sample(
-        _prefill_slots_paged_builder, model,
-        (prefill_batch, int(page_size), n_text_pages),
+    args = (
         variables, state, texts,
         jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
         jnp.asarray(temperatures, jnp.float32), jnp.asarray(keep_ks, jnp.int32),
         page_rows, jnp.asarray(partial_dst, jnp.int32),
     )
+    if block_bitmap is None:
+        return _jit_sample(
+            _prefill_slots_paged_builder, model,
+            (prefill_batch, int(page_size), n_text_pages), *args,
+        )
+    return _jit_sample(
+        _prefill_slots_paged_builder, model,
+        (prefill_batch, int(page_size), n_text_pages, "sparse"),
+        *args, jnp.asarray(block_bitmap, jnp.int32),
+    )
 
 
 def _prefill_slots_paged_builder(model, key):
-    prefill_batch, page_size, n_text_pages = key
+    prefill_batch, page_size, n_text_pages = key[:3]
+    sparse = "sparse" in key
     batch_axis = 1 if model.executor == "scan" else 0
 
     def block_of(p_leaf, r, j, last_axis=False):
@@ -1424,13 +1536,21 @@ def _prefill_slots_paged_builder(model, key):
         return blk
 
     def fn(variables, state, texts, slots, seeds, temperatures, keep_ks,
-           page_rows, partial_dst):
+           page_rows, partial_dst, *sparse_args):
+        cache0 = init_decode_cache(model, prefill_batch)
+        if sparse:
+            (block_bitmap,) = sparse_args
+            cache0 = _with_block_bitmap(
+                cache0, block_bitmap, model.executor, model.depth
+            )
         rows, cache_r = model.apply(
             variables,
             texts,
-            init_decode_cache(model, prefill_batch),
+            cache0,
             method=DALLE.decode_prefill,
         )
+        if sparse:
+            cache_r = _without_block_bitmap(cache_r, model.executor)
 
         def write(path, s_leaf, p_leaf):
             key_ = getattr(path[-1], "key", None)
@@ -1761,23 +1881,32 @@ _admit_prefix_builder._donate_argnums = (0,)  # state
 
 
 def decode_image_chunk_paged(
-    model: DALLE, variables, state: dict, chunk: int, page_table
+    model: DALLE, variables, state: dict, chunk: int, page_table,
+    block_bitmap=None,
 ):
     """Paged-layout chunk step: identical decode semantics to
     `decode_image_chunk` (one shared program body — see `_make_chunk_fn`),
     with every row's K/V reads and writes indirected through `page_table`
     [max_batch, n_pages] (host numpy, traced data: ONE compiled program no
     matter which pages are mapped). `state` is DONATED; the page table is
-    not (it is host-owned and tiny)."""
+    not (it is host-owned and tiny). `block_bitmap` arms policy sparsity
+    exactly as in `decode_image_chunk` — on this layout the table-gated
+    paged kernels skip dead PAGES through the same indirection."""
+    if block_bitmap is None:
+        return _jit_sample(
+            _chunk_paged_builder, model, (int(chunk),),
+            variables, state, jnp.asarray(page_table, jnp.int32),
+        )
     return _jit_sample(
-        _chunk_paged_builder, model, (int(chunk),),
+        _chunk_paged_builder, model, (int(chunk), "sparse"),
         variables, state, jnp.asarray(page_table, jnp.int32),
+        jnp.asarray(block_bitmap, jnp.int32),
     )
 
 
 def _chunk_paged_builder(model, key):
-    (chunk,) = key
-    return _make_chunk_fn(model, chunk, paged=True)
+    chunk = key[0]
+    return _make_chunk_fn(model, chunk, paged=True, sparse="sparse" in key)
 
 
 _chunk_paged_builder._donate_argnums = (1,)  # state

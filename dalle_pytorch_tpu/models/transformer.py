@@ -202,6 +202,7 @@ class _ScanBlock(nn.Module):
     sp_mesh: Any
     decode_mesh: Any
     decode_heads_axis: str
+    decode_sparse_block: Optional[int]
     deterministic: bool
     dtype: Any
 
@@ -242,6 +243,7 @@ class _ScanBlock(nn.Module):
             sp_mesh=self.sp_mesh,
             decode_mesh=self.decode_mesh,
             decode_heads_axis=self.decode_heads_axis,
+            decode_sparse_block=self.decode_sparse_block,
             dtype=self.dtype,
             name="attn",
         )(h, key_mask=key_mask, rotary=rotary,
@@ -355,6 +357,10 @@ class Transformer(nn.Module):
     sp_mesh: Any = None  # Mesh with "sp" axis for attn_impl="ring"
     decode_mesh: Any = None  # serving mesh for sharded flash decode
     decode_heads_axis: str = "tp"  # mesh axis the kernel splits heads over
+    # decode-time policy-sparse KV tile width (None = DECODE_SPARSE_BLOCK
+    # in models/attention.py); static config the serving engine clones in
+    # with --decode_sparsity=policy — the bitmap itself stays traced data
+    decode_sparse_block: Optional[int] = None
     # "unrolled" | "scan" — see module docstring. "scan" compiles one layer
     # body instead of `depth` copies; masked attn types run as dense with
     # depth-stacked scanned pattern masks; cached decode is native,
@@ -435,6 +441,7 @@ class Transformer(nn.Module):
                     sp_mesh=self.sp_mesh,
                     decode_mesh=self.decode_mesh,
                     decode_heads_axis=self.decode_heads_axis,
+                    decode_sparse_block=self.decode_sparse_block,
                     dtype=self.dtype,
                     name=f"attn_{attn_id}",
                 )
@@ -583,6 +590,7 @@ class Transformer(nn.Module):
             sp_mesh=self.sp_mesh,
             decode_mesh=self.decode_mesh,
             decode_heads_axis=self.decode_heads_axis,
+            decode_sparse_block=self.decode_sparse_block,
             dtype=self.dtype,
         )
 

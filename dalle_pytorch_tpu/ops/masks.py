@@ -132,3 +132,40 @@ def block_layout_to_token_mask(layout: np.ndarray, block: int, causal: bool = Tr
     if causal:
         mask &= causal_mask(mask.shape[0])
     return mask
+
+
+def mask_to_block_bitmap(
+    mask: np.ndarray,
+    block: int,
+    n_blocks: int | None = None,
+    always_live: int = 0,
+) -> np.ndarray:
+    """Reduce a token-level allowed mask to per-query-row KV-tile liveness.
+
+    The decode-time contract of the block-sparse flash kernel
+    (`ops/pallas_decode.py`): bitmap[i, j] says whether query row i may
+    read ANY position in KV tile j (tile j covers key positions
+    [j*block, (j+1)*block)). The reduction is conservative by
+    construction — a tile with a single allowed key is read whole, and
+    the kernel's in-tile causal/length mask trims the rest — so sparse
+    decode can only ever read a superset of the mask's positions, never
+    miss one.
+
+    `n_blocks` widens (False-pads) or crops the tile axis to the serving
+    cache's ceil(max_len/block); `always_live` forces the first tiles
+    covering that many key positions live (the text prefix + <bos>, which
+    every decode policy keeps resident). Host-side numpy, like every
+    builder here: the result rides into the chunk program as TRACED data.
+    """
+    t_q, t_k = mask.shape
+    if n_blocks is None:
+        n_blocks = -(-t_k // block)
+    out = np.zeros((t_q, n_blocks), dtype=bool)
+    for j in range(n_blocks):
+        lo = j * block
+        if lo >= t_k:
+            break
+        out[:, j] = mask[:, lo : min(lo + block, t_k)].any(axis=1)
+    if always_live > 0:
+        out[:, : -(-min(always_live, n_blocks * block) // block)] = True
+    return out

@@ -75,7 +75,7 @@ def _last_live_block(length, block_k):
 
 def _decode_kernel(
     lengths_ref, q_ref, *refs,
-    sm_scale, block_k, n_real_q, nk_blocks, quantized=False,
+    sm_scale, block_k, n_real_q, nk_blocks, quantized=False, live_ref=None,
 ):
     """Grid (b, h, ki): the q chunk stays put over the inner ki steps while
     [block_k, d] K/V tiles stream through (auto double-buffered). Tiles
@@ -84,7 +84,15 @@ def _decode_kernel(
 
     `quantized=True` interleaves per-(position, head) fp32 scale refs
     ([block_k] tiles) after each int8 K/V ref and dequantizes IN KERNEL —
-    the HBM read stays 1 byte/element; compute is fp32 as always."""
+    the HBM read stays 1 byte/element; compute is fp32 as always.
+
+    `live_ref` ([B, nk_blocks] int32 in SMEM, block-sparse mode) replaces
+    the length-derived liveness predicate with a per-(row, tile) bitmap:
+    a 0 entry skips the tile's compute here AND its DMA (the block-map
+    scalar operand the sparse index maps read re-indexes the previous
+    live tile, so Pallas elides the copy — the exact length-skip trick,
+    generalized to holes). The bitmap arrives pre-ANDed with the length
+    bound, so in-live-range causality still comes from `lengths_ref`."""
     if quantized:
         k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
     else:
@@ -100,7 +108,10 @@ def _decode_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    live = ki <= _last_live_block(length, block_k)
+    if live_ref is None:
+        live = ki <= _last_live_block(length, block_k)
+    else:
+        live = live_ref[b, ki] == 1
 
     @pl.when(live)
     def _attend():
@@ -242,6 +253,149 @@ def flash_decode_attention(
         ),
         interpret=interp,
     )(lengths, *operands)
+    return out[:, :, :n, :]
+
+
+# ------------------------------------------------- block-sparse tile skip
+#
+# Policy sparsity (axial / block-sparse attention layouts) generalizes the
+# length skip: a row's dead KV tiles are not just the suffix above its live
+# length but arbitrary HOLES the attention pattern never reads (an axial-row
+# image query only attends its own feature-map row + the text prefix). The
+# bitmap is per (batch row, KV tile), rides scalar prefetch next to the
+# lengths, and drives BOTH the compute predicate and the DMA index map —
+# so a skipped tile costs zero FLOPs and zero HBM traffic, and (since the
+# int8 scale sidecars share the same index maps) zero scale reads too.
+# An all-ones bitmap reduces the predicate and the index map to EXACTLY
+# the length-skip forms above, which is the bit-identity pin the tests
+# hold the sparse kernel to.
+
+
+def _sparse_maps(lengths, block_bitmap, block_k, nk_blocks):
+    """Per-(row, tile) liveness + DMA re-index maps for the sparse kernels.
+
+    live[b, j]  = bitmap says read it AND tile j intersects the live prefix;
+    bmap[b, j]  = j for live tiles, else the nearest live tile index <= j
+                  (0 before the first live tile — that one copy is real but
+                  its compute is predicated off). Consecutive dead steps
+                  repeat an index, so Pallas elides their DMAs.
+
+    Both are traced int32 — policy flips never recompile (the bitmap is
+    DATA, not structure)."""
+    j = lax.broadcasted_iota(jnp.int32, (lengths.shape[0], nk_blocks), 1)
+    llb = _last_live_block(lengths, block_k)[:, None]
+    live = (block_bitmap != 0) & (j <= llb)
+    bmap = jnp.maximum(lax.cummax(jnp.where(live, j, -1), axis=1), 0)
+    return live.astype(jnp.int32), bmap.astype(jnp.int32)
+
+
+def _sparse_decode_kernel(lengths_ref, live_ref, bmap_ref, q_ref, *refs, **kw):
+    """Online-softmax body with the bitmap predicate; the block-map ref is
+    consumed by the K/V BlockSpec index maps, not the body."""
+    del bmap_ref
+    _decode_kernel(lengths_ref, q_ref, *refs, live_ref=live_ref, **kw)
+
+
+def block_sparse_flash_decode_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    lengths: jnp.ndarray,
+    block_bitmap: jnp.ndarray,
+    *,
+    sm_scale: Optional[float] = None,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """`flash_decode_attention` with per-row per-KV-tile policy skipping.
+
+    block_bitmap: [B, ceil(S/block_k)] int (nonzero = tile may be read) —
+    tile j of row b covers cache positions [j*block_k, (j+1)*block_k).
+    Within live tiles the causal-over-prefix mask still applies, so an
+    all-ones bitmap is bit-identical to `flash_decode_attention` (same
+    tile order, same predicates, same accumulation — pinned in tests).
+    The bitmap is traced data: policy changes never trigger a compile.
+
+    int8 caches pass `k_scale`/`v_scale` as usual; the scale sidecars ride
+    the same block-map index maps, so a skipped tile skips its scale read.
+    """
+    b, h, n, d = q.shape
+    s_len = k.shape[2]
+    assert k.shape == v.shape == (b, h, s_len, d), (q.shape, k.shape, v.shape)
+    assert lengths.shape == (b,), f"lengths {lengths.shape} != ({b},)"
+    quantized = k_scale is not None
+    assert (k_scale is None) == (v_scale is None), "pass both scales or neither"
+    if quantized:
+        assert k_scale.shape == v_scale.shape == (b, h, s_len), (
+            k_scale.shape, (b, h, s_len),
+        )
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    interp = _use_interpret() if interpret is None else interpret
+
+    block_k = max(min(block_k, s_len), 1)
+    qp = _pad_to(q, 2, _MIN_BLOCK_Q)
+    kp = _pad_to(k, 2, block_k)
+    vp = _pad_to(v, 2, block_k)
+    bq = qp.shape[2]
+    nk_blocks = kp.shape[2] // block_k
+    assert block_bitmap.shape == (b, nk_blocks), (
+        f"block_bitmap {block_bitmap.shape} != ({b}, {nk_blocks}) "
+        f"for S={s_len}, block_k={block_k}"
+    )
+    lengths = jnp.clip(lengths.astype(jnp.int32), 0, s_len)
+    live_map, block_map = _sparse_maps(
+        lengths, block_bitmap, block_k, nk_blocks
+    )
+
+    kernel = functools.partial(
+        _sparse_decode_kernel,
+        sm_scale=scale,
+        block_k=block_k,
+        n_real_q=n,
+        nk_blocks=nk_blocks,
+        quantized=quantized,
+    )
+    qspec = pl.BlockSpec(
+        (1, 1, bq, d), lambda b_, h_, j, lens, live, bmap: (b_, h_, 0, 0)
+    )
+
+    def k_idx(b_, h_, j, lens, live, bmap):
+        # dead steps re-index the nearest preceding live tile -> copy elided
+        return (b_, h_, bmap[b_, j], 0)
+
+    kspec = pl.BlockSpec((1, 1, block_k, d), k_idx)
+    in_specs = [qspec, kspec, kspec]
+    operands = [qp, kp, vp]
+    if quantized:
+        sspec = pl.BlockSpec(
+            (1, 1, block_k),
+            lambda b_, h_, j, lens, live, bmap: (b_, h_, bmap[b_, j]),
+        )
+        ksp = _pad_to(k_scale.astype(jnp.float32), 2, block_k)
+        vsp = _pad_to(v_scale.astype(jnp.float32), 2, block_k)
+        in_specs = [qspec, kspec, sspec, kspec, sspec]
+        operands = [qp, kp, ksp, vp, vsp]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(b, h, nk_blocks),
+            in_specs=in_specs,
+            out_specs=qspec,
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interp,
+    )(lengths, live_map, block_map, *operands)
     return out[:, :, :n, :]
 
 
@@ -395,6 +549,113 @@ def paged_flash_decode_attention(
     return out[:, :, :n, :]
 
 
+def _sparse_paged_decode_kernel(
+    lengths_ref, pt_ref, live_ref, bmap_ref, q_ref, *refs, **kw
+):
+    """Paged sparse body: page table + block map feed the index maps."""
+    del pt_ref, bmap_ref
+    _decode_kernel(lengths_ref, q_ref, *refs, live_ref=live_ref, **kw)
+
+
+def block_sparse_paged_flash_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    lengths: jnp.ndarray,
+    page_table: jnp.ndarray,
+    block_bitmap: jnp.ndarray,
+    *,
+    sm_scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+    k_scale: Optional[jnp.ndarray] = None,
+    v_scale: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """`paged_flash_decode_attention` with policy tile skipping at PAGE
+    granularity: block_bitmap is [B, n_pages] (one bit per page-table
+    entry), and a dead page is never dereferenced — its grid step
+    re-indexes the nearest preceding live page through the block map, so
+    the physical-page DMA is elided along with the compute. An all-ones
+    bitmap is bit-identical to `paged_flash_decode_attention`. int8 scale
+    pages ride the same indirection and skip with their page."""
+    b, h, n, d = q.shape
+    p_total, hk, page_size, dk = k_pages.shape
+    assert k_pages.shape == v_pages.shape and (hk, dk) == (h, d), (
+        q.shape, k_pages.shape, v_pages.shape,
+    )
+    n_pages = page_table.shape[1]
+    assert page_table.shape == (b, n_pages), (page_table.shape, b)
+    assert block_bitmap.shape == (b, n_pages), (
+        f"block_bitmap {block_bitmap.shape} != ({b}, {n_pages})"
+    )
+    quantized = k_scale is not None
+    assert (k_scale is None) == (v_scale is None), "pass both scales or neither"
+    if quantized:
+        assert k_scale.shape == v_scale.shape == (p_total, h, page_size), (
+            k_scale.shape, (p_total, h, page_size),
+        )
+    scale = d**-0.5 if sm_scale is None else sm_scale
+    interp = _use_interpret() if interpret is None else interpret
+
+    qp = _pad_to(q, 2, _MIN_BLOCK_Q)
+    bq = qp.shape[2]
+    lengths = jnp.clip(lengths.astype(jnp.int32), 0, n_pages * page_size)
+    page_table = page_table.astype(jnp.int32)
+    live_map, block_map = _sparse_maps(
+        lengths, block_bitmap, page_size, n_pages
+    )
+
+    kernel = functools.partial(
+        _sparse_paged_decode_kernel,
+        sm_scale=scale,
+        block_k=page_size,
+        n_real_q=n,
+        nk_blocks=n_pages,
+        quantized=quantized,
+    )
+    qspec = pl.BlockSpec(
+        (1, 1, bq, d),
+        lambda b_, h_, j, lens, pt, live, bmap: (b_, h_, 0, 0),
+    )
+
+    def kv_idx(b_, h_, j, lens, pt, live, bmap):
+        # dead steps re-index the nearest preceding live PAGE -> copy elided
+        return (pt[b_, bmap[b_, j]], h_, 0, 0)
+
+    kvspec = pl.BlockSpec((1, 1, page_size, d), kv_idx)
+    in_specs = [qspec, kvspec, kvspec]
+    operands = [qp, k_pages, v_pages]
+    if quantized:
+        def sv_idx(b_, h_, j, lens, pt, live, bmap):
+            return (pt[b_, bmap[b_, j]], h_, 0)
+
+        svspec = pl.BlockSpec((1, 1, page_size), sv_idx)
+        in_specs = [qspec, kvspec, svspec, kvspec, svspec]
+        operands = [
+            qp, k_pages, k_scale.astype(jnp.float32),
+            v_pages, v_scale.astype(jnp.float32),
+        ]
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(b, h, n_pages),
+            in_specs=in_specs,
+            out_specs=qspec,
+            scratch_shapes=[
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, 1), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, bq, d), q.dtype),
+        compiler_params=CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interp,
+    )(lengths, page_table, live_map, block_map, *operands)
+    return out[:, :, :n, :]
+
+
 def paged_decode_attention(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,
@@ -407,6 +668,8 @@ def paged_decode_attention(
     sm_scale: Optional[float] = None,
     k_scale: Optional[jnp.ndarray] = None,
     v_scale: Optional[jnp.ndarray] = None,
+    block_bitmap: Optional[jnp.ndarray] = None,
+    sparse_block: Optional[int] = None,
 ) -> jnp.ndarray:
     """Flash-path dispatch for the paged cache — see the section comment
     above for the "gather" (bit-exact) vs "kernel" (bandwidth-optimal)
@@ -415,7 +678,15 @@ def paged_decode_attention(
     int8 pools pass their [P, H, page_size] scale pools: the gather path
     gathers int8 pages + scales and hands BOTH to the contiguous kernel
     (in-kernel dequant, identical math to the slotted quantized path),
-    keeping the slotted-vs-paged parity contract on the quantized cache."""
+    keeping the slotted-vs-paged parity contract on the quantized cache.
+
+    `block_bitmap` ([B, ceil(vlen/sparse_block)], with `sparse_block` the
+    policy's tile width) arms policy skipping: the gather path hands it to
+    the contiguous sparse kernel at `sparse_block` granularity (same tile
+    boundaries as the slotted engine, so paged-vs-slotted parity holds
+    under sparsity too); the "kernel" path re-expands it to PAGE
+    granularity (sparse_block must be a page_size multiple) so dead pages
+    are never dereferenced through the table."""
     impl = PAGED_DECODE_IMPL if impl is None else impl
     if impl == "gather":
         k = paged_gather(k_pages, page_table, vlen)
@@ -430,8 +701,33 @@ def paged_decode_attention(
                     v_scale[..., None], page_table, vlen
                 )[..., 0],
             }
+        if block_bitmap is not None:
+            assert sparse_block is not None, "sparse_block rides block_bitmap"
+            return block_sparse_flash_decode_attention(
+                q, k, v, lengths, block_bitmap,
+                sm_scale=sm_scale, block_k=sparse_block, **kw,
+            )
         return flash_decode_attention(q, k, v, lengths, sm_scale=sm_scale, **kw)
     assert impl == "kernel", f"unknown paged decode impl {impl!r}"
+    if block_bitmap is not None:
+        assert sparse_block is not None, "sparse_block rides block_bitmap"
+        page_size = k_pages.shape[2]
+        n_pages = page_table.shape[1]
+        assert sparse_block % page_size == 0, (
+            f"sparse_block {sparse_block} must be a multiple of "
+            f"page_size {page_size} for the paged kernel"
+        )
+        bm = jnp.repeat(block_bitmap, sparse_block // page_size, axis=1)
+        if bm.shape[1] < n_pages:
+            # trailing pages beyond the policy's bitmap window: dead (the
+            # live-length AND inside the kernel keeps this conservative)
+            bm = jnp.pad(bm, ((0, 0), (0, n_pages - bm.shape[1])))
+        else:
+            bm = bm[:, :n_pages]
+        return block_sparse_paged_flash_decode_attention(
+            q, k_pages, v_pages, lengths, page_table, bm,
+            sm_scale=sm_scale, k_scale=k_scale, v_scale=v_scale,
+        )
     return paged_flash_decode_attention(
         q, k_pages, v_pages, lengths, page_table, sm_scale=sm_scale,
         k_scale=k_scale, v_scale=v_scale,
@@ -461,6 +757,8 @@ def sharded_flash_decode_attention(
     sm_scale: Optional[float] = None,
     k_scale: Optional[jnp.ndarray] = None,
     v_scale: Optional[jnp.ndarray] = None,
+    block_bitmap: Optional[jnp.ndarray] = None,
+    sparse_block: Optional[int] = None,
 ):
     """`flash_decode_attention` split over `head_axis` of `mesh` via
     shard_map (`parallel/mesh.py`'s compat wrapper keeps it running on
@@ -469,38 +767,48 @@ def sharded_flash_decode_attention(
     `serving_partition`'s divisibility rule. int8 caches hand their
     [B, H, S] scale leaves along — per-head scales split with the heads
     (reduction-free), so the sharded quantized kernel stays bit-identical
-    to the unsharded quantized one."""
+    to the unsharded quantized one. `block_bitmap`/`sparse_block` arm
+    policy tile skipping: the bitmap is head-independent so it REPLICATES
+    (P()) like the lengths and every head shard skips the same tiles."""
     from dalle_pytorch_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
+
+    def dispatch(q_, k_, v_, lens_, bm_=None, ks_=None, vs_=None):
+        kw = {"sm_scale": sm_scale, "k_scale": ks_, "v_scale": vs_}
+        if bm_ is not None:
+            return block_sparse_flash_decode_attention(
+                q_, k_, v_, lens_, bm_,
+                block_k=128 if sparse_block is None else sparse_block, **kw,
+            )
+        return flash_decode_attention(q_, k_, v_, lens_, **kw)
 
     h = q.shape[1]
     # a mesh without the axis (custom caller-built meshes) falls back
     # unsharded rather than raising at trace time inside the chunk program
     axis_n = dict(mesh.shape).get(head_axis, 1)
     if axis_n == 1 or h % axis_n != 0:
-        return flash_decode_attention(
-            q, k, v, lengths, sm_scale=sm_scale,
-            k_scale=k_scale, v_scale=v_scale,
-        )
+        return dispatch(q, k, v, lengths, block_bitmap, k_scale, v_scale)
     spec = P(None, head_axis, None, None)
-    args = (q, k, v, lengths)
-    in_specs = (spec, spec, spec, P())
+    args = [q, k, v, lengths]
+    in_specs = [spec, spec, spec, P()]
+    if block_bitmap is not None:
+        args.append(block_bitmap)
+        in_specs.append(P())
     if k_scale is not None:
         sspec = P(None, head_axis, None)
-        args += (k_scale, v_scale)
-        in_specs += (sspec, sspec)
+        args += [k_scale, v_scale]
+        in_specs += [sspec, sspec]
 
-        def call(q_, k_, v_, lens_, ks_, vs_):
-            return flash_decode_attention(
-                q_, k_, v_, lens_, sm_scale=sm_scale,
-                k_scale=ks_, v_scale=vs_,
-            )
-    else:
-        call = functools.partial(flash_decode_attention, sm_scale=sm_scale)
+    def call(q_, k_, v_, lens_, *rest):
+        rest = list(rest)
+        bm_ = rest.pop(0) if block_bitmap is not None else None
+        ks_, vs_ = rest if rest else (None, None)
+        return dispatch(q_, k_, v_, lens_, bm_, ks_, vs_)
+
     fn = shard_map(
         call,
         mesh=mesh,
-        in_specs=in_specs,
+        in_specs=tuple(in_specs),
         out_specs=spec,
         check_vma=False,
     )
@@ -521,6 +829,8 @@ def sharded_paged_decode_attention(
     sm_scale: Optional[float] = None,
     k_scale: Optional[jnp.ndarray] = None,
     v_scale: Optional[jnp.ndarray] = None,
+    block_bitmap: Optional[jnp.ndarray] = None,
+    sparse_block: Optional[int] = None,
 ):
     """`paged_decode_attention` split over `head_axis` of `mesh`: the page
     pool shards at its HEAD axis (axis 1 of [P, H, page_size, D]) — pages
@@ -531,39 +841,47 @@ def sharded_paged_decode_attention(
     unmodified single-device code per shard; the head concat is exact, so
     sharded paged decode is bit-identical to single-device paged decode.
     Never split the PAGE axis: a page-split pool silently reads other
-    rows' pages through the global table (tracelint TL008 flags it)."""
+    rows' pages through the global table (tracelint TL008 flags it).
+    `block_bitmap`/`sparse_block` replicate (P()) like the page table —
+    policy skipping is head-independent."""
     from dalle_pytorch_tpu.parallel.mesh import shard_map
     from jax.sharding import PartitionSpec as P
+
+    def dispatch(q_, kp_, vp_, lens_, pt_, bm_=None, ks_=None, vs_=None):
+        return paged_decode_attention(
+            q_, kp_, vp_, lens_, pt_, vlen, impl=impl, sm_scale=sm_scale,
+            k_scale=ks_, v_scale=vs_,
+            block_bitmap=bm_, sparse_block=sparse_block,
+        )
 
     h = q.shape[1]
     axis_n = dict(mesh.shape).get(head_axis, 1)
     if axis_n == 1 or h % axis_n != 0:
-        return paged_decode_attention(
-            q, k_pages, v_pages, lengths, page_table, vlen,
-            impl=impl, sm_scale=sm_scale, k_scale=k_scale, v_scale=v_scale,
+        return dispatch(
+            q, k_pages, v_pages, lengths, page_table,
+            block_bitmap, k_scale, v_scale,
         )
     spec = P(None, head_axis, None, None)
-    args = (q, k_pages, v_pages, lengths, page_table)
-    in_specs = (spec, spec, spec, P(), P())
+    args = [q, k_pages, v_pages, lengths, page_table]
+    in_specs = [spec, spec, spec, P(), P()]
+    if block_bitmap is not None:
+        args.append(block_bitmap)
+        in_specs.append(P())
     if k_scale is not None:
         sspec = P(None, head_axis, None)
-        args += (k_scale, v_scale)
-        in_specs += (sspec, sspec)
+        args += [k_scale, v_scale]
+        in_specs += [sspec, sspec]
 
-        def call(q_, kp_, vp_, lens_, pt_, ks_, vs_):
-            return paged_decode_attention(
-                q_, kp_, vp_, lens_, pt_, vlen, impl=impl,
-                sm_scale=sm_scale, k_scale=ks_, v_scale=vs_,
-            )
-    else:
-        def call(q_, kp_, vp_, lens_, pt_):
-            return paged_decode_attention(
-                q_, kp_, vp_, lens_, pt_, vlen, impl=impl, sm_scale=sm_scale
-            )
+    def call(q_, kp_, vp_, lens_, pt_, *rest):
+        rest = list(rest)
+        bm_ = rest.pop(0) if block_bitmap is not None else None
+        ks_, vs_ = rest if rest else (None, None)
+        return dispatch(q_, kp_, vp_, lens_, pt_, bm_, ks_, vs_)
+
     fn = shard_map(
         call,
         mesh=mesh,
-        in_specs=in_specs,
+        in_specs=tuple(in_specs),
         out_specs=spec,
         check_vma=False,
     )

@@ -528,6 +528,7 @@ class ContinuousEngine(GenerationEngine):
         resume_enabled: bool = False,
         preview_enabled: bool = False,
         kv_dtype=None,
+        decode_sparsity: str = "causal",
     ):
         assert float(cond_scale) == 1.0, (
             "ContinuousEngine does not support classifier-free guidance yet "
@@ -535,11 +536,32 @@ class ContinuousEngine(GenerationEngine):
             "the micro-batch GenerationEngine for cond_scale != 1"
         )
         assert int(chunk_tokens) >= 1
+        assert decode_sparsity in ("causal", "policy"), (
+            f"unknown decode_sparsity {decode_sparsity!r}; "
+            "use 'causal' (dense-causal flash, the bit-identity default) "
+            "or 'policy' (block-sparse flash from the model's static "
+            "attention layouts)"
+        )
+        self.decode_sparsity = str(decode_sparsity)
         # int8 KV cache (--kv_dtype int8): clone the model so every slot-op
         # builder (they key the jit cache on the model) sees the quantized
         # cache layout; None keeps the bit-identical default path
         if kv_dtype is not None and getattr(model, "kv_dtype", None) is None:
             model = model.clone(kv_dtype=str(kv_dtype))
+        # block-sparse decode (--decode_sparsity policy): bake the tile
+        # width into the model clone (same builder-cache reasoning as
+        # kv_dtype — and the boot fingerprint hashes the model repr, so a
+        # sparse boot never resumes a causal compile cache); the bitmaps
+        # themselves stay TRACED data, built per dispatch by the policy
+        if (
+            self.decode_sparsity == "policy"
+            and getattr(model, "decode_sparse_block", None) is None
+        ):
+            from dalle_pytorch_tpu.models.attention import (
+                DECODE_SPARSE_BLOCK,
+            )
+
+            model = model.clone(decode_sparse_block=DECODE_SPARSE_BLOCK)
         super().__init__(
             model=model,
             variables=variables,
@@ -570,6 +592,18 @@ class ContinuousEngine(GenerationEngine):
         # admission never spans more slots than exist; 1 degrades to the
         # per-row admission of PR 2
         self.prefill_batch = max(1, min(int(prefill_batch), self.max_batch))
+        #: host-side tile-liveness policy (None on the causal path): turns
+        #: the model's static attention layouts into per-slot KV-tile
+        #: bitmaps the chunk/prefill dispatches carry as traced data
+        self._sparsity = None
+        if self.decode_sparsity == "policy":
+            from dalle_pytorch_tpu.serving.sparsity import (
+                DecodeSparsityPolicy,
+            )
+
+            self._sparsity = DecodeSparsityPolicy(
+                self.model, self.chunk_tokens, self.max_batch
+            )
         self._state = self._fresh_state()
         self._m_slots = self.registry.gauge(
             "dalle_serving_slots_active",
@@ -595,6 +629,17 @@ class ContinuousEngine(GenerationEngine):
             "capacity win when --kv_dtype int8 shrinks each page",
         )
         self._m_kv_bytes_slot.set(self.kv_bytes_per_slot())
+        self._m_kv_tiles_read = self.registry.counter(
+            "dalle_serving_kv_tiles_read_total",
+            "KV tiles the block-sparse decode kernel read (per chunk "
+            "dispatch, summed over live rows and layers; zero on "
+            "--decode_sparsity causal)",
+        )
+        self._m_kv_tiles_skipped = self.registry.counter(
+            "dalle_serving_kv_tiles_skipped_total",
+            "KV tiles the sparsity policy skipped that the length skip "
+            "alone would have read — the policy's own DMA/compute savings",
+        )
         self._decode_pixels_jit = None
         self._preview_jit = None
         self._preview_fill = None
@@ -612,6 +657,12 @@ class ContinuousEngine(GenerationEngine):
         overrides (rebuilding its host-side page tables alongside)."""
         from dalle_pytorch_tpu.models.dalle import init_slot_state
 
+        # host mirrors of (img_pos, active), updated at every admission/
+        # chunk/release: the sparsity policy derives each dispatch's tile
+        # bitmaps from them without an extra device sync (the paged
+        # subclass keeps the same pair for its allocator)
+        self._host_pos = np.zeros(self.max_batch, np.int64)
+        self._host_active = np.zeros(self.max_batch, bool)
         return init_slot_state(self.model, self.max_batch)
 
     def _kv_cache_bytes(self) -> int:
@@ -656,13 +707,37 @@ class ContinuousEngine(GenerationEngine):
             self._state = self._fresh_state()
             raise
 
+    def _prefill_bitmap_kw(self) -> dict:
+        """`block_bitmap=` kwarg for one prefill-shaped dispatch (empty on
+        the causal path) — shared by the slotted/paged dispatch seams and
+        their warmup cost captures so all four lower the same program."""
+        if self._sparsity is None:
+            return {}
+        return {
+            "block_bitmap": self._sparsity.prefill_bitmaps(
+                self.prefill_batch
+            )
+        }
+
+    def _chunk_bitmap_kw(self) -> dict:
+        """`block_bitmap=` kwarg for one chunk dispatch, derived from the
+        host position/liveness mirrors as of the chunk start."""
+        if self._sparsity is None:
+            return {}
+        return {
+            "block_bitmap": self._sparsity.chunk_bitmaps(
+                self._host_pos, self._host_active
+            )
+        }
+
     def _prefill_op(self, s, texts, slots, seeds, temps, keep):
         """One batched-prefill dispatch over state `s` (subclass hook —
         the sharded engine runs its sharding-pinned program here)."""
         from dalle_pytorch_tpu.models.dalle import prefill_into_slots
 
         return prefill_into_slots(
-            self.model, self.variables, s, texts, slots, seeds, temps, keep
+            self.model, self.variables, s, texts, slots, seeds, temps,
+            keep, **self._prefill_bitmap_kw(),
         )
 
     def _release_op(self, s, mask):
@@ -704,15 +779,19 @@ class ContinuousEngine(GenerationEngine):
             finally:
                 wall = time.perf_counter() - t0
                 self.vitals.dispatch_end("prefill", wall)
+            for slot, _spec in assignments:
+                self._host_pos[int(slot)] = 0
+                self._host_active[int(slot)] = True
             if _warmup:
                 # after the dispatch (see GenerationEngine.generate: a
                 # pre-dispatch lowering would poison the sampler cache)
                 from dalle_pytorch_tpu.models.dalle import prefill_into_slots
 
+                spkw = self._prefill_bitmap_kw()
                 self._capture_cost(
                     "prefill",
                     lambda v, s, t, sl, se, tm, k: prefill_into_slots(
-                        self.model, v, s, t, sl, se, tm, k,
+                        self.model, v, s, t, sl, se, tm, k, **spkw,
                     ),
                     self.variables, self._state, texts, slots, seeds,
                     temps, keep,
@@ -810,6 +889,9 @@ class ContinuousEngine(GenerationEngine):
             finally:
                 wall = time.perf_counter() - t0
                 self.vitals.dispatch_end("resume", wall)
+            for (slot, _spec), p in zip(assignments, img_pos[:n]):
+                self._host_pos[int(slot)] = int(p)
+                self._host_active[int(slot)] = True
             if _warmup:
                 from dalle_pytorch_tpu.models.dalle import resume_into_slots
 
@@ -835,12 +917,16 @@ class ContinuousEngine(GenerationEngine):
         from dalle_pytorch_tpu.models.dalle import decode_image_chunk
 
         return decode_image_chunk(
-            self.model, self.variables, s, self.chunk_tokens
+            self.model, self.variables, s, self.chunk_tokens,
+            **self._chunk_bitmap_kw(),
         )
 
     def _post_chunk(self, pos, act) -> None:
-        """Subclass hook after the host snapshot (the paged engine mirrors
-        positions and block gauges here)."""
+        """Mirror the chunk snapshot host-side — the sparsity policy (and
+        the paged allocator, which extends this) read positions without
+        another device sync."""
+        self._host_pos[: len(pos)] = pos
+        self._host_active[: len(act)] = np.asarray(act, bool)
 
     def step_chunk(self, _warmup: bool = False):  # tracelint: hotloop
         """Advance all live slots by `chunk_tokens`; returns the post-chunk
@@ -857,6 +943,15 @@ class ContinuousEngine(GenerationEngine):
                     self._m_chunks.inc()
                     self.chunk_index += 1
                     self.stats.batches += 1
+                    if self._sparsity is not None:
+                        # mirrors are still the chunk-START snapshot here
+                        # (post_chunk runs below), i.e. exactly what the
+                        # dispatch's bitmap was derived from
+                        read, skipped = self._sparsity.count_tiles(
+                            self._host_pos, self._host_active
+                        )
+                        self._m_kv_tiles_read.inc(read)
+                        self._m_kv_tiles_skipped.inc(skipped)
                 # the chunk boundary IS the designed sync point: retirement
                 # decisions need the positions on the host, and fusing both
                 # small arrays into one transfer keeps it to a single round trip
@@ -883,10 +978,11 @@ class ContinuousEngine(GenerationEngine):
         lock."""
         from dalle_pytorch_tpu.models.dalle import decode_image_chunk
 
+        spkw = self._chunk_bitmap_kw()
         self._capture_cost(
             "chunk",
             lambda v, s: decode_image_chunk(
-                self.model, v, s, self.chunk_tokens
+                self.model, v, s, self.chunk_tokens, **spkw,
             ),
             self.variables, self._state,
         )
@@ -945,6 +1041,8 @@ class ContinuousEngine(GenerationEngine):
                 self.vitals.dispatch_end(
                     "release", time.perf_counter() - t0
                 )
+            self._host_active[mask] = False
+            self._host_pos[mask] = 0
 
     def decode_pixels(self, tokens: np.ndarray) -> Optional[np.ndarray]:  # tracelint: hotloop
         """Pixels [n, H, W, 3] in [0, 1] for harvested token rows, via ONE
@@ -1226,6 +1324,18 @@ class ContinuousEngine(GenerationEngine):
 
     # -------------------------------------------------------- observability
 
+    def sparsity_detail(self) -> Optional[dict]:
+        """Decode-sparsity snapshot for `/healthz` (None on the causal
+        path, so the server omits the block entirely — same getattr
+        contract as `kv_detail`/`mesh_detail`)."""
+        if self._sparsity is None:
+            return None
+        out = {"mode": "policy"}
+        out.update(self._sparsity.detail())
+        out["kv_tiles_read"] = int(self._m_kv_tiles_read.value)
+        out["kv_tiles_skipped"] = int(self._m_kv_tiles_skipped.value)
+        return out
+
     def state_dump(self) -> dict:
         """Host-side engine state for `/debug/state` and stall reports —
         deliberately lock-free (a stalled engine is holding its dispatch
@@ -1292,6 +1402,7 @@ class PagedContinuousEngine(ContinuousEngine):
         resume_enabled: bool = False,
         preview_enabled: bool = False,
         kv_dtype=None,
+        decode_sparsity: str = "causal",
     ):
         self.page_size = int(page_size)
         assert self.page_size >= 1
@@ -1323,6 +1434,7 @@ class PagedContinuousEngine(ContinuousEngine):
             resume_enabled=resume_enabled,
             preview_enabled=preview_enabled,
             kv_dtype=kv_dtype,
+            decode_sparsity=decode_sparsity,
         )
         assert self.kv.can_ever_admit(1), (
             f"kv_pages={self.kv_pages} cannot hold a single row "
@@ -1468,6 +1580,7 @@ class PagedContinuousEngine(ContinuousEngine):
         return prefill_into_slots_paged(
             self.model, self.variables, s, texts, slots, seeds, temps,
             keep, page_rows, partial_dst, self.page_size,
+            **self._prefill_bitmap_kw(),
         )
 
     def _admit_hit_op(self, s, slot, sidecar, seed, temperature, keep_k,
@@ -1689,12 +1802,13 @@ class PagedContinuousEngine(ContinuousEngine):
             if _warmup:
                 # after the dispatch (see GenerationEngine.generate: a
                 # pre-dispatch lowering would poison the sampler cache)
+                spkw = self._prefill_bitmap_kw()
                 self._capture_cost(
                     "prefill",
                     lambda v, s, t, sl, se, tm, k, pr, pd: (
                         prefill_into_slots_paged(
                             self.model, v, s, t, sl, se, tm, k, pr, pd,
-                            self.page_size,
+                            self.page_size, **spkw,
                         )
                     ),
                     self.variables, self._state, texts, slots, seeds,
@@ -1806,21 +1920,23 @@ class PagedContinuousEngine(ContinuousEngine):
         from dalle_pytorch_tpu.models.dalle import decode_image_chunk_paged
 
         return decode_image_chunk_paged(
-            self.model, self.variables, s, self.chunk_tokens, self.kv.table
+            self.model, self.variables, s, self.chunk_tokens,
+            self.kv.table, **self._chunk_bitmap_kw(),
         )
 
     def _post_chunk(self, pos, act) -> None:
-        self._host_pos[: len(pos)] = pos
+        super()._post_chunk(pos, act)
         self._update_block_gauges()
 
     def release(self, slots: Sequence[int]) -> None:  # tracelint: hotloop
+        # snapshot BEFORE the base release clears the host mirrors: pages
+        # must be freed exactly for the rows that were live
+        was_active = {int(s): bool(self._host_active[int(s)]) for s in slots}
         super().release(slots)
         for s in slots:
             s = int(s)
-            if self._host_active[s]:
+            if was_active[s]:
                 self.kv.release(s)
-                self._host_active[s] = False
-                self._host_pos[s] = 0
         self._update_block_gauges()
 
     # ------------------------------------------------------------- warmup
@@ -1879,10 +1995,11 @@ class PagedContinuousEngine(ContinuousEngine):
     def _capture_chunk_cost(self) -> None:
         from dalle_pytorch_tpu.models.dalle import decode_image_chunk_paged
 
+        spkw = self._chunk_bitmap_kw()
         self._capture_cost(
             "chunk",
             lambda v, s, t: decode_image_chunk_paged(
-                self.model, v, s, self.chunk_tokens, t
+                self.model, v, s, self.chunk_tokens, t, **spkw,
             ),
             self.variables, self._state, self.kv.table,
         )
@@ -1923,6 +2040,7 @@ def engine_from_checkpoint(
     resume_enabled: Optional[bool] = None,
     preview_enabled: Optional[bool] = None,
     kv_dtype: Optional[str] = None,
+    decode_sparsity: Optional[str] = None,
 ):
     """Build a serving engine from a single-file DALLE checkpoint.
 
@@ -1937,7 +2055,12 @@ def engine_from_checkpoint(
     (`kv_layout="paged"` upgrades it to `ShardedPagedContinuousEngine`:
     the paged pool head-splits over `tp`, page tables stay host-side).
     `kv_dtype="int8"` stores KV pages quantized with per-(position, head)
-    scales; `None`/"model" keeps the model dtype. The loading
+    scales; `None`/"model" keeps the model dtype.
+    `decode_sparsity="policy"` routes pattern-masked decode rows through
+    the block-sparse flash kernel, bitmaps derived host-side from the
+    model's static attention layouts (`serving/sparsity.py`);
+    `None`/"causal" keeps the bit-identical dense-causal default
+    (continuous engines only). The loading
     sequence (VAE reconstruction, tokenizer, ring-attention downgrade for
     decode) was lifted from `generate.py`, which now calls this instead —
     CLI and server share one code path by construction.
@@ -1945,6 +2068,10 @@ def engine_from_checkpoint(
     assert mode in ("micro", "continuous"), f"unknown engine mode {mode!r}"
     assert mesh is None or mode == "continuous", (
         "--mesh needs the continuous engine (slot or paged kv layout)"
+    )
+    assert decode_sparsity in (None, "causal") or mode == "continuous", (
+        "--decode_sparsity policy needs the continuous engine (the "
+        "micro-batch sampler has no per-slot bitmap plumbing)"
     )
     from pathlib import Path
 
@@ -2044,6 +2171,9 @@ def engine_from_checkpoint(
         # the replicated VAE, so the sharded engine warms it too
         paged_kw["preview_enabled"] = (
             True if preview_enabled is None else bool(preview_enabled)
+        )
+        paged_kw["decode_sparsity"] = (
+            "causal" if decode_sparsity is None else str(decode_sparsity)
         )
         return cls(
             max_batch=max(int(b) for b in batch_shapes),

@@ -1485,6 +1485,14 @@ class ServingServer:
                 # bytes, so a probe (and the watchdog's stall dump, which
                 # rides engine.state_dump) names the sick shard
                 detail["mesh"] = mesh_detail()
+            sparsity_detail = getattr(self.engine, "sparsity_detail", None)
+            if sparsity_detail is not None:
+                # block-sparse decode (--decode_sparsity policy): tile
+                # width/static dead fraction + the lifetime read/skipped
+                # tile counters (None on causal boots — block omitted)
+                sp = sparsity_detail()
+                if sp is not None:
+                    detail["sparsity"] = sp
         if err is not None:
             detail["last_error"] = repr(err)
             if err_age is not None:

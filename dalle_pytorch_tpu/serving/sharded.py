@@ -320,6 +320,7 @@ class ShardedContinuousEngine(_MeshServingMixin, ContinuousEngine):
         resume_enabled: bool = False,
         preview_enabled: bool = False,
         kv_dtype=None,
+        decode_sparsity: str = "causal",
     ):
         model, variables, vae_params = self._init_mesh(
             model, variables, vae_params, mesh, mesh_shape, model_axis
@@ -341,6 +342,7 @@ class ShardedContinuousEngine(_MeshServingMixin, ContinuousEngine):
             resume_enabled=resume_enabled,
             preview_enabled=preview_enabled,
             kv_dtype=kv_dtype,
+            decode_sparsity=decode_sparsity,
         )
 
     # ----------------------------------------------------------- slot ops
@@ -351,19 +353,33 @@ class ShardedContinuousEngine(_MeshServingMixin, ContinuousEngine):
 
         from dalle_pytorch_tpu.models.dalle import _prefill_slots_builder
 
+        sparse = self._sparsity is not None
+        key = (
+            (self.prefill_batch, "sparse") if sparse
+            else (self.prefill_batch,)
+        )
         fn = self._sharded_program(
             "prefill",
             lambda: jax.jit(
-                _prefill_slots_builder(self.model, (self.prefill_batch,)),
+                _prefill_slots_builder(self.model, key),
                 donate_argnums=(1,),
                 out_shardings=self._state_shardings,
             ),
         )
-        return fn(
+        args = [
             self.variables, s, jnp.asarray(texts, jnp.int32),
             jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
             jnp.asarray(temps, jnp.float32), jnp.asarray(keep, jnp.int32),
-        )
+        ]
+        if sparse:
+            # bitmap rides replicated (it is per-row control data, tiny
+            # next to the KV it gates; GSPMD replicates uncommitted hosts
+            # arrays) — the per-head split happens inside the shard_map
+            args.append(jnp.asarray(
+                self._sparsity.prefill_bitmaps(self.prefill_batch),
+                jnp.int32,
+            ))
+        return fn(*args)
 
     def _resume_op(self, s, texts, img_tokens, img_pos, slots, seeds,
                    temps, keep):
@@ -390,17 +406,30 @@ class ShardedContinuousEngine(_MeshServingMixin, ContinuousEngine):
 
     def _chunk_op(self, s):
         import jax
+        import jax.numpy as jnp
 
         from dalle_pytorch_tpu.models.dalle import _chunk_builder
 
+        sparse = self._sparsity is not None
+        key = (
+            (self.chunk_tokens, "sparse") if sparse
+            else (self.chunk_tokens,)
+        )
         fn = self._sharded_program(
             "chunk",
             lambda: jax.jit(
-                _chunk_builder(self.model, (self.chunk_tokens,)),
+                _chunk_builder(self.model, key),
                 donate_argnums=(1,),
                 out_shardings=self._state_shardings,
             ),
         )
+        if sparse:
+            return fn(self.variables, s, jnp.asarray(
+                self._sparsity.chunk_bitmaps(
+                    self._host_pos, self._host_active
+                ),
+                jnp.int32,
+            ))
         return fn(self.variables, s)
 
 
@@ -442,6 +471,7 @@ class ShardedPagedContinuousEngine(_MeshServingMixin, PagedContinuousEngine):
         resume_enabled: bool = False,
         preview_enabled: bool = False,
         kv_dtype=None,
+        decode_sparsity: str = "causal",
     ):
         model, variables, vae_params = self._init_mesh(
             model, variables, vae_params, mesh, mesh_shape, model_axis
@@ -466,6 +496,7 @@ class ShardedPagedContinuousEngine(_MeshServingMixin, PagedContinuousEngine):
             resume_enabled=resume_enabled,
             preview_enabled=preview_enabled,
             kv_dtype=kv_dtype,
+            decode_sparsity=decode_sparsity,
         )
 
     # ----------------------------------------------------------- slot ops
@@ -485,26 +516,33 @@ class ShardedPagedContinuousEngine(_MeshServingMixin, PagedContinuousEngine):
         )
 
         n_text_pages = int(np.asarray(page_rows).shape[1])
+        sparse = self._sparsity is not None
+        key = (self.prefill_batch, self.page_size, n_text_pages)
+        if sparse:
+            key = key + ("sparse",)
         fn = self._sharded_program(
             "prefill",
             lambda: jax.jit(
-                _prefill_slots_paged_builder(
-                    self.model,
-                    (self.prefill_batch, self.page_size, n_text_pages),
-                ),
+                _prefill_slots_paged_builder(self.model, key),
                 donate_argnums=(1,),
                 out_shardings=(
                     self._state_shardings, self._replicated_sharding(),
                 ),
             ),
         )
-        return fn(
+        args = [
             self.variables, s, jnp.asarray(texts, jnp.int32),
             jnp.asarray(slots, jnp.int32), jnp.asarray(seeds, jnp.int32),
             jnp.asarray(temps, jnp.float32), jnp.asarray(keep, jnp.int32),
             jnp.asarray(page_rows, jnp.int32),
             jnp.asarray(partial_dst, jnp.int32),
-        )
+        ]
+        if sparse:
+            args.append(jnp.asarray(
+                self._sparsity.prefill_bitmaps(self.prefill_batch),
+                jnp.int32,
+            ))
+        return fn(*args)
 
     def _admit_hit_op(self, s, slot, sidecar, seed, temperature, keep_k,
                       partial_src, partial_dst):
@@ -563,14 +601,25 @@ class ShardedPagedContinuousEngine(_MeshServingMixin, PagedContinuousEngine):
 
         from dalle_pytorch_tpu.models.dalle import _chunk_paged_builder
 
+        sparse = self._sparsity is not None
+        key = (
+            (self.chunk_tokens, "sparse") if sparse
+            else (self.chunk_tokens,)
+        )
         fn = self._sharded_program(
             "chunk",
             lambda: jax.jit(
-                _chunk_paged_builder(self.model, (self.chunk_tokens,)),
+                _chunk_paged_builder(self.model, key),
                 donate_argnums=(1,),
                 out_shardings=self._state_shardings,
             ),
         )
-        return fn(
-            self.variables, s, jnp.asarray(self.kv.table, jnp.int32)
-        )
+        args = [self.variables, s, jnp.asarray(self.kv.table, jnp.int32)]
+        if sparse:
+            args.append(jnp.asarray(
+                self._sparsity.chunk_bitmaps(
+                    self._host_pos, self._host_active
+                ),
+                jnp.int32,
+            ))
+        return fn(*args)

@@ -28,7 +28,18 @@ recommendation line. Caveats stated in BASELINE.md §flash-crossover; the
 on-chip wall-clock A/B (`scripts/pallas_onchip.py`) stays armed in the
 watchdog matrix as the final decider.
 
-Usage: JAX_PLATFORMS=cpu python scripts/flash_crossover.py
+`--sparse` runs the BLOCK-SPARSE decode sweep instead (BASELINE.md
+§block-sparse): for the flagship axial-row layout it reduces the static
+pattern to per-row KV-tile bitmaps at several tile widths (the same
+`ops/masks.py:mask_to_block_bitmap` reduction the serving policy ships at
+runtime) and models, per width, the expected tiles read/skipped over a
+full image decode plus the roofline step time with a per-tile grid charge.
+The tension it quantifies: thin tiles skip more (a tile one live position
+touches is read whole) but pay more grid steps; wide tiles amortise grid
+overhead but smear the pattern. The sweep is what justifies
+`DECODE_SPARSE_BLOCK = 128` in models/attention.py.
+
+Usage: JAX_PLATFORMS=cpu python scripts/flash_crossover.py [--sparse]
 """
 
 from __future__ import annotations
@@ -52,6 +63,14 @@ KERNEL_OVERHEAD_S = 5e-6
 BATCH, HEADS, DIM_HEAD = 4, 16, 64
 BLOCK = 128
 SEQS = (256, 384, 512, 640, 768, 1024, 1280, 1536, 2048, 4096)
+
+# flagship text/image split: 256 text tokens + <bos>, fmap 32 -> 1024
+# image tokens, decode cache max_len 1281
+TEXT_SEQ, FMAP = 256, 32
+#: per-grid-step charge inside the Mosaic kernel (DMA issue + bookkeeping
+#: per (head, kv-tile) step) — the cost thin tiles multiply
+TILE_STEP_OVERHEAD_S = 1e-7
+SPARSE_BLOCKS = (32, 64, 128, 256, 512)
 
 
 def measured_dense(seq, dtype):
@@ -102,6 +121,104 @@ def decode_step_times(max_len, itemsize):
     kv_flash = 2 * BATCH * HEADS * tiles * BLOCK * DIM_HEAD * itemsize
     flash_s = kv_flash / V5E_HBM_BPS + KERNEL_OVERHEAD_S
     return dense_s, flash_s
+
+
+def sparse_sweep():
+    """Tile-width sweep for the block-sparse flash-decode kernel.
+
+    Pure host numpy over the REAL static layout (`_build_static_mask` +
+    `mask_to_block_bitmap` — the exact reduction the serving policy ships),
+    so the live/dead tile counts are the truth, not a model; only the time
+    axis is a roofline. Per block width, averaged over every image decode
+    position p (cache length text_len + p + 1):
+
+      * tiles_read / tiles_skipped among causally in-range tiles — i.e.
+        the policy's savings ON TOP of the PR 4 length skip, the same
+        accounting as the fleet's kv_tiles_* counters;
+      * roofline step time: live K/V tile bytes over HBM BW, plus the
+        per-tile grid charge times in-range tiles (dead tiles still cost
+        a grid step: the kernel skips their DMA and compute, not their
+        index-map evaluation) and the per-kernel overhead.
+    """
+    import numpy as np
+
+    from dalle_pytorch_tpu.models.transformer import _build_static_mask
+    from dalle_pytorch_tpu.ops.masks import mask_to_block_bitmap
+
+    itemsize = 2  # bf16 KV cache
+    total = TEXT_SEQ + FMAP * FMAP
+    max_len = total + 1
+    text_len = TEXT_SEQ + 1
+    image_seq = FMAP * FMAP
+    mask = np.asarray(_build_static_mask("axial_row", total, FMAP, 0))
+    if mask.shape[0] < max_len:
+        pad = max_len - mask.shape[0]
+        mask = np.pad(mask, ((0, pad), (0, pad)), constant_values=True)
+    mask = mask[:max_len, :max_len]
+
+    lens = text_len + np.arange(image_seq) + 1  # cache length at step p
+    rows_out = []
+    for blk in SPARSE_BLOCKS:
+        nb = -(-max_len // blk)
+        bitmap = mask_to_block_bitmap(
+            mask, blk, n_blocks=nb, always_live=text_len
+        )[text_len:][:image_seq]
+        llb = (lens - 1) // blk
+        in_range = np.arange(nb)[None, :] <= llb[:, None]
+        live = bitmap & in_range
+        read = live.sum(axis=1).astype(float)
+        in_r = in_range.sum(axis=1).astype(float)
+        live_frac = float(read.sum() / in_r.sum())
+        # one decode step's K/V traffic (all heads; q is a single token)
+        kv_read = 2 * BATCH * HEADS * read.mean() * blk * DIM_HEAD * itemsize
+        kv_len = 2 * BATCH * HEADS * in_r.mean() * blk * DIM_HEAD * itemsize
+        step_s = (
+            kv_read / V5E_HBM_BPS
+            + HEADS * in_r.mean() * TILE_STEP_OVERHEAD_S
+            + KERNEL_OVERHEAD_S
+        )
+        len_skip_s = (
+            kv_len / V5E_HBM_BPS
+            + HEADS * in_r.mean() * TILE_STEP_OVERHEAD_S
+            + KERNEL_OVERHEAD_S
+        )
+        rows_out.append(
+            {
+                "probe": "sparse_block_sweep",
+                "pattern": "axial_row",
+                "block": blk,
+                "n_blocks": nb,
+                "live_tile_frac": round(live_frac, 4),
+                "tiles_read_mean": round(float(read.mean()), 2),
+                "tiles_skipped_mean": round(float((in_r - read).mean()), 2),
+                "kv_bytes_read_mean": int(kv_read),
+                "kv_bytes_saved_mean": int(kv_len - kv_read),
+                "decode_step_us": round(step_s * 1e6, 2),
+                "decode_lengthskip_us": round(len_skip_s * 1e6, 2),
+            }
+        )
+        print(json.dumps(rows_out[-1]), flush=True)
+    best_saved = max(r["kv_bytes_saved_mean"] for r in rows_out)
+    by_block = {r["block"]: r for r in rows_out}
+    print(
+        json.dumps(
+            {
+                "probe": "sparse_block_recommendation",
+                "decode_sparse_block": 128,
+                "savings_captured_vs_best": round(
+                    by_block[128]["kv_bytes_saved_mean"] / best_saved, 4
+                ),
+                "basis": "128 matches flash_decode_attention's default "
+                "block_k (all-ones bitmap keeps bit-identity with the "
+                "dense-causal flash path) and sits at the roofline knee: "
+                "thinner tiles save more bytes but the per-tile grid "
+                "charge eats the win (32-wide models SLOWER than "
+                "length-skip-only at 128); wider tiles smear the "
+                "pattern and forfeit most of the skip",
+            }
+        ),
+        flush=True,
+    )
 
 
 def main():
@@ -167,4 +284,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--sparse" in sys.argv[1:]:
+        sparse_sweep()
+    else:
+        main()

@@ -115,6 +115,18 @@ def parse_args(argv=None):
                    "byte (exactly 2D/(D+4) at head dim D) at a small "
                    "quantization error (bench_serving.py reports the "
                    "CLIP-score delta beside the speedup)")
+    p.add_argument("--decode_sparsity", choices=("causal", "policy"),
+                   default="causal",
+                   help="decode-attention sparsity (continuous engine). "
+                   "causal: dense-causal flash decode, the bit-identical "
+                   "default; policy: pattern-masked layers route through "
+                   "the block-sparse flash kernel with per-slot KV-tile "
+                   "bitmaps derived host-side from the model's static "
+                   "attention layouts (serving/sparsity.py) and shipped "
+                   "as traced data — dead tiles skip compute AND DMA, "
+                   "zero extra compiled programs after warmup "
+                   "(bench_serving.py reports kv_tiles_skipped and the "
+                   "CLIP-score delta beside the speedup)")
     p.add_argument("--max_queue", type=int, default=64,
                    help="queue bound in rows; beyond it requests get 503")
     p.add_argument("--request_timeout_s", type=float, default=120.0)
@@ -418,6 +430,7 @@ def main(argv=None):
             prefix_entries=args.prefix_entries,
             mesh=args.mesh,
             kv_dtype=args.kv_dtype,
+            decode_sparsity=args.decode_sparsity,
             resume_enabled=not args.no_resume,
             # --preview_every 0 drops the preview fill+decode program
             # from the warmup ladder entirely (micro engines never

@@ -1,0 +1,30 @@
+"""TL007 positive (block-sparse decode): the KV-tile bitmap materialized
+INSIDE the chunk scan body. The serving contract (serving/sparsity.py)
+ships policy bitmaps as TRACED data precisely so admission, retirement,
+and policy swaps never compile; wrapping the host table inside the body
+captures it into the trace and re-stages it on every retrace — every
+policy change becomes a compile. Never executed — tracelint parses it;
+pytest ignores non-test_ files."""
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+# [depth, max_batch, n_blocks] policy table, host-side (2048 elements)
+BLOCK_BITMAP = np.ones((16, 8, 16), np.int32)
+
+
+def chunk_module_bitmap(state, toks):
+    def body_module_bitmap(carry, tok):
+        bitmap = jnp.asarray(BLOCK_BITMAP)  # host table re-wrapped per trace
+        return carry + bitmap[0, 0, 0], tok
+
+    return lax.scan(body_module_bitmap, state, toks)
+
+
+def chunk_inline_bitmap(state, toks):
+    def body_inline_bitmap(carry, tok):
+        bitmap = jnp.asarray(np.ones((32, 8, 16), np.int32))  # staged inline
+        return carry + bitmap[0, 0, 0], tok
+
+    return lax.scan(body_inline_bitmap, state, toks)

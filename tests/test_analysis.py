@@ -47,6 +47,7 @@ class TestRuleCorpus:
             ("models/tl005_pos.py", "TL005", 3),
             ("tl006_pos.py", "TL006", 4),
             ("tl007_pos.py", "TL007", 3),
+            ("tl007_bitmap_pos.py", "TL007", 2),
             ("tl008_pos.py", "TL008", 3),
             ("tl008_paged_pos.py", "TL008", 3),
             ("tl009_pos.py", "TL009", 3),
@@ -77,6 +78,7 @@ class TestRuleCorpus:
             "models/tl005_neg.py",
             "tl006_neg.py",
             "tl007_neg.py",
+            "tl007_bitmap_neg.py",
             "tl008_neg.py",
             "tl008_paged_neg.py",
             "tl009_neg.py",

@@ -1,0 +1,508 @@
+"""Block-sparse flash decode: sparse-vs-dense oracle parity corpus.
+
+Two pins, per the PR 19 contract:
+
+  * an ALL-ONES bitmap is BIT-IDENTICAL to the non-sparse kernel — same
+    tile boundaries, same predicates, same accumulation order. This is
+    the serving stack's parity anchor: dense-causal policy ("causal",
+    the default) keeps every bit-identity contract the decode path ever
+    made, on the slotted, paged, and sharded kernels, fp32 and int8.
+  * an arbitrary bitmap matches the dense MASKED oracle — dense cached
+    attention under (tile-expanded bitmap AND causal-over-prefix). That
+    is the kernel's mathematical spec: live tiles are read whole and the
+    causal mask trims inside them (the policy's tile reduction is
+    conservative, so exact-pattern dense is a quality comparison — the
+    bench reports it — not a parity pin). The axial-row case runs the
+    REAL layout reduction (`_build_static_mask` + `mask_to_block_bitmap`)
+    end to end.
+
+Kernel tests run in Pallas interpret mode on CPU; engine-level cycles
+(full program compiles) ride the slow tier except the slotted anchor.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dalle_pytorch_tpu.models.attention import _kv_quantize
+from dalle_pytorch_tpu.ops.attention_core import dense_attention
+from dalle_pytorch_tpu.ops.masks import mask_to_block_bitmap
+from dalle_pytorch_tpu.ops.pallas_decode import (
+    block_sparse_flash_decode_attention,
+    block_sparse_paged_flash_decode_attention,
+    flash_decode_attention,
+    paged_decode_attention,
+    paged_flash_decode_attention,
+    sharded_flash_decode_attention,
+    sharded_paged_decode_attention,
+)
+
+
+def _qkv(b, h, n, s, d, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(b, h, n, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    return q, k, v
+
+
+def _sparse_oracle(q, k, v, lengths, bitmap, block_k):
+    """Dense cached attention under the kernel's spec mask: position t of
+    row b is visible iff its tile is live AND t is causally in range."""
+    n = q.shape[2]
+    s = k.shape[2]
+    tiles = jnp.asarray(bitmap)[:, jnp.arange(s) // block_k]  # [B, S]
+    causal = (
+        jnp.arange(s)[None, None, :]
+        <= (lengths[:, None, None] - n + jnp.arange(n)[None, :, None])
+    )
+    mask = (tiles != 0)[:, None, :] & causal
+    return dense_attention(q, k, v, mask=mask[:, None])
+
+
+def _rand_bitmap(b, nk, seed, live_frac=0.5):
+    """Random bitmap with tile 0 always live (the policy's always-live
+    text prefix: a row with zero live tiles has no softmax support)."""
+    rng = np.random.RandomState(seed)
+    bm = (rng.rand(b, nk) < live_frac).astype(np.int32)
+    bm[:, 0] = 1
+    return jnp.asarray(bm)
+
+
+# ------------------------------------------------------------ slotted kernel
+
+
+@pytest.mark.parametrize("block_k", [8, 16])
+def test_all_ones_bit_identical_to_plain_flash(block_k):
+    """The serving parity anchor: all-ones bitmap == flash_decode_attention
+    bit for bit, per-row lengths included."""
+    b, h, s, d = 4, 2, 37, 16
+    q, k, v = _qkv(b, h, 1, s, d)
+    lengths = jnp.asarray([1, 9, 20, s], jnp.int32)
+    nk = -(-s // block_k)
+    ones = jnp.ones((b, nk), jnp.int32)
+    sparse = block_sparse_flash_decode_attention(
+        q, k, v, lengths, ones, block_k=block_k
+    )
+    plain = flash_decode_attention(q, k, v, lengths, block_k=block_k)
+    np.testing.assert_array_equal(np.asarray(sparse), np.asarray(plain))
+
+
+def test_all_ones_bit_identical_int8():
+    """Same anchor on the quantized cache: the scale sidecar rides the
+    same index maps, so all-ones stays bit-identical there too."""
+    b, h, s, d = 2, 2, 24, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=1)
+    kq, ks = _kv_quantize(k)
+    vq, vs = _kv_quantize(v)
+    lengths = jnp.asarray([5, 24], jnp.int32)
+    ones = jnp.ones((b, 3), jnp.int32)
+    sparse = block_sparse_flash_decode_attention(
+        q, kq, vq, lengths, ones, block_k=8, k_scale=ks, v_scale=vs
+    )
+    plain = flash_decode_attention(
+        q, kq, vq, lengths, block_k=8, k_scale=ks, v_scale=vs
+    )
+    np.testing.assert_array_equal(np.asarray(sparse), np.asarray(plain))
+
+
+@pytest.mark.parametrize("n", [1, 4], ids=["decode", "chunk"])
+def test_random_bitmap_matches_masked_oracle(n):
+    """Arbitrary bitmaps across chunk sizes and per-row lengths match the
+    tile-expanded dense oracle to fp32 tolerance."""
+    b, h, s, d, block_k = 3, 2, 40, 8, 8
+    q, k, v = _qkv(b, h, n, s, d, seed=2)
+    lengths = jnp.asarray([n + 3, 17, s], jnp.int32)
+    bm = _rand_bitmap(b, s // block_k, seed=3)
+    out = block_sparse_flash_decode_attention(
+        q, k, v, lengths, bm, block_k=block_k
+    )
+    np.testing.assert_allclose(
+        out, _sparse_oracle(q, k, v, lengths, bm, block_k),
+        atol=2e-5, rtol=1e-5,
+    )
+
+
+def test_axial_layout_bitmap_matches_masked_oracle():
+    """The REAL policy reduction end to end: an axial_row static layout
+    reduced by mask_to_block_bitmap (text prefix always live) drives the
+    kernel; output matches the dense oracle under the reduced mask."""
+    from dalle_pytorch_tpu.models.transformer import _build_static_mask
+
+    fmap, text_seq, block_k = 4, 7, 8
+    total = text_seq + fmap * fmap  # 23
+    max_len = total + 1  # 24
+    text_len = text_seq + 1
+    mask = np.asarray(_build_static_mask("axial_row", total, fmap, 0))
+    mask = np.pad(
+        mask, ((0, max_len - total), (0, max_len - total)),
+        constant_values=True,
+    )[:max_len, :max_len]
+    rows = mask_to_block_bitmap(
+        mask, block_k, n_blocks=max_len // block_k, always_live=text_len
+    )
+    # three slots decoding at different image positions
+    img_pos = np.asarray([0, 5, 15])
+    bm = jnp.asarray(rows[text_len + img_pos].astype(np.int32))
+    lengths = jnp.asarray(text_len + img_pos + 1, jnp.int32)
+    b, h, d = 3, 2, 8
+    q, k, v = _qkv(b, h, 1, max_len, d, seed=4)
+    out = block_sparse_flash_decode_attention(
+        q, k, v, lengths, bm, block_k=block_k
+    )
+    np.testing.assert_allclose(
+        out, _sparse_oracle(q, k, v, lengths, bm, block_k),
+        atol=2e-5, rtol=1e-5,
+    )
+    assert not np.asarray(bm).all(), "layout should have dead tiles"
+
+
+def test_dead_tiles_never_read():
+    """Poison K/V inside dead tiles with huge finite garbage: the output
+    must be unchanged — dead tiles are skipped, not merely down-weighted
+    (unmasked, 1e4-magnitude logits would dominate every softmax)."""
+    b, h, s, d, block_k = 2, 2, 32, 8, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=5)
+    lengths = jnp.asarray([s, s], jnp.int32)
+    bm = jnp.asarray([[1, 0, 1, 0], [1, 1, 0, 0]], jnp.int32)
+    clean = block_sparse_flash_decode_attention(
+        q, k, v, lengths, bm, block_k=block_k
+    )
+    dead = (np.asarray(bm)[:, np.arange(s) // block_k] == 0)  # [B, S]
+    poison = jnp.asarray(
+        np.where(dead[:, None, :, None], 1e4, 0.0), jnp.float32
+    )
+    poisoned = block_sparse_flash_decode_attention(
+        q, k + poison, v + poison, lengths, bm, block_k=block_k
+    )
+    np.testing.assert_array_equal(np.asarray(clean), np.asarray(poisoned))
+
+
+def test_bitmap_is_traced_data_under_jit():
+    """One compiled program serves DIFFERENT bitmaps — the policy is data,
+    not structure (the zero-recompile contract at kernel level)."""
+    b, h, s, d, block_k = 2, 2, 16, 8, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=6)
+    lengths = jnp.asarray([s, s], jnp.int32)
+    with jax.log_compiles(False):
+        fn = jax.jit(
+            lambda bm: block_sparse_flash_decode_attention(
+                q, k, v, lengths, bm, block_k=block_k
+            )
+        )
+        bm1 = jnp.asarray([[1, 1], [1, 1]], jnp.int32)
+        bm2 = jnp.asarray([[1, 0], [1, 1]], jnp.int32)
+        out1 = fn(bm1)
+        compiled_once = fn._cache_size()
+        out2 = fn(bm2)
+        assert fn._cache_size() == compiled_once
+    np.testing.assert_array_equal(
+        np.asarray(out1),
+        np.asarray(flash_decode_attention(q, k, v, lengths, block_k=block_k)),
+    )
+    np.testing.assert_allclose(
+        out2, _sparse_oracle(q, k, v, lengths, bm2, block_k),
+        atol=2e-5, rtol=1e-5,
+    )
+
+
+# -------------------------------------------------------------- paged kernels
+
+
+def _paged(k, v, page_size, seed=7):
+    """Scatter contiguous K/V into a shuffled page pool + table."""
+    b, h, s, d = k.shape
+    n_pages = s // page_size
+    rng = np.random.RandomState(seed)
+    perm = rng.permutation(b * n_pages)
+    pool_k = np.zeros((b * n_pages, h, page_size, d), np.float32)
+    pool_v = np.zeros_like(pool_k)
+    table = np.zeros((b, n_pages), np.int32)
+    for bi in range(b):
+        for j in range(n_pages):
+            phys = perm[bi * n_pages + j]
+            table[bi, j] = phys
+            sl = np.s_[bi, :, j * page_size : (j + 1) * page_size]
+            pool_k[phys] = np.asarray(k)[sl]
+            pool_v[phys] = np.asarray(v)[sl]
+    return jnp.asarray(pool_k), jnp.asarray(pool_v), jnp.asarray(table)
+
+
+def test_paged_all_ones_bit_identical_both_impls():
+    """Page-granularity all-ones == the non-sparse paged kernel (true
+    paged impl), and the gather impl == the slotted sparse kernel — the
+    paged-vs-slotted parity contract survives sparsity."""
+    b, h, s, d, page = 2, 2, 32, 8, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=8)
+    kp, vp, table = _paged(k, v, page)
+    lengths = jnp.asarray([9, 26], jnp.int32)
+    ones = jnp.ones((b, s // page), jnp.int32)
+    sparse_kernel = block_sparse_paged_flash_decode_attention(
+        q, kp, vp, lengths, table, ones
+    )
+    plain_kernel = paged_flash_decode_attention(q, kp, vp, lengths, table)
+    np.testing.assert_array_equal(
+        np.asarray(sparse_kernel), np.asarray(plain_kernel)
+    )
+    gather = paged_decode_attention(
+        q, kp, vp, lengths, table, s, impl="gather",
+        block_bitmap=ones, sparse_block=page,
+    )
+    slotted = block_sparse_flash_decode_attention(
+        q, k, v, lengths, ones, block_k=page
+    )
+    np.testing.assert_array_equal(np.asarray(gather), np.asarray(slotted))
+
+
+def test_paged_sparse_matches_oracle_both_impls():
+    """A patterned bitmap on the paged cache: both impls match the
+    tile-expanded oracle; the gather impl stays bit-identical to the
+    slotted sparse kernel; a dead page's physical slot can hold garbage."""
+    b, h, s, d, page = 2, 2, 32, 8, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=9)
+    kp, vp, table = _paged(k, v, page)
+    lengths = jnp.asarray([s, s], jnp.int32)
+    bm = jnp.asarray([[1, 0, 1, 1], [1, 1, 0, 1]], jnp.int32)
+    oracle = _sparse_oracle(q, k, v, lengths, bm, page)
+    for impl in ("gather", "kernel"):
+        out = paged_decode_attention(
+            q, kp, vp, lengths, table, s, impl=impl,
+            block_bitmap=bm, sparse_block=page,
+        )
+        np.testing.assert_allclose(out, oracle, atol=2e-5, rtol=1e-5)
+    slotted = block_sparse_flash_decode_attention(
+        q, k, v, lengths, bm, block_k=page
+    )
+    gather = paged_decode_attention(
+        q, kp, vp, lengths, table, s, impl="gather",
+        block_bitmap=bm, sparse_block=page,
+    )
+    np.testing.assert_array_equal(np.asarray(gather), np.asarray(slotted))
+
+
+def test_paged_sparse_int8_scale_pages_skip_with_their_page():
+    """int8 pool: all-ones stays bit-identical to the non-sparse paged
+    quantized kernel; a patterned bitmap matches the dequantized oracle."""
+    b, h, s, d, page = 2, 2, 32, 8, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=10)
+    kq, ks = _kv_quantize(k)
+    vq, vs = _kv_quantize(v)
+    kp, vp, table = _paged(kq.astype(jnp.float32), vq.astype(jnp.float32), page)
+    ksp, vsp, _ = _paged(ks[..., None], vs[..., None], page, seed=7)
+    kp, vp = kp.astype(jnp.int8), vp.astype(jnp.int8)
+    ksp, vsp = ksp[..., 0], vsp[..., 0]
+    lengths = jnp.asarray([s, s], jnp.int32)
+    ones = jnp.ones((b, s // page), jnp.int32)
+    sparse = block_sparse_paged_flash_decode_attention(
+        q, kp, vp, lengths, table, ones, k_scale=ksp, v_scale=vsp
+    )
+    plain = paged_flash_decode_attention(
+        q, kp, vp, lengths, table, k_scale=ksp, v_scale=vsp
+    )
+    np.testing.assert_array_equal(np.asarray(sparse), np.asarray(plain))
+    bm = jnp.asarray([[1, 1, 0, 1], [1, 0, 1, 1]], jnp.int32)
+    out = block_sparse_paged_flash_decode_attention(
+        q, kp, vp, lengths, table, bm, k_scale=ksp, v_scale=vsp
+    )
+    kdq = jnp.asarray(kq, jnp.float32) * ks[..., None]
+    vdq = jnp.asarray(vq, jnp.float32) * vs[..., None]
+    np.testing.assert_allclose(
+        out, _sparse_oracle(q, kdq, vdq, lengths, bm, page),
+        atol=2e-5, rtol=1e-5,
+    )
+
+
+# ----------------------------------------------------------- sharded kernels
+
+
+def test_sharded_sparse_bit_identical_to_unsharded():
+    """Head-sharded sparse decode == unsharded sparse decode bit for bit
+    (the bitmap replicates; heads are independent)."""
+    from dalle_pytorch_tpu.serving.sharded import build_serving_mesh
+
+    mesh = build_serving_mesh({"tp": 2})
+    b, h, s, d, block_k = 2, 4, 32, 8, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=11)
+    lengths = jnp.asarray([13, s], jnp.int32)
+    bm = _rand_bitmap(b, s // block_k, seed=12)
+    sharded = sharded_flash_decode_attention(
+        mesh, q, k, v, lengths, block_bitmap=bm, sparse_block=block_k
+    )
+    local = block_sparse_flash_decode_attention(
+        q, k, v, lengths, bm, block_k=block_k
+    )
+    np.testing.assert_array_equal(np.asarray(sharded), np.asarray(local))
+
+
+def test_sharded_paged_sparse_bit_identical_to_unsharded():
+    from dalle_pytorch_tpu.serving.sharded import build_serving_mesh
+
+    mesh = build_serving_mesh({"tp": 2})
+    b, h, s, d, page = 2, 4, 32, 8, 8
+    q, k, v = _qkv(b, h, 1, s, d, seed=13)
+    kp, vp, table = _paged(k, v, page)
+    lengths = jnp.asarray([9, s], jnp.int32)
+    bm = jnp.asarray([[1, 1, 0, 1], [1, 0, 1, 1]], jnp.int32)
+    sharded = sharded_paged_decode_attention(
+        mesh, q, kp, vp, lengths, table, s, impl="gather",
+        block_bitmap=bm, sparse_block=page,
+    )
+    local = paged_decode_attention(
+        q, kp, vp, lengths, table, s, impl="gather",
+        block_bitmap=bm, sparse_block=page,
+    )
+    np.testing.assert_array_equal(np.asarray(sharded), np.asarray(local))
+
+
+# ------------------------------------------------------------ engine cycles
+#
+# Full serve cycles: policy mode on every engine. The slotted anchor runs
+# in tier 1; paged and sharded cycles compile whole serving programs and
+# ride the slow tier.
+
+TEXT_SEQ = 8
+FMAP = 4
+IMG_SEQ = FMAP * FMAP
+
+
+def _build_model(**kw):
+    from dalle_pytorch_tpu.models.dalle import DALLE
+
+    base = dict(
+        dim=32, depth=2, heads=2, dim_head=8,
+        num_image_tokens=32, image_fmap_size=FMAP,
+        num_text_tokens=64, text_seq_len=TEXT_SEQ,
+        shift_tokens=True, rotary_emb=True, attn_impl="flash",
+    )
+    base.update(kw)
+    model = DALLE(**base)
+    text = jnp.zeros((1, TEXT_SEQ), jnp.int32)
+    toks = jnp.zeros((1, IMG_SEQ), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(42), text, toks)
+    return model, params
+
+
+def _spec(seed):
+    from dalle_pytorch_tpu.serving.engine import SampleSpec
+
+    ids = np.zeros(TEXT_SEQ, np.int32)
+    ids[:3] = (5, 6, 7)
+    return SampleSpec(ids, seed=seed, temperature=1.0, top_k=0.9)
+
+
+def _cycle(eng):
+    eng.prefill_slots([(0, _spec(7)), (1, _spec(9))])
+    for _ in range(32):
+        pos, act = eng.step_chunk()
+        if (pos[act] >= eng.image_seq_len).all():
+            break
+    else:
+        raise AssertionError("decode never finished")
+    out = eng.harvest([0, 1])
+    eng.release([0, 1])
+    return out
+
+
+def _registry():
+    from dalle_pytorch_tpu.training.metrics import MetricsRegistry
+
+    return MetricsRegistry()
+
+
+class TestEnginePolicyMode:
+    def test_full_causal_policy_bit_identical_to_causal(self):
+        """Policy mode on an unpatterned model: every bitmap is all-ones,
+        so the whole serve cycle is bit-identical to the default engine —
+        the parity anchor at engine level."""
+        from dalle_pytorch_tpu.serving.engine import ContinuousEngine
+
+        model, params = _build_model()
+        kw = dict(model=model, variables=params, max_batch=2,
+                  chunk_tokens=4, prefill_batch=2)
+        causal = ContinuousEngine(registry=_registry(), **kw)
+        policy = ContinuousEngine(
+            registry=_registry(), decode_sparsity="policy", **kw
+        )
+        np.testing.assert_array_equal(_cycle(causal), _cycle(policy))
+
+    def test_axial_policy_zero_recompile_and_counts(self):
+        """Patterned model in policy mode: a warm serve cycle compiles
+        ZERO programs (bitmaps are traced data) and the tile counters
+        report real skips."""
+        from dalle_pytorch_tpu.serving.engine import ContinuousEngine
+        from dalle_pytorch_tpu.utils.compile_guard import assert_no_recompiles
+
+        model, params = _build_model(
+            attn_types=("full", "axial_row"), decode_sparse_block=4
+        )
+        eng = ContinuousEngine(
+            model=model, variables=params, max_batch=2, chunk_tokens=4,
+            prefill_batch=2, registry=_registry(), decode_sparsity="policy",
+        )
+        eng.warmup()
+        with assert_no_recompiles():
+            out = _cycle(eng)
+        assert out.shape == (2, IMG_SEQ)
+        detail = eng.sparsity_detail()
+        assert detail["mode"] == "policy"
+        assert detail["patterned_layers"] == 1
+        assert detail["kv_tiles_skipped"] > 0
+        assert detail["kv_tiles_read"] > 0
+        read = eng.registry.get("dalle_serving_kv_tiles_read_total")
+        assert int(read.value) == detail["kv_tiles_read"]
+
+    @pytest.mark.slow
+    def test_paged_policy_int8_zero_recompile(self):
+        from dalle_pytorch_tpu.serving.engine import PagedContinuousEngine
+        from dalle_pytorch_tpu.utils.compile_guard import assert_no_recompiles
+
+        model, params = _build_model(
+            attn_types=("full", "axial_row"), decode_sparse_block=4
+        )
+        eng = PagedContinuousEngine(
+            model=model, variables=params, max_batch=2, chunk_tokens=4,
+            prefill_batch=2, page_size=4, registry=_registry(),
+            decode_sparsity="policy", kv_dtype="int8",
+        )
+        eng.warmup()
+        with assert_no_recompiles():
+            out = _cycle(eng)
+        assert out.shape == (2, IMG_SEQ)
+        assert eng.sparsity_detail()["kv_tiles_skipped"] > 0
+
+    @pytest.mark.slow
+    def test_sharded_full_causal_policy_parity(self):
+        from dalle_pytorch_tpu.serving.engine import ContinuousEngine
+        from dalle_pytorch_tpu.serving.sharded import ShardedContinuousEngine
+
+        model, params = _build_model()
+        kw = dict(model=model, variables=params, max_batch=2,
+                  chunk_tokens=4, prefill_batch=2)
+        ref = ContinuousEngine(registry=_registry(), **kw)
+        shp = ShardedContinuousEngine(
+            registry=_registry(), mesh_shape="tp=2",
+            decode_sparsity="policy", **kw,
+        )
+        np.testing.assert_array_equal(_cycle(ref), _cycle(shp))
+
+    @pytest.mark.slow
+    def test_sharded_paged_axial_policy_zero_recompile(self):
+        from dalle_pytorch_tpu.serving.sharded import (
+            ShardedPagedContinuousEngine,
+        )
+        from dalle_pytorch_tpu.utils.compile_guard import assert_no_recompiles
+
+        model, params = _build_model(
+            attn_types=("full", "axial_row"), decode_sparse_block=4
+        )
+        eng = ShardedPagedContinuousEngine(
+            model=model, variables=params, max_batch=2, chunk_tokens=4,
+            prefill_batch=2, page_size=4, registry=_registry(),
+            mesh_shape="tp=2", decode_sparsity="policy",
+        )
+        eng.warmup()
+        with assert_no_recompiles():
+            out = _cycle(eng)
+        assert out.shape == (2, IMG_SEQ)
+        assert eng.sparsity_detail()["kv_tiles_skipped"] > 0
