@@ -1325,13 +1325,16 @@ def fleet_request(port, body, timeout=30.0, headers=None):
     return out
 
 
-def run_fleet_window(port, arrivals, seeds, timeout_s=60.0, on_offset=None):
+def run_fleet_window(port, arrivals, seeds, timeout_s=60.0, on_offset=None,
+                     tenant_of=None):
     """Open-loop Poisson replay through the router over HTTP: each
     arrival fires a client thread (open-loop — a slow fleet cannot slow
     the arrival process). `on_offset` is the chaos hook: (offset_s,
     callable) runs once when the schedule passes that offset — the bench
-    kills a replica with it mid-window. Returns completion counts and
-    latency percentiles."""
+    kills a replica with it mid-window. `tenant_of` (index -> tenant
+    string) stamps each request with a tenant so the usage ledger has
+    something to attribute. Returns completion counts and latency
+    percentiles."""
     results = [None] * len(arrivals)
     threads = []
     fired = threading.Event()
@@ -1350,12 +1353,11 @@ def run_fleet_window(port, arrivals, seeds, timeout_s=60.0, on_offset=None):
             threading.Thread(target=on_offset[1], daemon=True).start()
 
         def client(i=i, seed=seed):
-            results[i] = fleet_request(
-                port,
-                {"prompt": f"fleet bench {seed}", "seed": int(seed),
-                 "timeout_s": timeout_s},
-                timeout=timeout_s + 5.0,
-            )
+            body = {"prompt": f"fleet bench {seed}", "seed": int(seed),
+                    "timeout_s": timeout_s}
+            if tenant_of is not None:
+                body["tenant"] = tenant_of(i)
+            results[i] = fleet_request(port, body, timeout=timeout_s + 5.0)
 
         t = threading.Thread(target=client, daemon=True)
         t.start()
@@ -1381,6 +1383,31 @@ def run_fleet_window(port, arrivals, seeds, timeout_s=60.0, on_offset=None):
     }
 
 
+def _fleet_block(scraper, router):
+    """The telemetry-plane slice of the fleet bench line: one final
+    scrape sweep (the killed replica shows up stale), then the capacity
+    model's goodput/suggested-replicas read and the usage ledger's
+    per-tenant chip-second attribution."""
+    scraper.scrape_once()
+    cap = scraper.capacity_report()
+    usage = router.usage.summary()
+    return {
+        "goodput_fraction": cap["goodput"]["fraction"],
+        "wasted_tokens": cap["goodput"]["wasted_tokens"],
+        "suggested_replicas": cap["suggested_replicas"],
+        "fresh_replicas": cap["fresh_replicas"],
+        "scrape_generations": {
+            name: {"generation": s.generation, "stale": s.stale}
+            for name, s in sorted(scraper.snapshot().items())
+        },
+        "chip_seconds_by_tenant": {
+            f'{r["tenant"]}/{r["priority"]}': r["chip_seconds"]
+            for r in usage["tenants"]
+        },
+        "chip_seconds_total": usage["totals"]["chip_seconds"],
+    }
+
+
 def main_fleet(n_replicas, hedge_after_ms=None):
     """`--replicas N` fleet mode: N in-process continuous-engine
     replicas behind a real `FleetRouter`, open-loop load over HTTP, one
@@ -1390,6 +1417,7 @@ def main_fleet(n_replicas, hedge_after_ms=None):
     import numpy as np
 
     from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+    from dalle_pytorch_tpu.obs.fleetmetrics import FleetScraper
     from dalle_pytorch_tpu.serving.engine import ContinuousEngine
     from dalle_pytorch_tpu.serving.router import FleetRouter, RouterServer
     from dalle_pytorch_tpu.serving.server import ServingServer
@@ -1421,7 +1449,11 @@ def main_fleet(n_replicas, hedge_after_ms=None):
         hedge_after_ms=hedge_after_ms,
         probe_interval_s=0.25,
     )
-    front = RouterServer(router, port=0).start()
+    scraper = FleetScraper(
+        [(rep.name, rep.url) for rep in router.replicas],
+        registry=router.registry, usage=router.usage, interval_s=0.5,
+    )
+    front = RouterServer(router, port=0, fleet=scraper).start()
     port = front.port
 
     # warm every replica (compile + one real request) and calibrate the
@@ -1456,7 +1488,11 @@ def main_fleet(n_replicas, hedge_after_ms=None):
             return {label: int(c.value) for label, c in fam.items()}
         return {"total": int(fam.value)}
 
-    healthy = run_fleet_window(port, arrivals, seeds)
+    # alternate two tenants so the usage ledger's chip-second
+    # attribution has something to split
+    tenant_of = lambda i: "tenant-a" if i % 2 == 0 else "tenant-b"
+
+    healthy = run_fleet_window(port, arrivals, seeds, tenant_of=tenant_of)
 
     # snapshot AFTER the healthy window: the router block must describe
     # the chaos window it is printed next to, not fold in warmup and
@@ -1474,7 +1510,8 @@ def main_fleet(n_replicas, hedge_after_ms=None):
         servers[0].shutdown(drain=False)
 
     killed = run_fleet_window(
-        port, arrivals, seeds + 1, on_offset=(kill_at, kill)
+        port, arrivals, seeds + 1, on_offset=(kill_at, kill),
+        tenant_of=tenant_of,
     )
 
     def _delta(name):
@@ -1514,6 +1551,7 @@ def main_fleet(n_replicas, hedge_after_ms=None):
                 for name, v in per_replica.items()
             },
         },
+        "fleet": _fleet_block(scraper, router),
         "p95_killed_vs_healthy": (
             round(killed["latency_p95_ms"] / healthy["latency_p95_ms"], 3)
             if killed["latency_p95_ms"] and healthy["latency_p95_ms"]

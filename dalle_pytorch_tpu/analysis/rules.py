@@ -136,6 +136,15 @@ TL021  hot-loop sharded gather: a host read (`jax.device_get`,
        a mesh-splitting sharding inside a hotloop-reachable function
        gathers the FULL array across the mesh every chunk — host-read
        leaves belong replicated (serving_partition's row-scalar rule).
+TL022  request-scoped data as a metric label in `serving/` or `obs/`:
+       a `.labels(...)` / `.labels_extra(...)` argument whose value
+       flows from a per-request identifier (trace IDs, prompts, raw
+       tenant/user strings, request keys) — every distinct value mints
+       a new child series, so an open endpoint can grow the registry
+       (and every scrape body) without bound. Values routed through a
+       bounding call (`*bounded*`, `*clamp*`, `*bucket*`, `*intern*`,
+       `*canonical*`, `*cap*` — the UsageLedger `__other__` pattern)
+       are trusted; opaque locals stay silent (false-negative bias).
 TL009  a `Trace.begin(...)` span whose matching `end()` is unreachable
        on the exception path: begin and end in the SAME function, every
        `end` in straight-line code — an exception between them leaks the
@@ -2001,6 +2010,104 @@ class ShardedHostReadRule(Rule):
             )
 
 
+class MetricsCardinalityRule(Rule):
+    code = "TL022"
+    name = "metrics-cardinality"
+    description = (
+        "request-scoped data (trace IDs, prompts, raw tenant/user "
+        "strings) used as a metric label value — every distinct value "
+        "mints a new child series, so an open endpoint grows the "
+        "registry and every scrape body without bound; route the value "
+        "through a bounding clamp (charset/length cap + `__other__` "
+        "overflow) first"
+    )
+
+    #: label hygiene is a serving/observability contract; offline
+    #: training scripts don't expose a scrape endpoint to open traffic
+    SCOPED_DIRS = ("serving", "obs")
+
+    #: identifier fragments that mark a value as request-scoped. A
+    #: heuristic by design (false-negative bias, like TL010's backoff
+    #: list): `trace_id`, `req.prompt`, `body["tenant"]`, `user_id`
+    #: all match; opaque locals (`label`, `reason`, `name`) stay silent.
+    REQUEST_HINTS = (
+        "trace", "prompt", "request_id", "request_key", "tenant",
+        "user_id",
+    )
+    REQUEST_EXACT = ("user",)
+
+    #: call-name fragments that count as cardinality discipline — a
+    #: value routed through one of these is trusted as bounded (the
+    #: UsageLedger `_bounded_tenant` -> `__other__` pattern).
+    BOUND_HINTS = ("bound", "clamp", "intern", "bucket", "canonical",
+                   "cap")
+
+    def _in_scope(self, ctx: FileContext) -> bool:
+        return any(d in ctx.path.parts for d in self.SCOPED_DIRS)
+
+    @classmethod
+    def _risky_ident(cls, ident: Optional[str]) -> bool:
+        if not ident:
+            return False
+        s = ident.lower()
+        return s in cls.REQUEST_EXACT or any(
+            h in s for h in cls.REQUEST_HINTS
+        )
+
+    @classmethod
+    def _risky_source(cls, node: ast.AST) -> Optional[str]:
+        """The identifier that makes `node` request-scoped, or None.
+        Descends through pass-through calls (`str(...)`, f-strings,
+        concats) but treats a bounding call as a trust boundary."""
+        if isinstance(node, ast.Call):
+            dotted = (dotted_name(node.func) or "").lower()
+            if any(h in dotted for h in cls.BOUND_HINTS):
+                return None  # clamped: trusted
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                hit = cls._risky_source(arg)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.Subscript):
+            key = node.slice
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                if cls._risky_ident(key.value):
+                    return f'[{key.value!r}]'
+            return cls._risky_source(node.value)
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute)):
+                ident = terminal_name(sub)
+                if cls._risky_ident(ident):
+                    return ident
+        return None
+
+    def check(self, ctx: FileContext, package) -> Iterator[Finding]:
+        if not self._in_scope(ctx):
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("labels", "labels_extra")):
+                continue
+            values = list(node.args) + [k.value for k in node.keywords]
+            for value in values:
+                hit = self._risky_source(value)
+                if hit is None:
+                    continue
+                yield ctx.finding(
+                    self.code, node,
+                    f"request-scoped value `{hit}` used as a metric "
+                    "label — every distinct value mints a new child "
+                    "series, so an open endpoint grows the registry "
+                    "and every scrape body without bound; clamp it "
+                    "first (charset/length cap with an `__other__` "
+                    "overflow bucket — recognized call names: "
+                    f"{', '.join(self.BOUND_HINTS)})",
+                )
+                break  # one finding per call site
+
+
 ALL_RULES: Tuple[Rule, ...] = (
     TracerBranchRule(),
     HostSyncRule(),
@@ -2023,4 +2130,5 @@ ALL_RULES: Tuple[Rule, ...] = (
     ImplicitReshardRule(),
     DivisibilityFallbackRule(),
     ShardedHostReadRule(),
+    MetricsCardinalityRule(),
 )

@@ -43,12 +43,30 @@ from typing import Dict, Optional
 # tracelint: threads
 class StructuredLog:
     """Thread-safe JSONL writer. Failures to write never raise into the
-    serving path (a closed pipe must not fail a request)."""
+    serving path (a closed pipe must not fail a request).
+
+    File-backed mode (`path=`) adds size-capped rotation: once the file
+    exceeds `max_mb`, it is renamed to `<path>.1` (replacing any prior
+    one — keep-one policy, so disk use is bounded at ~2x the cap) and a
+    fresh file is started. Rotation failures are swallowed like write
+    failures: a long-lived replica must not fail a request over its own
+    log housekeeping."""
 
     def __init__(self, stream=None, component: str = "dalle.serving",
-                 site: Optional[str] = None):
+                 site: Optional[str] = None, path: Optional[str] = None,
+                 max_mb: Optional[float] = None):
         from dalle_pytorch_tpu.obs.aggregate import default_site, sanitize_site
 
+        assert stream is None or path is None, (
+            "pass a stream OR a file path, not both"
+        )
+        self._path = str(path) if path is not None else None
+        self._max_bytes = (
+            int(float(max_mb) * 1024 * 1024)
+            if max_mb is not None and self._path is not None else None
+        )
+        if self._path is not None:
+            stream = open(self._path, "a", encoding="utf-8")
         self._stream = stream if stream is not None else sys.stdout
         self._component = component
         self._lock = threading.Lock()
@@ -64,13 +82,40 @@ class StructuredLog:
             "host": sanitize_site(socket.gethostname() or "localhost"),
         }
 
+    def _rotate_locked(self) -> None:
+        """Caller holds the lock. Rename the full file to `<path>.1`
+        (keep one) and start fresh; any failure leaves the current
+        stream writable and is retried implicitly at the next cap
+        crossing."""
+        try:
+            self._stream.close()
+        except (ValueError, OSError):
+            pass
+        try:
+            os.replace(self._path, self._path + ".1")
+        except OSError:
+            pass  # rename failed: reopen appends to the oversized file
+        try:
+            self._stream = open(self._path, "a", encoding="utf-8")
+        except OSError:
+            # can't reopen (dir vanished?): swallow writes from now on
+            # rather than raise into the request path
+            self._stream = None
+
     def _emit(self, record: Dict) -> None:
         record = {**self._identity, **record}
         line = json.dumps(record, default=str)
         try:
             with self._lock:
+                if self._stream is None:
+                    return
                 self._stream.write(line + "\n")
                 self._stream.flush()
+                if (
+                    self._max_bytes is not None
+                    and self._stream.tell() >= self._max_bytes
+                ):
+                    self._rotate_locked()
         except (ValueError, OSError):
             pass  # stream closed mid-shutdown; the request already succeeded
 

@@ -1565,6 +1565,9 @@ class PagedContinuousEngine(ContinuousEngine):
                 "hits": cache.hits,
                 "misses": cache.misses,
                 "evictions": cache.evictions,
+                # seen-keys Bloom digest for the fleet scraper: the
+                # prefix-affinity signal a future placer intersects
+                "bloom": cache.bloom_digest(),
             },
         }
 

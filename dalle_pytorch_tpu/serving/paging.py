@@ -39,6 +39,7 @@ arguments, so no allocation decision ever triggers a recompile.
 
 from __future__ import annotations
 
+import base64
 import hashlib
 import heapq
 from collections import OrderedDict
@@ -197,6 +198,40 @@ class PrefixCache:
         prompt must not pin its entry against eviction by being asked
         about."""
         return self._entries.get(self._key(text_ids))
+
+    def bloom_digest(self, bits: int = 256, hashes: int = 2) -> Dict:
+        """Compact Bloom filter over the cached prompt keys, advertised
+        on /healthz for the fleet scraper: a router-side placer can test
+        "has replica R plausibly seen this prompt?" without shipping the
+        key set (the first observable slice of prefix-affine routing).
+        False positives shrink with `bits`; never false negatives for
+        the snapshot it was built from.
+
+        Reads from probe threads race the worker's inserts/evictions —
+        the snapshot is retried a few times and degrades to an empty
+        digest rather than raising into the health path."""
+        keys: List[bytes] = []
+        for _ in range(3):
+            try:
+                keys = list(self._entries)
+                break
+            except RuntimeError:  # resized mid-iteration; retry
+                continue
+        bitmap = bytearray(max(8, bits) // 8)
+        nbits = len(bitmap) * 8
+        for key in keys:
+            digest = hashlib.blake2b(key, digest_size=4 * hashes).digest()
+            for i in range(hashes):
+                idx = int.from_bytes(
+                    digest[4 * i:4 * (i + 1)], "little"
+                ) % nbits
+                bitmap[idx // 8] |= 1 << (idx % 8)
+        return {
+            "bits": nbits,
+            "hashes": int(hashes),
+            "entries": len(keys),
+            "b64": base64.b64encode(bytes(bitmap)).decode("ascii"),
+        }
 
     def block_page(self, h: str) -> Optional[int]:
         """Page registered for one chain hash, None when unknown."""

@@ -755,6 +755,13 @@ class FleetRouter:
             "checkpoints ingested from replica spool hand-offs "
             "(POST /admin/spool)",
         )
+        # per-tenant / per-priority usage accounting: every successful
+        # dispatch records its replica wall + token usage here; the
+        # fleet scraper joins in ProgramCostTable FLOP rates and
+        # GET /debug/usage reads it back
+        from dalle_pytorch_tpu.obs.fleetmetrics import UsageLedger
+
+        self.usage = UsageLedger(registry=registry)
         for rep in self.replicas:
             self._m_state.labels(rep.name).set(STATE_VALUES[rep.state()])
             self._m_outstanding.labels(rep.name).set(0)
@@ -1406,6 +1413,40 @@ class FleetRouter:
 
     # ------------------------------------------------------------ requests
 
+    def _record_usage(self, body: Dict, res: Dict, wall_s: float) -> None:
+        """Attribute one successful dispatch to the usage ledger:
+        replica-reported wall (`latency_ms`, the chip-second basis) and
+        the response's `usage` token block, falling back to router-side
+        wall when the body carries neither. Accounting only — a broken
+        body must never fail the reply it is accounting for."""
+        try:
+            usage: Dict = {}
+            latency_ms = None
+            try:
+                payload = json.loads(res.get("body") or b"{}")
+                if isinstance(payload, dict):
+                    u = payload.get("usage")
+                    usage = u if isinstance(u, dict) else {}
+                    latency_ms = payload.get("latency_ms")
+            except Exception:
+                pass
+            wall = (
+                float(latency_ms) / 1000.0
+                if isinstance(latency_ms, (int, float)) else float(wall_s)
+            )
+            rep = res.get("replica")
+            self.usage.record(
+                tenant=body.get("tenant"),
+                priority=str(body.get("priority", "normal")),
+                rows=int(body.get("num_images", 1) or 1),
+                wall_s=wall,
+                decoded_tokens=int(usage.get("decoded_tokens") or 0),
+                resumed_tokens=int(usage.get("resumed_tokens") or 0),
+                replica=rep.name if rep is not None else None,
+            )
+        except Exception:
+            pass
+
     def handle_generate(self, raw: bytes, inbound_headers) -> Tuple[
         int, bytes, List[Tuple[str, str]]
     ]:
@@ -1599,6 +1640,10 @@ class FleetRouter:
                 if status == 200 and resume_reason is not None:
                     with self._lock:
                         res["replica"].resumes += 1
+                if status == 200:
+                    # usage accounting off the reply's own metadata
+                    # (never fails the reply; tenant rides the body)
+                    self._record_usage(body, res, self._now() - t0)
                 closed_out(
                     outcome, status, replica=res["replica"].name,
                 )
@@ -2023,6 +2068,13 @@ class FleetRouter:
                 if int(minfo) == 200 and resume_reason is not None:
                     with self._lock:
                         primary.resumes += 1
+                if int(minfo) == 200:
+                    # streamed bytes passed through unparsed: record the
+                    # wall-clock side of the usage row (token counts ride
+                    # only the buffered path's usage block)
+                    self._record_usage(
+                        body, {"replica": primary}, self._now() - t0,
+                    )
                 closed_out(
                     "ok" if int(minfo) == 200 else "replica_status",
                     int(minfo), replica=primary.name,
@@ -2362,6 +2414,34 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 pass
         elif path == "/debug/replicas":
             self._reply(200, router.detail())
+        elif path == "/fleet/metrics":
+            fleet = self.server.owner.fleet
+            if fleet is None:
+                self._reply(404, {
+                    "error": "fleet metrics disabled (--no_fleet_metrics)"
+                })
+                return
+            text = fleet.federated_render().encode("utf-8")
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(text)))
+            self.end_headers()
+            try:
+                self.wfile.write(text)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+        elif path == "/debug/fleet":
+            fleet = self.server.owner.fleet
+            if fleet is None:
+                self._reply(404, {
+                    "error": "fleet metrics disabled (--no_fleet_metrics)"
+                })
+                return
+            self._reply(200, fleet.fleet_detail())
+        elif path == "/debug/usage":
+            self._reply(200, router.usage.summary())
         else:
             self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -2488,15 +2568,22 @@ class RouterServer:
 
     def __init__(self, router: FleetRouter, host: str = "127.0.0.1",
                  port: int = 8100, verbose: bool = False,
-                 probes: bool = True):
+                 probes: bool = True, fleet: Optional[object] = None):
         self.router = router
         self.verbose = verbose
+        #: optional FleetScraper (obs/fleetmetrics.py) behind
+        #: GET /fleet/metrics and /debug/fleet — owned here so its
+        #: thread lifecycle matches the probe loop's, never the
+        #: dispatch path's
+        self.fleet = fleet
         self._httpd = _HTTPServer((host, port), self)
         self._thread: Optional[threading.Thread] = None
         self._serving = False
         self._closed = False
         if probes:
             router.start_probes()
+        if fleet is not None:
+            fleet.start()
 
     @property
     def port(self) -> int:
@@ -2522,6 +2609,8 @@ class RouterServer:
 
     def shutdown(self) -> None:
         self.router.stop_probes()
+        if self.fleet is not None:
+            self.fleet.stop()
         first_close = not self._closed
         self._closed = True
         if self._serving:
@@ -2583,6 +2672,15 @@ def add_router_args(p: argparse.ArgumentParser,
                    "to arrive (supervisor hand-off) before failing over "
                    "from scratch; 0 = never park (spooled resumes still "
                    "apply when the hand-off already landed)")
+    p.add_argument("--fleet_scrape_interval_s", type=float, default=2.0,
+                   help="seconds between fleet telemetry sweeps "
+                   "(/metrics + /debug/vitals + /healthz per replica) "
+                   "feeding GET /fleet/metrics and /debug/fleet")
+    p.add_argument("--no_fleet_metrics", action="store_true",
+                   help="disable the fleet telemetry scraper "
+                   "(/fleet/metrics and /debug/fleet answer 404; "
+                   "per-tenant /debug/usage still works from the "
+                   "router's own accounting)")
 
 
 def router_from_args(args, registry=None, log=None) -> FleetRouter:
@@ -2624,6 +2722,24 @@ def router_from_args(args, registry=None, log=None) -> FleetRouter:
     )
 
 
+def fleet_scraper_from_args(args, router: FleetRouter, log=None):
+    """Build the fleet telemetry scraper for a router CLI boot (None
+    when --no_fleet_metrics): scrapes the SAME replica set the router
+    routes to, shares its registry (so /metrics carries the
+    dalle_fleet_* gauges) and its usage ledger."""
+    if getattr(args, "no_fleet_metrics", False):
+        return None
+    from dalle_pytorch_tpu.obs.fleetmetrics import FleetScraper
+
+    return FleetScraper(
+        [(rep.name, rep.url) for rep in router.replicas],
+        registry=router.registry,
+        usage=router.usage,
+        interval_s=getattr(args, "fleet_scrape_interval_s", 2.0),
+        log=log,
+    )
+
+
 def run_router_server(args, log=None) -> int:
     """The shared CLI run loop: build the router from parsed args, serve
     in the foreground with double-signal handling. Both entrypoints
@@ -2635,6 +2751,7 @@ def run_router_server(args, log=None) -> int:
     server = RouterServer(
         router, host=args.host, port=args.port,
         verbose=getattr(args, "verbose", False),
+        fleet=fleet_scraper_from_args(args, router, log=log),
     )
 
     stopping = threading.Event()

@@ -163,6 +163,23 @@ from dalle_pytorch_tpu.serving.engine import (
 MAX_BODY_BYTES = 1 << 20  # prompts are tiny; reject anything bigger
 
 
+def _usage_block(engine, req, num_images: int) -> dict:
+    """Per-request token accounting for the response payload: the fleet
+    router's usage ledger attributes chip-seconds and decode work per
+    tenant off this block, so it must distinguish tokens this replica
+    actually decoded from tokens restored verbatim out of a resume
+    checkpoint (migrated/resumed requests re-pay nothing for those)."""
+    seq = int(getattr(engine, "image_seq_len", 0) or 0)
+    resumed = sum(
+        len(t) for t in (getattr(req, "resume_tokens", None) or {}).values()
+    )
+    return {
+        "rows": int(num_images),
+        "decoded_tokens": max(0, int(num_images) * seq - resumed),
+        "resumed_tokens": int(resumed),
+    }
+
+
 def _png_b64(img: np.ndarray) -> str:
     from PIL import Image
 
@@ -683,6 +700,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "num_images": num_images,
                 "seed": int(seed),
                 "latency_ms": round((time.monotonic() - t0) * 1000.0, 2),
+                # per-request work accounting for the fleet router's
+                # usage ledger: tokens this replica decoded for THIS
+                # request vs tokens restored from a resume checkpoint
+                "usage": _usage_block(owner.engine, req, num_images),
             }
             if trace:
                 payload["trace_id"] = trace.trace_id
@@ -958,6 +979,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "num_images": num_images,
                 "seed": seed,
                 "latency_ms": round((time.monotonic() - t0) * 1000.0, 2),
+                "usage": _usage_block(owner.engine, req, num_images),
             }
             if trace:
                 payload["trace_id"] = trace.trace_id
@@ -1459,6 +1481,28 @@ class ServingServer:
             "compiled_shapes": list(self.engine.stats.compiled_shapes),
             "batch_shapes": list(self.engine.batch_shapes),
         }
+        # machine-readable work accounting for the fleet scraper's
+        # capacity/goodput model: warmup work done, the token geometry
+        # that converts batches to tokens, and the lifetime decode
+        # counters (also on /metrics — repeated here so one /healthz
+        # poll carries the whole capacity input)
+        work = {
+            "warmup_batches": int(
+                getattr(self.engine.stats, "warmup_batches", 0) or 0
+            ),
+            "image_seq_len": int(
+                getattr(self.engine, "image_seq_len", 0) or 0
+            ),
+            "max_batch": int(getattr(self.engine, "max_batch", 0) or 0),
+        }
+        for key, name in (
+            ("decoded_tokens", "dalle_serving_decoded_tokens_total"),
+            ("resumed_tokens", "dalle_serving_resumed_tokens_total"),
+        ):
+            counter = self.registry.get(name)
+            if counter is not None and hasattr(counter, "value"):
+                work[key] = int(counter.value)
+        detail["work"] = work
         if degraded_reasons:
             detail["degraded_reasons"] = degraded_reasons
         if self.vitals.slo is not None:

@@ -14,11 +14,12 @@ from __future__ import annotations
 
 import bisect
 import json
+import re
 import threading
 import time
 from collections import deque
 from pathlib import Path
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Iterable, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 
@@ -395,6 +396,225 @@ class MetricsRegistry:
         if exemplars:
             lines.append("# EOF")
         return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------- exposition text parsing
+#
+# The inverse of `MetricsRegistry.render()`, for the fleet scraper
+# (obs/fleetmetrics.py): a router-side poller pulls each replica's
+# GET /metrics body and needs the samples back as typed values to
+# federate, delta, and roll up. Tolerates both exposition flavors this
+# registry emits — classic text and the OpenMetrics exemplar variant
+# (`_total`-stripped counter family names, `# {...}` bucket exemplars,
+# trailing `# EOF`) — and the convenience `_p50`/`_p95` gauge lines that
+# carry a TYPE header but no HELP.
+
+
+class ParsedSample(NamedTuple):
+    """One exposition sample line: full rendered name (`foo_total`,
+    `foo_bucket`, ...), label dict, numeric value."""
+
+    name: str
+    labels: Dict[str, str]
+    value: float
+
+    def key(self) -> Tuple[str, Tuple[Tuple[str, str], ...]]:
+        """Hashable series identity (name + sorted labels) — the join
+        key for cross-scrape deltas and cross-replica rollups."""
+        return self.name, tuple(sorted(self.labels.items()))
+
+
+class ParsedFamily:
+    """All samples of one metric family plus its TYPE/HELP metadata."""
+
+    __slots__ = ("name", "type", "help", "samples")
+
+    def __init__(self, name: str, type: str = "untyped", help: str = ""):
+        self.name, self.type, self.help = name, type, help
+        self.samples: List[ParsedSample] = []
+
+    def histogram_series(self) -> Dict[Tuple[Tuple[str, str], ...], Dict]:
+        """Reassemble `_bucket`/`_sum`/`_count` samples into per-series
+        histogram points keyed by the non-`le` label set: each value is
+        `{"bounds": [...], "cum": [...], "count": int, "sum": float}`
+        with cumulative bucket counts and `+Inf` folded into `count`."""
+        out: Dict[Tuple[Tuple[str, str], ...], Dict] = {}
+
+        def point(labels: Dict[str, str]) -> Dict:
+            k = tuple(sorted(
+                (n, v) for n, v in labels.items() if n != "le"
+            ))
+            return out.setdefault(
+                k, {"bounds": [], "cum": [], "count": 0, "sum": 0.0}
+            )
+
+        for s in self.samples:
+            if s.name == f"{self.name}_bucket":
+                le = s.labels.get("le", "+Inf")
+                if le == "+Inf":
+                    point(s.labels)["count"] = int(s.value)
+                else:
+                    p = point(s.labels)
+                    p["bounds"].append(float(le))
+                    p["cum"].append(int(s.value))
+            elif s.name == f"{self.name}_sum":
+                point(s.labels)["sum"] = float(s.value)
+            elif s.name == f"{self.name}_count":
+                point(s.labels)["count"] = int(s.value)
+        for p in out.values():
+            order = sorted(range(len(p["bounds"])), key=p["bounds"].__getitem__)
+            p["bounds"] = [p["bounds"][i] for i in order]
+            p["cum"] = [p["cum"][i] for i in order]
+        return out
+
+
+_SAMPLE_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+#: sample-name suffixes that attach a line to a declared family; classic
+#: counters match their family name exactly, OpenMetrics counters add
+#: `_total`, histograms fan out into bucket/sum/count
+_FAMILY_SUFFIXES = ("", "_total", "_bucket", "_sum", "_count")
+
+
+def _unescape_label(v: str) -> str:
+    return v.replace('\\"', '"').replace("\\n", "\n").replace("\\\\", "\\")
+
+
+def _parse_sample_line(line: str) -> ParsedSample:
+    """`name[{labels}] value[ # exemplar...]` → ParsedSample. Raises
+    ValueError on anything malformed (the scraper treats that as a
+    failed scrape, not a partial one)."""
+    name, labels_part, rest = line, "", ""
+    brace = line.find("{")
+    if brace >= 0:
+        close = line.find("}", brace)
+        if close < 0:
+            raise ValueError(f"unterminated label block: {line!r}")
+        name = line[:brace]
+        labels_part = line[brace + 1:close]
+        rest = line[close + 1:].strip()
+    else:
+        try:
+            name, rest = line.split(None, 1)
+        except ValueError:
+            raise ValueError(f"sample line without a value: {line!r}")
+    if not _SAMPLE_NAME_RE.match(name):
+        raise ValueError(f"bad sample name in line: {line!r}")
+    labels: Dict[str, str] = {}
+    if labels_part:
+        matched = _LABEL_RE.findall(labels_part)
+        stripped = _LABEL_RE.sub("", labels_part).replace(",", "").strip()
+        if stripped:
+            raise ValueError(f"bad label block: {labels_part!r}")
+        labels = {k: _unescape_label(v) for k, v in matched}
+    # an OpenMetrics exemplar trails the value as ` # {...} v ts`
+    value_token = rest.split(" # ", 1)[0].strip().split()
+    if len(value_token) != 1:
+        raise ValueError(f"bad sample value in line: {line!r}")
+    tok = value_token[0]
+    try:
+        value = float("inf") if tok == "+Inf" else float(tok)
+    except ValueError:
+        raise ValueError(f"non-numeric sample value {tok!r} in {line!r}")
+    return ParsedSample(name, labels, value)
+
+
+def parse_exposition(text: str) -> Dict[str, ParsedFamily]:
+    """Parse Prometheus text exposition (as `MetricsRegistry.render`
+    emits it, either flavor) back into `{family name: ParsedFamily}`.
+
+    Strict on sample lines — a truncated or garbage body raises
+    ValueError rather than returning half a scrape — but permissive on
+    metadata: unknown comment lines are skipped, TYPE without HELP is
+    fine (the `_p50`/`_p95` convenience gauges), and samples with no
+    declared family land in an `untyped` one.
+    """
+    families: Dict[str, ParsedFamily] = {}
+
+    def family_for(sample_name: str) -> ParsedFamily:
+        for suffix in _FAMILY_SUFFIXES:
+            if suffix and not sample_name.endswith(suffix):
+                continue
+            base = sample_name[: len(sample_name) - len(suffix)] if suffix \
+                else sample_name
+            fam = families.get(base)
+            if fam is not None:
+                return fam
+        fam = families.setdefault(sample_name, ParsedFamily(sample_name))
+        return fam
+
+    for raw_line in text.splitlines():
+        line = raw_line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] == "TYPE":
+                fam = families.setdefault(parts[2], ParsedFamily(parts[2]))
+                fam.type = parts[3] if len(parts) > 3 else "untyped"
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                fam = families.setdefault(parts[2], ParsedFamily(parts[2]))
+                fam.help = parts[3] if len(parts) > 3 else ""
+            # anything else (# EOF, stray comments) is skippable metadata
+            continue
+        families_sample = _parse_sample_line(line)
+        family_for(families_sample.name).samples.append(families_sample)
+    return families
+
+
+def counter_delta(prev: Optional[float], cur: float) -> float:
+    """Reset-aware counter delta: a monotonic counter that went DOWN
+    means the replica restarted (a supervised crash/recovery) — clamp
+    the delta to 0 rather than going negative; the post-restart
+    increments land in the following scrapes once the new baseline is
+    recorded. `prev=None` (first sight of the series) also reads as 0:
+    a scraper joining mid-life must not claim the replica's whole
+    counter history as one interval's work."""
+    if prev is None or cur < prev:
+        return 0.0
+    return float(cur - prev)
+
+
+def merge_histogram_points(points: Iterable[Dict]) -> Dict:
+    """Merge per-replica histogram points (the `histogram_series()`
+    shape) into one fleet histogram. Identical bucket bounds — the
+    common case, every replica runs the same instrument definitions —
+    merge exactly (cumulative counts sum). Mismatched bounds merge on
+    the union grid, flooring each histogram's cumulative count at
+    unknown bounds to its nearest LOWER known bound (an undercount
+    bias, never an overcount)."""
+    points = [p for p in points if p is not None]
+    if not points:
+        return {"bounds": [], "cum": [], "count": 0, "sum": 0.0}
+    bounds: List[float] = sorted({b for p in points for b in p["bounds"]})
+
+    def cum_at(p: Dict, bound: float) -> int:
+        idx = bisect.bisect_right(p["bounds"], bound) - 1
+        return int(p["cum"][idx]) if idx >= 0 else 0
+
+    return {
+        "bounds": bounds,
+        "cum": [sum(cum_at(p, b) for p in points) for b in bounds],
+        "count": int(sum(p["count"] for p in points)),
+        "sum": float(sum(p["sum"] for p in points)),
+    }
+
+
+def render_histogram_point(name: str, point: Dict,
+                           labels: str = "") -> List[str]:
+    """Exposition bucket/sum/count lines for one merged histogram point
+    (no HELP/TYPE header — the caller owns family metadata). `labels`
+    is a pre-rendered `k="v"` list spliced before `le`."""
+    prefix = f"{labels}," if labels else ""
+    lines = [
+        f'{name}_bucket{{{prefix}le="{_fmt(b)}"}} {int(c)}'
+        for b, c in zip(point["bounds"], point["cum"])
+    ]
+    lines.append(f'{name}_bucket{{{prefix}le="+Inf"}} {int(point["count"])}')
+    suffix = f"{{{labels}}}" if labels else ""
+    lines.append(f'{name}_sum{suffix} {_fmt(point["sum"])}')
+    lines.append(f'{name}_count{suffix} {int(point["count"])}')
+    return lines
 
 
 class MetricsLogger:

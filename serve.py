@@ -244,6 +244,16 @@ def parse_args(argv=None):
     p.add_argument("--no_request_log", action="store_true",
                    help="suppress the structured JSON log line per "
                    "completed request")
+    p.add_argument("--request_log_path", type=str, default=None,
+                   metavar="FILE",
+                   help="write structured JSONL to FILE instead of "
+                   "stdout (append mode; lifecycle events included)")
+    p.add_argument("--request_log_max_mb", type=float, default=None,
+                   metavar="MB",
+                   help="rotate --request_log_path once it exceeds MB "
+                   "megabytes: the full file is renamed to FILE.1 "
+                   "(keep one) and a fresh file is started, so disk "
+                   "use stays bounded at ~2x the cap")
     p.add_argument("--no_vitals", action="store_true",
                    help="disable the engine-vitals sampler (and with it "
                    "the stall watchdog and SLO burn tracking); "
@@ -293,6 +303,12 @@ def parse_args(argv=None):
         p.error("--spool_every must be >= 1")
     if args.preview_every < 0:
         p.error("--preview_every must be >= 0 (0 disables previews)")
+    if args.request_log_max_mb is not None:
+        if args.request_log_path is None:
+            p.error("--request_log_max_mb rotates a log file; it needs "
+                    "--request_log_path")
+        if args.request_log_max_mb <= 0:
+            p.error("--request_log_max_mb must be > 0")
     if args.router:
         if not args.replicas:
             p.error("--router needs --replicas URL[,URL...]")
@@ -363,7 +379,9 @@ def run_router(args):
     from dalle_pytorch_tpu.obs.logging import StructuredLog
     from dalle_pytorch_tpu.serving.router import run_router_server
 
-    log = StructuredLog(component="dalle.router", site=args.trace_site)
+    log = StructuredLog(component="dalle.router", site=args.trace_site,
+                        path=args.request_log_path,
+                        max_mb=args.request_log_max_mb)
     return run_router_server(args, log=log)
 
 
@@ -401,7 +419,8 @@ def main(argv=None):
     # per-request lines; lifecycle events (warmup, trace_dump, shutdown)
     # always flow. --trace_site stamps every line's process identity so
     # fleet logs merge and join against collector traces by trace_id.
-    log = StructuredLog(site=args.trace_site)
+    log = StructuredLog(site=args.trace_site, path=args.request_log_path,
+                        max_mb=args.request_log_max_mb)
 
     registry = MetricsRegistry()
     cache = None

@@ -1,0 +1,38 @@
+"""TL022 negative fixture: the label-hygiene shapes the rule must
+trust — constant labels, small closed enums, and request data routed
+through a bounding clamp before it reaches the registry."""
+
+OTHER = "__other__"
+
+
+def _bounded_tenant(tenant, seen, cap=32):
+    """Charset/length clamp with an `__other__` overflow bucket — the
+    UsageLedger pattern TL022's guard recognizes by name."""
+    safe = "".join(c for c in str(tenant or "") if c.isalnum())[:64]
+    if safe not in seen and len(seen) >= cap:
+        return OTHER
+    seen.add(safe)
+    return safe
+
+
+def constant_labels(metric):
+    metric.labels("queue").observe(0.25)
+    metric.labels("generate").observe(1.5)
+
+
+def closed_enum_labels(metric, rep, reason):
+    # replica names and ejection reasons come from config / a closed
+    # set, not from request payloads
+    metric.labels(rep.name).set(3)
+    metric.labels(reason).inc()
+
+
+def clamped_tenant(metric, body, seen):
+    # routed through the bound: trusted even though `tenant` appears
+    metric.labels(_bounded_tenant(body["tenant"], seen)).inc()
+
+
+def opaque_local(metric, label):
+    # an opaque local stays silent (false-negative bias): the rule
+    # cannot see where `label` came from and does not guess
+    metric.labels_extra(label, priority="bulk").inc()

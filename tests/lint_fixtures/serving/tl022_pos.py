@@ -1,0 +1,18 @@
+"""TL022 positive fixture (path carries `serving/`, so the rule is in
+scope): request-scoped data flowing into metric label values — each
+distinct value mints a new child series, unbounded over open traffic."""
+
+
+def per_trace_series(metric, req):
+    # trace IDs are unique per request: one series per request, forever
+    metric.labels(req.trace_id).inc()
+
+
+def raw_tenant_from_body(metric, body):
+    # the raw tenant string arrives from the wire unclamped
+    metric.labels(body["tenant"]).inc()
+
+
+def user_kwarg_through_str(metric, user):
+    # str() is a pass-through, not a bound: still one series per user
+    metric.labels_extra("ok", who=str(user)).set(1)

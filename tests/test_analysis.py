@@ -54,6 +54,7 @@ class TestRuleCorpus:
             ("serving/tl010_pos.py", "TL010", 3),
             ("serving/tl011_pos.py", "TL011", 3),
             ("serving/tl012_pos.py", "TL012", 3),
+            ("serving/tl022_pos.py", "TL022", 3),
         ],
     )
     def test_positive_fixture_caught(self, fixture, code, expected):
@@ -85,6 +86,7 @@ class TestRuleCorpus:
             "serving/tl010_neg.py",
             "serving/tl011_neg.py",
             "serving/tl012_neg.py",
+            "serving/tl022_neg.py",
         ],
     )
     def test_negative_fixture_clean(self, fixture):

@@ -1,0 +1,852 @@
+"""Fleet telemetry plane: exposition parsing, the scraper's failure
+matrix, per-tenant usage attribution, and the capacity/goodput signal.
+
+The load-bearing contracts:
+
+  * the parser is the EXACT inverse of this repo's own exposition
+    renderer (both flavors), so `/fleet/metrics` federation round-trips
+    through `parse_exposition` with no third-party client library;
+  * a counter reset (replica restart) clamps the delta to 0 — fleet
+    totals NEVER go backwards and never spike negative;
+  * every scrape failure mode — hard-killed replica, garbage body, hung
+    endpoint — degrades to a stale-marked generation and an error
+    counter; a hung endpoint cannot starve the other replicas' freshness
+    (scrapes are concurrent, sweep time = max not sum);
+  * tenant label cardinality is BOUNDED (`__other__` overflow) — the
+    usage ledger must survive an open endpoint inventing tenants.
+"""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dalle_pytorch_tpu.obs.fleetmetrics import (
+    CapacityModel,
+    FleetScraper,
+    ReplicaScrape,
+    UsageLedger,
+)
+from dalle_pytorch_tpu.serving.router import FleetRouter, RouterServer
+from dalle_pytorch_tpu.training.metrics import (
+    MetricsRegistry,
+    counter_delta,
+    merge_histogram_points,
+    parse_exposition,
+)
+
+
+def _sample_registry(counter=100.0, mfu=0.2):
+    """A small real registry exercising every instrument shape the
+    replicas actually export: plain counter, labeled gauge family,
+    histogram with an exemplar."""
+    reg = MetricsRegistry()
+    c = reg.counter("dalle_serving_decoded_tokens_total", "decoded")
+    c.inc(counter)
+    g = reg.gauge_family("dalle_serving_mfu", "mfu", label_name="program")
+    g.labels("decode_b4").set(mfu)
+    h = reg.histogram(
+        "dalle_serving_stage_seconds", "stages", buckets=(0.1, 1.0)
+    )
+    h.observe(0.05, exemplar="tr1")
+    h.observe(0.5)
+    return reg
+
+
+# ---------------------------------------------------------------- parser
+
+
+class TestExpositionParser:
+    def test_round_trips_own_classic_render(self):
+        reg = _sample_registry()
+        fams = parse_exposition(reg.render())
+        c = fams["dalle_serving_decoded_tokens_total"]
+        assert c.type == "counter"
+        assert [s.value for s in c.samples] == [100.0]
+        g = fams["dalle_serving_mfu"]
+        assert g.samples[0].labels == {"program": "decode_b4"}
+        assert g.samples[0].value == 0.2
+        h = fams["dalle_serving_stage_seconds"]
+        series = h.histogram_series()
+        ((_, point),) = series.items()
+        assert point["count"] == 2 and point["cum"] == [1, 2]
+        assert point["bounds"] == [0.1, 1.0]  # +Inf lives in "count"
+
+    def test_round_trips_openmetrics_flavor(self):
+        """exemplars=True: `_total`-stripped family names, exemplar
+        annotations on buckets, and the `# EOF` terminator — all must
+        parse, with exemplars stripped from the sample values."""
+        reg = _sample_registry()
+        fams = parse_exposition(reg.render(exemplars=True))
+        # OpenMetrics names the counter FAMILY without `_total`; the
+        # sample keeps it
+        c = fams["dalle_serving_decoded_tokens"]
+        assert c.type == "counter"
+        assert c.samples[0].name == "dalle_serving_decoded_tokens_total"
+        assert [s.value for s in c.samples] == [100.0]
+        h = fams["dalle_serving_stage_seconds"]
+        ((_, point),) = h.histogram_series().items()
+        assert point["count"] == 2
+
+    def test_malformed_sample_line_raises(self):
+        with pytest.raises(ValueError):
+            parse_exposition("dalle_x{unclosed 1.0\n")
+        with pytest.raises(ValueError):
+            parse_exposition("dalle_x not_a_number\n")
+
+    def test_counter_delta_clamps_never_negative(self):
+        assert counter_delta(None, 10.0) == 0.0   # no baseline yet
+        assert counter_delta(100.0, 40.0) == 0.0  # reset: clamp, not -60
+        assert counter_delta(10.0, 15.0) == 5.0
+
+    def test_histogram_merge_identical_bounds_sums_exactly(self):
+        a = {"bounds": [0.1, 1.0], "cum": [1, 3], "count": 4, "sum": 2.0}
+        b = {"bounds": [0.1, 1.0], "cum": [0, 2], "count": 5, "sum": 9.0}
+        m = merge_histogram_points([a, b])
+        assert m["cum"] == [1, 5] and m["count"] == 9
+        assert m["sum"] == 11.0
+
+    def test_histogram_merge_mismatched_bounds_floors_to_union(self):
+        """Unknown cut points floor to the nearest LOWER known bound
+        (undercount bias — a merged p95 can read low, never high)."""
+        a = {"bounds": [0.5], "cum": [2], "count": 4, "sum": 3.0}
+        b = {"bounds": [0.1], "cum": [1], "count": 3, "sum": 2.0}
+        m = merge_histogram_points([a, b])
+        assert m["bounds"] == [0.1, 0.5]
+        # a contributes 0 at 0.1 (its 2-at-0.5 can't be split lower);
+        # b's 1-at-0.1 carries forward to the coarser 0.5 cut
+        assert m["cum"] == [1, 3]
+        assert m["count"] == 7 and m["sum"] == 5.0
+
+
+# ------------------------------------------------------- scripted scraper
+
+
+def _scripted(payloads, **kw):
+    """FleetScraper whose `_fetch` serves from a dict instead of a
+    socket — the same seam the router's probe tests stub. `payloads`
+    maps replica name -> {path: str | bytes | dict | Exception}."""
+
+    class Scripted(FleetScraper):
+        def _fetch(self, url, path):
+            body = payloads[url][path]
+            if isinstance(body, Exception):
+                raise body
+            if isinstance(body, dict):
+                return json.dumps(body).encode()
+            return body.encode() if isinstance(body, str) else body
+
+    kw.setdefault("registry", MetricsRegistry())
+    return Scripted([(name, name) for name in payloads], **kw)
+
+
+def _ok_payload(counter=100.0, mfu=0.2, health=None):
+    return {
+        "/metrics": _sample_registry(counter=counter, mfu=mfu).render(),
+        "/healthz": health if health is not None else {
+            "status": "ok", "queue_depth_rows": 0, "slots_active": 1,
+            "uptime_s": 5.0,
+            "work": {"warmup_batches": 2, "image_seq_len": 16,
+                     "max_batch": 4},
+        },
+        "/debug/vitals?n=1": {"samples": []},
+    }
+
+
+def _counter_value(registry, name, label):
+    fam = registry.get(name)
+    items = dict(fam.items()) if fam is not None else {}
+    return int(items[label].value) if label in items else 0
+
+
+class TestScraperFailureMatrix:
+    def test_successful_sweep_commits_generation_and_monotonic(self):
+        payloads = {"r0": _ok_payload(counter=100.0)}
+        s = _scripted(payloads)
+        s.scrape_once(now=1.0)
+        snap = s.snapshot()["r0"]
+        assert snap.generation == 1 and snap.stale is False
+        # first sight is the baseline: totals count growth SINCE
+        # scraper start, so a pre-existing 100 contributes 0
+        assert s.fleet_totals("dalle_serving_decoded_tokens_total") == 0.0
+        payloads["r0"] = _ok_payload(counter=115.0)
+        s.scrape_once(now=2.0)
+        assert s.fleet_totals("dalle_serving_decoded_tokens_total") == 15.0
+
+    def test_counter_reset_clamps_delta_to_zero(self):
+        """A replica restart resets its counters; the fleet total must
+        hold, not go negative or double-count."""
+        payloads = {"r0": _ok_payload(counter=100.0)}
+        s = _scripted(payloads)
+        s.scrape_once(now=1.0)
+        payloads["r0"] = _ok_payload(counter=140.0)
+        s.scrape_once(now=2.0)
+        assert s.fleet_totals("dalle_serving_decoded_tokens_total") == 40.0
+        payloads["r0"] = _ok_payload(counter=5.0)   # restart: 140 -> 5
+        s.scrape_once(now=3.0)
+        assert s.fleet_totals("dalle_serving_decoded_tokens_total") == 40.0
+        payloads["r0"] = _ok_payload(counter=25.0)  # growth resumes
+        s.scrape_once(now=4.0)
+        assert s.fleet_totals("dalle_serving_decoded_tokens_total") == 60.0
+
+    def test_garbage_body_marks_stale_keeps_last_payload(self):
+        payloads = {"r0": _ok_payload(counter=100.0, mfu=0.3)}
+        s = _scripted(payloads)
+        s.scrape_once(now=1.0)
+        payloads["r0"] = dict(
+            _ok_payload(), **{"/metrics": "%%% not exposition {{{ 1"}
+        )
+        s.scrape_once(now=2.0)
+        snap = s.snapshot()["r0"]
+        assert snap.stale is True and snap.error
+        assert snap.generation == 1  # the generation is HISTORY
+        # last good payload still readable (mfu from sweep 1)
+        assert snap.families["dalle_serving_mfu"].samples[0].value == 0.3
+        assert _counter_value(
+            s.registry, "dalle_fleet_scrape_errors_total", "r0"
+        ) == 1
+
+    def test_truncated_health_body_marks_stale(self):
+        payloads = {"r0": dict(_ok_payload(), **{"/healthz": '{"status": '})}
+        s = _scripted(payloads)
+        s.scrape_once(now=1.0)
+        assert s.snapshot()["r0"].stale is True
+
+    def test_dead_replica_marks_stale_never_raises(self):
+        payloads = {
+            "r0": {
+                "/metrics": ConnectionRefusedError("dead"),
+                "/healthz": ConnectionRefusedError("dead"),
+                "/debug/vitals?n=1": ConnectionRefusedError("dead"),
+            },
+            "r1": _ok_payload(),
+        }
+        s = _scripted(payloads)
+        s.scrape_once(now=1.0)
+        assert s.snapshot()["r0"].stale is True
+        assert s.snapshot()["r1"].stale is False
+
+    def test_hard_killed_replica_real_socket(self):
+        """Real transport against a port nothing listens on
+        (ECONNREFUSED) — the unstubbed `_fetch` path must degrade the
+        same way."""
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        dead_port = sock.getsockname()[1]
+        sock.close()
+        s = FleetScraper(
+            [("r0", f"http://127.0.0.1:{dead_port}")],
+            registry=MetricsRegistry(), timeout_s=1.0,
+        )
+        s.scrape_once()
+        snap = s.snapshot()["r0"]
+        assert snap.stale is True and snap.generation == 0
+        assert _counter_value(
+            s.registry, "dalle_fleet_scrape_errors_total", "r0"
+        ) == 1
+
+    def test_hung_endpoint_does_not_starve_other_replicas(self):
+        """One replica hangs past the scrape timeout: the sweep is
+        bounded by the TIMEOUT (scrapes run concurrently), and the
+        healthy replica's generation still advances."""
+        hung = _HangingServer(delay_s=5.0)
+        healthy = _FleetStub("r1")
+        try:
+            s = FleetScraper(
+                [("r0", hung.url), ("r1", healthy.url)],
+                registry=MetricsRegistry(), timeout_s=0.5,
+            )
+            t0 = time.monotonic()
+            s.scrape_once()
+            wall = time.monotonic() - t0
+            assert wall < 4.0, f"sweep waited out the hang: {wall:.1f}s"
+            assert s.snapshot()["r0"].stale is True
+            assert s.snapshot()["r1"].stale is False
+            assert s.snapshot()["r1"].generation == 1
+        finally:
+            hung.kill()
+            healthy.kill()
+
+    def test_sweep_never_raises_even_if_capacity_model_breaks(self):
+        """The scrape loop must survive anything — drive the loop body
+        with a payload whose health block is adversarial junk."""
+        payloads = {"r0": _ok_payload(health={
+            "status": None, "queue_depth_rows": "junk",
+            "slots_active": {}, "slo": [{"burn_rate": "NaN-ish"}],
+        })}
+        s = _scripted(payloads)
+        try:
+            s.scrape_once(now=1.0)
+        except Exception as exc:  # pragma: no cover - the assertion
+            pytest.fail(f"sweep raised: {exc!r}")
+
+
+# ----------------------------------------------- federation round-trip
+
+
+class TestFederation:
+    def test_federated_render_round_trips_with_rollups(self):
+        payloads = {
+            "r0": _ok_payload(counter=100.0, mfu=0.2),
+            "r1": _ok_payload(counter=50.0, mfu=0.3),
+        }
+        s = _scripted(payloads)
+        s.scrape_once(now=1.0)
+        payloads["r0"] = _ok_payload(counter=130.0, mfu=0.25)
+        payloads["r1"] = _ok_payload(counter=60.0, mfu=0.1)
+        s.scrape_once(now=2.0)
+
+        fams = parse_exposition(s.federated_render())
+
+        # per-replica samples carry the replica label
+        mfu = fams["dalle_serving_mfu"]
+        by_replica = {
+            s_.labels["replica"]: s_.value
+            for s_ in mfu.samples if "replica" in s_.labels
+        }
+        assert by_replica == {"r0": 0.25, "r1": 0.1}
+        # gauge rollups: sum and max across the fleet
+        assert fams["dalle_serving_mfu:fleet_sum"].samples[0].value == 0.35
+        assert fams["dalle_serving_mfu:fleet_max"].samples[0].value == 0.25
+        # counter rollup is reset-corrected growth since scraper start
+        assert fams[
+            "dalle_serving_decoded_tokens_total:fleet_sum"
+        ].samples[0].value == 40.0
+        # histogram rollup merges buckets across replicas (2 obs each)
+        hist = fams["dalle_serving_stage_seconds:fleet"]
+        ((_, point),) = hist.histogram_series().items()
+        assert point["count"] == 4
+        # freshness meta rides the federated body itself
+        stale = {
+            s_.labels["replica"]: s_.value
+            for s_ in fams["dalle_fleet_scrape_stale"].samples
+        }
+        assert stale == {"r0": 0.0, "r1": 0.0}
+        gen = {
+            s_.labels["replica"]: s_.value
+            for s_ in fams["dalle_fleet_scrape_generation"].samples
+        }
+        assert gen == {"r0": 2.0, "r1": 2.0}
+
+
+# ------------------------------------------------------------ usage ledger
+
+
+class TestUsageLedger:
+    def test_chip_seconds_attributed_per_tenant_and_priority(self):
+        reg = MetricsRegistry()
+        u = UsageLedger(registry=reg)
+        u.record("acme", "normal", rows=2, wall_s=1.5, decoded_tokens=32)
+        u.record("acme", "normal", rows=1, wall_s=0.5, decoded_tokens=16)
+        u.record("acme", "bulk", rows=4, wall_s=2.0, decoded_tokens=64)
+        s = u.summary()
+        rows = {(r["tenant"], r["priority"]): r for r in s["tenants"]}
+        assert rows[("acme", "normal")]["chip_seconds"] == 2.0
+        assert rows[("acme", "normal")]["decoded_tokens"] == 48
+        assert rows[("acme", "bulk")]["chip_seconds"] == 2.0
+        assert s["totals"]["chip_seconds"] == 4.0
+        # the counter family carries the same attribution
+        fam = dict(reg.get("dalle_fleet_chip_seconds_total").items())
+        assert any("acme" in label and "bulk" in label for label in fam)
+
+    def test_tenant_cardinality_bounded_with_other_bucket(self):
+        u = UsageLedger(max_tenants=2)
+        u.record("a", "normal", rows=1, wall_s=1.0)
+        u.record("b", "normal", rows=1, wall_s=1.0)
+        for i in range(20):
+            u.record(f"attacker-{i}", "normal", rows=1, wall_s=1.0)
+        s = u.summary()
+        tenants = {r["tenant"] for r in s["tenants"]}
+        assert tenants == {"a", "b", UsageLedger.OTHER}
+        rows = {r["tenant"]: r for r in s["tenants"]}
+        assert rows[UsageLedger.OTHER]["requests"] == 20
+        # a KNOWN tenant still attributes to itself after the fold
+        u.record("a", "normal", rows=1, wall_s=1.0)
+        rows = {r["tenant"]: r for r in u.summary()["tenants"]}
+        assert rows["a"]["requests"] == 2
+
+    def test_tenant_string_sanitized(self):
+        u = UsageLedger(max_tenants=8)
+        u.record('ev"il\nten{ant}' + "x" * 200, "normal", rows=1,
+                 wall_s=1.0)
+        (row,) = u.summary()["tenants"]
+        assert all(
+            ch in UsageLedger._SAFE for ch in row["tenant"]
+        ) and len(row["tenant"]) <= 64
+        u.record(None, "normal", rows=1, wall_s=1.0)
+        assert any(
+            r["tenant"] == "anonymous" for r in u.summary()["tenants"]
+        )
+
+    def test_flops_attribution_uses_current_rate(self):
+        u = UsageLedger()
+        u.note_flops_rate(1e12)
+        u.record("a", "normal", rows=1, wall_s=2.0)
+        (row,) = u.summary()["tenants"]
+        assert row["est_flops"] == 2e12
+
+
+# --------------------------------------------------------- capacity model
+
+
+def _synthetic_scrape(name, stale=False, mfu=None, queue=0, slots=0,
+                      max_batch=4, burn=0.0, warmup_batches=0):
+    s = ReplicaScrape(name, name)
+    s.stale = stale
+    s.generation = 0 if stale else 3
+    s.health = {
+        "status": "ok", "queue_depth_rows": queue, "slots_active": slots,
+        "slo": [{"burn_rate": burn}],
+        "work": {"warmup_batches": warmup_batches, "image_seq_len": 16,
+                 "max_batch": max_batch},
+    }
+    if mfu is not None:
+        s.families = parse_exposition(
+            "# TYPE dalle_serving_mfu gauge\n"
+            f'dalle_serving_mfu{{program="decode"}} {mfu}\n'
+        )
+    return s
+
+
+class TestCapacityModel:
+    def test_mfu_headroom_against_serving_ceiling(self):
+        r = CapacityModel.replica_assessment(
+            _synthetic_scrape("r0", mfu=0.175)
+        )
+        assert r["mfu"] == 0.175
+        assert r["mfu_headroom"] == 0.5  # ceiling is 0.35, not 1.0
+
+    def test_slo_burn_asks_for_scale_up(self):
+        scrapes = {
+            "r0": _synthetic_scrape("r0", slots=2, burn=2.5),
+            "r1": _synthetic_scrape("r1", slots=2),
+        }
+        rep = CapacityModel.assess(scrapes)
+        assert rep["suggested_replicas"] == 3
+        assert rep["max_slo_burn"] == 2.5
+
+    def test_saturation_asks_for_scale_up(self):
+        scrapes = {
+            "r0": _synthetic_scrape("r0", slots=4, queue=20),
+            "r1": _synthetic_scrape("r1", slots=4, queue=20),
+        }
+        assert CapacityModel.assess(scrapes)["suggested_replicas"] == 3
+
+    def test_idle_fleet_releases_one_replica(self):
+        scrapes = {
+            "r0": _synthetic_scrape("r0", slots=0, queue=0),
+            "r1": _synthetic_scrape("r1", slots=0, queue=0),
+        }
+        assert CapacityModel.assess(scrapes)["suggested_replicas"] == 1
+
+    def test_stale_fleet_never_releases(self):
+        """No fresh data -> hold, don't scale down on blindness."""
+        scrapes = {
+            "r0": _synthetic_scrape("r0", stale=True),
+            "r1": _synthetic_scrape("r1", stale=True),
+        }
+        rep = CapacityModel.assess(scrapes)
+        assert rep["suggested_replicas"] == 2
+        assert rep["fresh_replicas"] == 0
+
+    def test_goodput_counts_redecode_and_warmup_as_waste(self):
+        scrapes = {
+            "r0": _synthetic_scrape("r0", warmup_batches=2, max_batch=4),
+        }
+        rep = CapacityModel.assess(
+            scrapes,
+            fleet_decoded_tokens=300.0,   # fleet burned 300 tokens
+            usage={"totals": {"decoded_tokens": 172}},  # delivered 172
+        )
+        g = rep["goodput"]
+        assert g["useful_tokens"] == 172
+        assert g["warmup_tokens"] == 2 * 16 * 4
+        assert g["wasted_tokens"] == (300 - 172) + 128
+        assert g["fraction"] == pytest.approx(172 / (172 + 256), abs=1e-3)
+
+    def test_goodput_never_negative_on_accounting_skew(self):
+        """Ledger ahead of the scrape (usage recorded before the next
+        sweep): waste clamps at warmup, fraction stays in [0, 1]."""
+        rep = CapacityModel.assess(
+            {"r0": _synthetic_scrape("r0")},
+            fleet_decoded_tokens=100.0,
+            usage={"totals": {"decoded_tokens": 150}},
+        )
+        assert rep["goodput"]["wasted_tokens"] == 0
+        assert rep["goodput"]["fraction"] == 1.0
+
+
+# ------------------------------------------------- router HTTP integration
+
+
+class _FleetStubHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        owner = self.server.owner
+        if self.path == "/metrics":
+            self._body(200, owner.registry.render().encode(),
+                       "text/plain; version=0.0.4")
+        elif self.path.startswith("/healthz"):
+            self._body(200, json.dumps(owner.health).encode())
+        elif self.path.startswith("/debug/vitals"):
+            self._body(200, json.dumps({"samples": []}).encode())
+        else:
+            self.send_error(404)
+
+    def do_POST(self):
+        owner = self.server.owner
+        length = int(self.headers.get("Content-Length", "0") or 0)
+        body = json.loads(self.rfile.read(length) or b"{}")
+        if owner.delay_s:
+            time.sleep(owner.delay_s)
+        owner.registry.counter(
+            "dalle_serving_decoded_tokens_total", "decoded"
+        ).inc(16)
+        self._body(200, json.dumps({
+            "tokens": [[int(body.get("seed", 0))] * 4],
+            "seed": body.get("seed"),
+            "replica": owner.name,
+            "latency_ms": owner.latency_ms,
+            "usage": {"rows": 1, "decoded_tokens": 16,
+                      "resumed_tokens": 0},
+        }).encode())
+
+    def _body(self, code, body, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        try:
+            self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class _StubHTTP(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _FleetStub:
+    """Replica stub serving the full scrape surface (/metrics, /healthz,
+    /debug/vitals) plus a /generate that reports a usage block — what
+    the telemetry integration needs beyond test_router's StubReplica."""
+
+    def __init__(self, name, latency_ms=250.0):
+        self.name = name
+        self.latency_ms = latency_ms
+        self.delay_s = 0.0
+        self.registry = _sample_registry()
+        self.health = {
+            "status": "ok", "queue_depth_rows": 0, "slots_active": 0,
+            "uptime_s": 9.0,
+            "work": {"warmup_batches": 1, "image_seq_len": 16,
+                     "max_batch": 4},
+            "kv": {"prefix_cache": {"bloom": {
+                "bits": 256, "hashes": 2, "entries": 1, "b64": "AAAA",
+            }}},
+        }
+        self._httpd = _StubHTTP(("127.0.0.1", 0), _FleetStubHandler)
+        self._httpd.owner = self
+        threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.02}, daemon=True,
+        ).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self._httpd.server_address[1]}"
+
+    def kill(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class _HangingServer:
+    """Accepts the TCP connection, then never answers — the hung-socket
+    flavor of a dying replica (distinct from ECONNREFUSED)."""
+
+    def __init__(self, delay_s=5.0):
+        self.delay_s = delay_s
+        self._sock = socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(4)
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        self._sock.settimeout(0.2)
+        conns = []
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+                conns.append(conn)  # hold it open, answer nothing
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self._sock.getsockname()[1]}"
+
+    def kill(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _http(method, port, path, body=None, timeout=10):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=(json.dumps(body).encode() if body is not None else None),
+        headers={"Content-Type": "application/json"},
+        method=method,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+        ctype = resp.headers.get("Content-Type", "")
+        return resp.status, raw, ctype
+
+
+class TestRouterFleetEndpoints:
+    def _fleet(self, n=2):
+        stubs = [_FleetStub(f"r{i}") for i in range(n)]
+        router = FleetRouter(
+            [f"{s.name}={s.url}" for s in stubs],
+            registry=MetricsRegistry(),
+        )
+        scraper = FleetScraper(
+            [(rep.name, rep.url) for rep in router.replicas],
+            registry=router.registry, usage=router.usage,
+            interval_s=30.0,  # driven by hand via scrape_once
+        )
+        server = RouterServer(
+            router, port=0, probes=False, fleet=scraper
+        ).start()
+        return stubs, router, scraper, server
+
+    def test_fleet_metrics_round_trip_and_usage_join(self):
+        stubs, router, scraper, server = self._fleet(2)
+        try:
+            port = server.port
+            for seed, tenant in ((1, "acme"), (2, "acme"), (3, "zyx")):
+                status, raw, _ = _http(
+                    "POST", port, "/generate",
+                    {"prompt": "x", "seed": seed, "tenant": tenant},
+                )
+                assert status == 200
+            scraper.scrape_once()
+
+            # federation round-trips through our own parser
+            status, raw, ctype = _http("GET", port, "/fleet/metrics")
+            assert status == 200 and "text/plain" in ctype
+            fams = parse_exposition(raw.decode())
+            assert "dalle_serving_mfu:fleet_max" in fams
+            replicas = {
+                s.labels.get("replica")
+                for s in fams["dalle_serving_mfu"].samples
+            }
+            assert replicas == {"r0", "r1"}
+
+            # usage: chip-seconds joined from the replicas' latency_ms
+            status, raw, _ = _http("GET", port, "/debug/usage")
+            usage = json.loads(raw)
+            rows = {r["tenant"]: r for r in usage["tenants"]}
+            assert rows["acme"]["requests"] == 2
+            assert rows["zyx"]["requests"] == 1
+            # 3 requests x 250ms replica-reported wall
+            assert usage["totals"]["chip_seconds"] == pytest.approx(
+                0.75, abs=1e-6
+            )
+            assert usage["totals"]["decoded_tokens"] == 48
+
+            # /debug/fleet: freshness + bloom digest + capacity signal
+            status, raw, _ = _http("GET", port, "/debug/fleet")
+            detail = json.loads(raw)
+            assert detail["replicas"]["r0"]["generation"] >= 1
+            assert detail["replicas"]["r0"]["stale"] is False
+            assert detail["replicas"]["r0"]["prefix_bloom"]["b64"] == "AAAA"
+            assert "suggested_replicas" in detail["capacity"]
+            assert detail["usage"]["totals"]["requests"] == 3
+        finally:
+            server.shutdown()
+            for s in stubs:
+                s.kill()
+
+    def test_killed_replica_goes_stale_routing_unaffected(self):
+        stubs, router, scraper, server = self._fleet(2)
+        try:
+            port = server.port
+            scraper.scrape_once()
+            stubs[0].kill()
+            scraper.scrape_once()
+            status, raw, _ = _http("GET", port, "/fleet/metrics")
+            fams = parse_exposition(raw.decode())
+            stale = {
+                s.labels["replica"]: s.value
+                for s in fams["dalle_fleet_scrape_stale"].samples
+            }
+            assert stale["r0"] == 1.0 and stale["r1"] == 0.0
+            # routing still works through the surviving replica
+            status, raw, _ = _http(
+                "POST", port, "/generate", {"prompt": "x", "seed": 9}
+            )
+            assert status == 200
+        finally:
+            server.shutdown()
+            for s in stubs:
+                s.kill()
+
+    def test_fleet_endpoints_404_when_disabled(self):
+        stub = _FleetStub("r0")
+        router = FleetRouter(
+            [f"r0={stub.url}"], registry=MetricsRegistry()
+        )
+        server = RouterServer(router, port=0, probes=False).start()
+        try:
+            for path in ("/fleet/metrics", "/debug/fleet"):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    _http("GET", server.port, path)
+                assert e.value.code == 404
+                e.value.read()
+            # /debug/usage always works: the ledger is the router's own
+            status, raw, _ = _http("GET", server.port, "/debug/usage")
+            assert status == 200
+        finally:
+            server.shutdown()
+            stub.kill()
+
+
+# ----------------------------------------- warm-fleet acceptance (slow)
+
+
+@pytest.mark.slow
+def test_warm_fleet_under_scrape_zero_compiles_and_usage_joins():
+    """The PR's acceptance pin: a warm 2-replica fleet under active
+    scraping serves with ZERO new compiles, /fleet/metrics round-trips
+    through our own parser with both replicas fresh, and the 2-tenant
+    chip-second attribution lands within 10% of the measured dispatch
+    wall."""
+    import jax
+    import jax.numpy as jnp
+
+    from dalle_pytorch_tpu.data.tokenizer import ByteTokenizer
+    from dalle_pytorch_tpu.models.dalle import DALLE
+    from dalle_pytorch_tpu.serving.engine import ContinuousEngine
+    from dalle_pytorch_tpu.serving.server import ServingServer
+
+    text_seq, fmap = 8, 4
+    model = DALLE(
+        dim=32, depth=2, heads=2, dim_head=8, num_image_tokens=32,
+        image_fmap_size=fmap, num_text_tokens=64, text_seq_len=text_seq,
+        shift_tokens=True, rotary_emb=True,
+    )
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(42),
+        jnp.zeros((1, text_seq), jnp.int32),
+        jnp.zeros((1, fmap * fmap), jnp.int32),
+    )
+    engines, servers = [], []
+    for _ in range(2):
+        eng = ContinuousEngine(
+            model=model, variables=params, max_batch=2, chunk_tokens=4,
+            prefill_batch=2, registry=MetricsRegistry(),
+        )
+        eng.tokenizer = ByteTokenizer()
+        engines.append(eng)
+        servers.append(
+            ServingServer(eng, port=0, request_timeout_s=60).start()
+        )
+    router = FleetRouter(
+        [f"r{i}=http://127.0.0.1:{s.port}" for i, s in enumerate(servers)],
+        registry=MetricsRegistry(),
+    )
+    scraper = FleetScraper(
+        [(rep.name, rep.url) for rep in router.replicas],
+        registry=router.registry, usage=router.usage, interval_s=30.0,
+    )
+    front = RouterServer(router, port=0, probes=False, fleet=scraper).start()
+
+    def _misses():
+        return [
+            e.registry.get(
+                "dalle_serving_engine_compile_misses_total"
+            ).value
+            for e in engines
+        ]
+
+    try:
+        # warm: enough sequential singles to compile both replicas
+        for seed in range(4):
+            status, _, _ = _http(
+                "POST", front.port, "/generate",
+                {"prompt": "warm", "seed": seed}, timeout=300,
+            )
+            assert status == 200
+        warm_misses = _misses()
+
+        scraper.scrape_once()
+        dispatch_wall = 0.0
+        client_wall = 0.0
+        for seed, tenant in (
+            (10, "tenant-a"), (11, "tenant-b"),
+            (12, "tenant-a"), (13, "tenant-b"),
+        ):
+            t0 = time.monotonic()
+            status, raw, _ = _http(
+                "POST", front.port, "/generate",
+                {"prompt": "x", "seed": seed, "tenant": tenant},
+                timeout=300,
+            )
+            client_wall += time.monotonic() - t0
+            assert status == 200
+            dispatch_wall += json.loads(raw)["latency_ms"] / 1000.0
+            scraper.scrape_once()  # scraping interleaves with dispatch
+
+        # the acceptance headline: warm traffic under scrape pins ZERO
+        # new compiles (a scrape that perturbed program shapes would
+        # show up here)
+        assert _misses() == warm_misses
+
+        status, raw, _ = _http("GET", front.port, "/fleet/metrics")
+        fams = parse_exposition(raw.decode())
+        stale = {
+            s.labels["replica"]: s.value
+            for s in fams["dalle_fleet_scrape_stale"].samples
+        }
+        assert stale == {"r0": 0.0, "r1": 0.0}
+        # the replicas' decode counters federate with fleet rollups
+        assert any(name.endswith(":fleet_sum") for name in fams)
+
+        # 2-tenant chip-seconds within 10% of the total dispatch wall
+        # (the replica-reported latency; the client clock bounds it
+        # from above with router+HTTP overhead on top)
+        rows = [
+            r for r in router.usage.summary()["tenants"]
+            if r["tenant"].startswith("tenant-")
+        ]
+        assert {r["tenant"] for r in rows} == {"tenant-a", "tenant-b"}
+        attributed = sum(r["chip_seconds"] for r in rows)
+        assert attributed == pytest.approx(dispatch_wall, rel=0.10)
+        assert attributed <= client_wall
+    finally:
+        front.shutdown()
+        for s in servers:
+            s.shutdown()

@@ -178,6 +178,39 @@ class TestStructuredLog:
         buf.close()
         log.event("after_close")  # must not raise into the serving path
 
+    def test_file_mode_rotates_at_cap_keep_one(self, tmp_path):
+        """--request_log_max_mb: crossing the cap renames the file to
+        `<path>.1` (replacing any prior .1 — disk use bounded at ~2x)
+        and keeps writing to a fresh file; no line is lost to rotation."""
+        import os
+
+        p = tmp_path / "req.jsonl"
+        log = StructuredLog(path=str(p), max_mb=0.0005)  # ~512 bytes
+        for i in range(40):
+            log.event("tick", i=i, pad="x" * 40)
+        assert (tmp_path / "req.jsonl.1").exists()
+        assert os.path.getsize(p) < 2 * 512  # rotated, not runaway
+        lines = []
+        for f in (p.with_name("req.jsonl.1"), p):
+            lines += [json.loads(l) for l in f.read_text().splitlines()]
+        # keep-one drops older ROTATED files, never lines mid-stream:
+        # the survivors are a contiguous tail ending at the last write
+        seen = [r["i"] for r in lines if r["event"] == "tick"]
+        assert seen == list(range(seen[0], 40))
+
+    def test_file_mode_write_failure_is_silent(self, tmp_path):
+        """A vanished log directory (node cleanup) must not raise into
+        the request path — writes degrade to no-ops."""
+        import shutil
+
+        d = tmp_path / "logs"
+        d.mkdir()
+        log = StructuredLog(path=str(d / "req.jsonl"), max_mb=0.0005)
+        log.event("before")
+        shutil.rmtree(d)
+        for i in range(200):  # enough to force a rotation attempt too
+            log.event("tick", i=i, pad="y" * 40)  # must not raise
+
 
 # ------------------------------------------------- stage metrics/exemplars
 

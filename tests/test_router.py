@@ -1179,6 +1179,20 @@ def test_fleet_bench_schema():
     for k in ("failovers", "hedges", "ejections", "retry_budget",
               "per_replica_share"):
         assert k in router_block, f"missing router key {k}"
+    fleet_block = line["fleet"]
+    for k in ("goodput_fraction", "suggested_replicas",
+              "scrape_generations", "chip_seconds_by_tenant",
+              "chip_seconds_total"):
+        assert k in fleet_block, f"missing fleet key {k}"
+    # the final sweep sees the killed replica: its generation is stale
+    assert fleet_block["scrape_generations"]["r0"]["stale"] is True
+    # both synthetic tenants got chip-seconds attributed
+    tenants = {
+        k.split("/")[0] for k in fleet_block["chip_seconds_by_tenant"]
+    }
+    assert {"tenant-a", "tenant-b"} <= tenants
+    assert fleet_block["chip_seconds_total"] > 0
+    assert 0.0 <= fleet_block["goodput_fraction"] <= 1.0
     # the chaos claim: killing a replica mid-window loses nothing
     assert line["killed"]["completed"] == line["killed"]["offered"], line
     assert line["value"] == 1.0
